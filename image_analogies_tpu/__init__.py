@@ -1,0 +1,34 @@
+"""image_analogies_tpu — a TPU-native (JAX/XLA/Pallas/pjit) Image Analogies framework.
+
+Implements the full capability surface of the reference
+(`rubychen0611/image-analogies-python`, Hertzmann et al., SIGGRAPH 2001 "Image
+Analogies"): given a training pair A -> A' and a new image B, synthesize B' such
+that A : A' :: B : B'.  One engine, several applications: artistic filters,
+texture synthesis, texture-by-numbers, super-resolution, and (new here) batched
+video analogies.
+
+Architecture (see SURVEY.md for the layer map):
+
+- ``ops/``      pure array ops: color (YIQ), Gaussian pyramid, neighborhood
+  feature extraction (the shared semantic spec, NumPy + JAX twins), distance
+  kernels, and the Pallas fused distance+argmin TPU kernel.
+- ``backends/`` the pluggable ``Matcher`` seam (BASELINE.json north star): a
+  NumPy/cKDTree CPU oracle and the JAX/Pallas TPU backend.  Only
+  ``build_features()`` / ``best_match()`` / ``synthesize_level()`` cross it.
+- ``models/``   the synthesis driver (coarse-to-fine loop) and application
+  modes (filter, texture-by-numbers, super-res, texture synthesis, video).
+- ``parallel/`` device-mesh utilities and the sharded patch-DB argmin
+  (``lax.pmin`` + index all-reduce over the ICI mesh).
+- ``utils/``    image I/O, checkpoint/resume, structured logging, SSIM eval.
+
+The reference mount was empty at survey time (SURVEY.md §0); semantics are
+pinned by the Hertzmann 2001 paper + BASELINE.json and locked by this package's
+own CPU oracle + test suite.
+"""
+
+from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.models.analogy import create_image_analogy
+
+__version__ = "0.1.0"
+
+__all__ = ["AnalogyParams", "create_image_analogy", "__version__"]

@@ -1,0 +1,23 @@
+"""Pluggable Matcher backends (BASELINE.json:5 — the `--backend` seam)."""
+
+from image_analogies_tpu.backends.base import LevelJob, Matcher
+
+
+def get_backend(params) -> "Matcher":
+    if params.backend == "cpu":
+        from image_analogies_tpu.backends.cpu import CpuMatcher
+
+        return CpuMatcher(params)
+    if params.backend == "tpu":
+        try:
+            from image_analogies_tpu.backends.tpu import TpuMatcher
+        except ImportError as e:
+            raise ImportError(
+                "the TPU backend requires jax; underlying error: "
+                f"{e}") from e
+
+        return TpuMatcher(params)
+    raise ValueError(f"unknown backend {params.backend!r}")
+
+
+__all__ = ["LevelJob", "Matcher", "get_backend"]

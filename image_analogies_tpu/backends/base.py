@@ -1,0 +1,93 @@
+"""The backend boundary (SURVEY.md §1: drawn between L3/L4 and L5).
+
+Per BASELINE.json:5, only feature building and best-match cross the backend
+boundary; the coarse-to-fine level loop stays in the Python driver
+(`models/analogy.py`).  A backend additionally owns the *within-level* scan
+(`synthesize_level`) so the TPU implementation can keep the raster scan on
+device inside one jitted `lax.fori_loop` instead of 10^6 host round-trips
+(SURVEY.md §7 step 5).
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from image_analogies_tpu.ops.features import FeatureSpec
+
+
+@dataclass
+class LevelJob:
+    """Everything a backend needs to synthesize one pyramid level.
+
+    Planes are host NumPy float32; `level` counts from the finest (0).
+    `a_src`/`b_src` may be (H,W) or (H,W,C_s) — label maps keep channels.
+    `*_coarse` planes are the next-coarser level (None at the coarsest level);
+    `b_filt_coarse` is the already-synthesized coarser B'.
+    """
+
+    level: int
+    spec: FeatureSpec
+    kappa_mult: float  # (1 + 2^-level * kappa)^2, threshold on squared dists
+
+    a_src: np.ndarray
+    a_filt: np.ndarray
+    b_src: np.ndarray
+    a_src_coarse: Optional[np.ndarray] = None
+    a_filt_coarse: Optional[np.ndarray] = None
+    b_src_coarse: Optional[np.ndarray] = None
+    b_filt_coarse: Optional[np.ndarray] = None
+    # Video mode: previous frame's planes at this level (temporal term).
+    a_temporal: Optional[np.ndarray] = None
+    b_temporal: Optional[np.ndarray] = None
+
+    @property
+    def a_shape(self) -> Tuple[int, int]:
+        return self.a_src.shape[:2]
+
+    @property
+    def b_shape(self) -> Tuple[int, int]:
+        return self.b_src.shape[:2]
+
+
+class Matcher(abc.ABC):
+    """A matching backend.  Stateless across levels except via returned values."""
+
+    def __init__(self, params):
+        self.params = params
+
+    @abc.abstractmethod
+    def build_features(self, job: LevelJob) -> Any:
+        """Build the per-level feature database over A/A' (opaque handle).
+
+        The handle also carries whatever precomputed query-side state the
+        backend wants (static query features, index maps, ...).
+        """
+
+    @abc.abstractmethod
+    def best_match(
+        self,
+        db: Any,
+        job: LevelJob,
+        q: int,
+        bp_flat: np.ndarray,
+        s_flat: np.ndarray,
+    ) -> Tuple[int, float, bool]:
+        """Best source pixel for query pixel q given the evolving (B', s).
+
+        Returns (p, squared_distance, used_coherence).  This is the
+        unit-testable seam; `synthesize_level` may fuse it for speed but must
+        agree with it.
+        """
+
+    @abc.abstractmethod
+    def synthesize_level(
+        self, db: Any, job: LevelJob
+    ) -> Tuple[np.ndarray, np.ndarray, Dict[str, Any]]:
+        """Raster-scan synthesis of one level.
+
+        Returns (bp (H,W) float32, s (H,W) int32 flat indices into A, stats).
+        """

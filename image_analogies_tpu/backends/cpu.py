@@ -1,0 +1,159 @@
+"""CPU oracle backend: NumPy + scipy.spatial.cKDTree (SURVEY.md §2 P6-P8).
+
+This is the faithful reimplementation of the reference's "NumPy/cKDTree path"
+(BASELINE.json:5) and serves three roles (SURVEY.md §4.1): the reference
+semantics spec, the SSIM-parity oracle for the TPU backend, and a fallback
+backend.  The per-pixel raster scan is deliberately literal — clarity over
+speed; the optional native C++ brute-force matcher (`native/`) accelerates the
+approximate match when ANN is off.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from image_analogies_tpu.backends.base import LevelJob, Matcher
+from image_analogies_tpu.ops.features import (
+    build_features_np,
+    fine_gather_maps,
+    window_offsets,
+)
+
+try:
+    from scipy.spatial import cKDTree
+except Exception:  # pragma: no cover - scipy is baked into this image
+    cKDTree = None
+
+
+@dataclass
+class CpuLevelDB:
+    """Per-level database + precomputed query-side state."""
+
+    db: np.ndarray  # (Na, F) weighted features over A/A'
+    tree: Optional["cKDTree"]
+    a_filt_flat: np.ndarray  # (Na,) A' luminance, flat
+    wa: int  # A width (for flat<->2d index math)
+    ha: int
+    static_q: np.ndarray  # (Nb, F) query features, fine_filt block zero
+    flat_idx: np.ndarray  # (Nb, n_fine) clipped gather map into B' plane
+    valid: np.ndarray  # (Nb, n_fine) causal & in-bounds mask (coherence)
+    written: np.ndarray  # (Nb, n_fine) causal & already-synthesized mask
+    fine_sqrtw: np.ndarray  # (n_fine,) sqrt-weights of the fine_filt block
+    offsets: np.ndarray  # (n_fine, 2) window offsets
+
+
+class CpuMatcher(Matcher):
+    def build_features(self, job: LevelJob) -> CpuLevelDB:
+        spec = job.spec
+        db = build_features_np(
+            spec, job.a_src, job.a_filt, job.a_src_coarse, job.a_filt_coarse,
+            temporal_fine=job.a_temporal,
+        )
+        static_q = build_features_np(
+            spec, job.b_src, None, job.b_src_coarse, job.b_filt_coarse,
+            temporal_fine=job.b_temporal,
+        )
+        hb, wb = job.b_shape
+        ha, wa = job.a_shape
+        flat_idx, valid, written = fine_gather_maps(hb, wb, spec.fine_size)
+        tree = (cKDTree(db) if (self.params.use_ann and cKDTree is not None)
+                else None)
+        return CpuLevelDB(
+            db=db,
+            tree=tree,
+            a_filt_flat=np.asarray(job.a_filt, np.float32).reshape(-1),
+            wa=wa,
+            ha=ha,
+            static_q=static_q,
+            flat_idx=flat_idx,
+            valid=valid,
+            written=written,
+            fine_sqrtw=spec.sqrt_weights()[spec.fine_filt_slice].copy(),
+            offsets=window_offsets(spec.fine_size),
+        )
+
+    # -- the three canonical pieces of the matcher (SURVEY.md §3.3) ---------
+
+    def query_vector(self, db: CpuLevelDB, job: LevelJob, q: int,
+                     bp_flat: np.ndarray) -> np.ndarray:
+        """Full feature vector of query pixel q given B'-so-far: the static
+        part (B / coarse planes) plus the causal gather from the evolving B'."""
+        vec = db.static_q[q].copy()
+        vec[job.spec.fine_filt_slice] = (
+            bp_flat[db.flat_idx[q]] * db.written[q] * db.fine_sqrtw)
+        return vec
+
+    def best_approximate_match(self, db: CpuLevelDB,
+                               qvec: np.ndarray) -> Tuple[int, float]:
+        """L2 nearest DB row: cKDTree when ANN on, else brute force."""
+        if db.tree is not None:
+            d, p = db.tree.query(qvec)
+            return int(p), float(d) ** 2
+        from image_analogies_tpu.backends import native_match
+
+        return native_match.brute_argmin(db.db, qvec)
+
+    def best_coherence_match(
+        self, db: CpuLevelDB, job: LevelJob, q: int, qvec: np.ndarray,
+        s_flat: np.ndarray,
+    ) -> Tuple[int, float]:
+        """Ashikhmin candidate: argmin over {s(r) + (q - r)} for causal r.
+
+        Returns (-1, inf) when no candidate is valid (e.g. the first pixel).
+        """
+        valid = db.valid[q] > 0
+        if not valid.any():
+            return -1, np.inf
+        r_flat = db.flat_idx[q][valid]
+        off = db.offsets[valid]
+        # p_c = s(r) + (q - r) = s(r) - offset, in A 2-D coords.
+        si = s_flat[r_flat] // db.wa - off[:, 0]
+        sj = s_flat[r_flat] % db.wa - off[:, 1]
+        inb = (si >= 0) & (si < db.ha) & (sj >= 0) & (sj < db.wa)
+        if not inb.any():
+            return -1, np.inf
+        cand = (si[inb] * db.wa + sj[inb]).astype(np.int64)
+        d = ((db.db[cand] - qvec[None, :]) ** 2).sum(axis=1)
+        k = int(np.argmin(d))  # first-lowest tie-break
+        return int(cand[k]), float(d[k])
+
+    def best_match(self, db: CpuLevelDB, job: LevelJob, q: int,
+                   bp_flat: np.ndarray, s_flat: np.ndarray
+                   ) -> Tuple[int, float, bool]:
+        qvec = self.query_vector(db, job, q, bp_flat)
+        p_app, d_app = self.best_approximate_match(db, qvec)
+        p_coh, d_coh = self.best_coherence_match(db, job, q, qvec, s_flat)
+        # kappa rule (Hertzmann §3.2 eq. 2, squared distances).
+        if p_coh >= 0 and d_coh <= d_app * job.kappa_mult:
+            return p_coh, d_coh, True
+        return p_app, d_app, False
+
+    # -- level scan ---------------------------------------------------------
+
+    def synthesize_level(self, db: CpuLevelDB, job: LevelJob
+                         ) -> Tuple[np.ndarray, np.ndarray, Dict[str, Any]]:
+        hb, wb = job.b_shape
+        n = hb * wb
+        bp = np.zeros(n, dtype=np.float32)
+        s = np.zeros(n, dtype=np.int32)
+        t0 = time.perf_counter()
+        n_coh = 0
+        for q in range(n):
+            p, _, used_coh = self.best_match(db, job, q, bp, s)
+            n_coh += used_coh
+            bp[q] = db.a_filt_flat[p]
+            s[q] = p
+        dt = time.perf_counter() - t0
+        stats = {
+            "level": job.level,
+            "db_rows": int(db.db.shape[0]),
+            "pixels": n,
+            "coherence_ratio": n_coh / max(n, 1),
+            "ms": dt * 1e3,
+            "backend": "cpu",
+        }
+        return bp.reshape(hb, wb), s.reshape(hb, wb), stats

@@ -1,0 +1,91 @@
+"""Brute-force L2 argmin for the CPU path, with an optional native C++ core.
+
+The reference leans on SciPy's C/Cython cKDTree for its hot path (SURVEY.md
+§2.2 N1).  When ANN is toggled off, the brute-force search runs here: a C++
+OpenMP kernel (``native/match.cpp``, loaded via ctypes) when built, else a
+NumPy fallback.  Build with ``make -C native`` (see native/README.md).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+        "native", "libia_match.so",
+    )
+    try:
+        lib = ctypes.CDLL(path)
+        lib.ia_brute_argmin.restype = None
+        lib.ia_brute_argmin.argtypes = [
+            ctypes.POINTER(ctypes.c_float),  # db (n, f)
+            ctypes.c_int64,  # n
+            ctypes.c_int64,  # f
+            ctypes.POINTER(ctypes.c_float),  # queries (m, f)
+            ctypes.c_int64,  # m
+            ctypes.POINTER(ctypes.c_int64),  # out idx (m,)
+            ctypes.POINTER(ctypes.c_float),  # out dist (m,)
+        ]
+        _LIB = lib
+    except OSError:
+        _LIB = None
+    return _LIB
+
+
+def have_native() -> bool:
+    return _load() is not None
+
+
+def brute_argmin_batch(db: np.ndarray, queries: np.ndarray
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+    """Exact L2 argmin of each query row against the DB.
+
+    Returns (idx (m,) int64, squared_dist (m,) float32); ties -> lowest index.
+    """
+    db = np.ascontiguousarray(db, dtype=np.float32)
+    queries = np.ascontiguousarray(queries, dtype=np.float32)
+    n, f = db.shape
+    m = queries.shape[0]
+    lib = _load()
+    if lib is not None:
+        idx = np.empty(m, dtype=np.int64)
+        dist = np.empty(m, dtype=np.float32)
+        lib.ia_brute_argmin(
+            db.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n, f,
+            queries.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), m,
+            idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            dist.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        )
+        return idx, dist
+    # NumPy fallback: ||a-b||^2 = ||a||^2 - 2ab + ||b||^2, blocked over queries.
+    dbn = (db * db).sum(axis=1)
+    idx = np.empty(m, dtype=np.int64)
+    dist = np.empty(m, dtype=np.float32)
+    step = max(1, int(2e7 // max(n, 1)))
+    for s0 in range(0, m, step):
+        q = queries[s0 : s0 + step]
+        d = dbn[None, :] - 2.0 * (q @ db.T)
+        k = np.argmin(d, axis=1)
+        idx[s0 : s0 + step] = k
+        qn = (q * q).sum(axis=1)
+        dist[s0 : s0 + step] = d[np.arange(len(k)), k] + qn
+    np.maximum(dist, 0.0, out=dist)
+    return idx, dist
+
+
+def brute_argmin(db: np.ndarray, query: np.ndarray) -> Tuple[int, float]:
+    idx, dist = brute_argmin_batch(db, query[None, :])
+    return int(idx[0]), float(dist[0])

@@ -1,0 +1,136 @@
+"""Configuration for the Image Analogies engine.
+
+The flag surface mirrors the reference CLI (SURVEY.md §2 P1, §5.6): paths for
+A/A'/B, kappa, pyramid levels, patch sizes, ANN toggle, mode — plus the
+TPU-framework additions: ``backend`` (the pluggable Matcher seam,
+BASELINE.json:5), match ``strategy``, mesh shape, checkpointing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+
+@dataclass(frozen=True)
+class AnalogyParams:
+    """All knobs of the synthesis engine.
+
+    Semantics follow Hertzmann et al. 2001 (see SURVEY.md §1-§3):
+
+    - ``levels``: Gaussian pyramid depth L.  ``levels=1`` is the supported
+      single-scale degenerate case (texture-by-numbers config, BASELINE.json:7).
+    - ``patch_size``: fine-level window P (odd).  5 classic, 7 for super-res.
+    - ``coarse_patch_size``: window at the next-coarser level (odd), 3 classic.
+    - ``kappa``: coherence weight.  Coherence candidate wins iff
+      ``d_coh <= d_app * (1 + 2**(-level) * kappa)**2`` where ``level`` counts
+      from the finest (0) — squared because distances are squared L2
+      (Hertzmann §3.2 eq. 2; level factor 2^(l-L) with their numbering).
+    - ``gaussian_weights``: Gaussian-weight neighborhood differences
+      (Hertzmann §3.1); both backends share the exact weight vector.
+    - ``remap_luminance``: linearly remap A/A' luminance to B's mean/std
+      (Hertzmann §3.4).  Off for texture-by-numbers.
+    - ``src_weight``: multiplier on the unfiltered-plane (A/B) feature blocks.
+      1.0 for analogies; 0.0 turns the engine into plain texture synthesis
+      (the B plane is ignored; only causal B' windows drive matching).
+    - ``color_mode``: how B' gets color.  ``"yiq_transfer"`` synthesizes Y and
+      carries B's IQ chroma (classic filter mode); ``"source_rgb"`` copies the
+      full RGB of A'[s(q)] via the source map (texture-by-numbers / synthesis).
+    """
+
+    levels: int = 3
+    patch_size: int = 5
+    coarse_patch_size: int = 3
+    kappa: float = 5.0
+    gaussian_weights: bool = True
+    remap_luminance: bool = True
+    src_weight: float = 1.0
+    color_mode: str = "yiq_transfer"  # "yiq_transfer" | "source_rgb"
+
+    # Backend seam (BASELINE.json:5): only build_features()/best_match()
+    # cross it.  "cpu" = NumPy/cKDTree oracle, "tpu" = JAX/Pallas.
+    backend: str = "cpu"  # "cpu" | "tpu"
+
+    # TPU match strategy:
+    #   "exact"   - per-pixel on-device scan, bit-matches the oracle's
+    #               candidate selection (modulo fp associativity).
+    #   "rowwise" - batched approximate search per scan row (rows-above-only
+    #               causal mask) + sequential coherence/kappa resolution; the
+    #               fast path (SURVEY.md §7 hard part 1's sanctioned lever).
+    #   "auto"    - exact while the DB fits comfortably in VMEM, else rowwise.
+    strategy: str = "auto"
+
+    # Use the cKDTree index for the CPU approximate match (the reference's ANN
+    # toggle); False = brute force (native C++ matcher if built, else NumPy).
+    use_ann: bool = True
+
+    # Parallelism (SURVEY.md §5.7-5.8): shard the A/A' patch DB over `db_shards`
+    # mesh devices; video mode shards frames over the `data` axis.
+    db_shards: int = 1
+
+    # Video mode: weight of the temporal-coherence feature term (previous
+    # frame's B' window appended to the feature vector, BASELINE.json:12).
+    temporal_weight: float = 0.0
+
+    # Aux subsystems (SURVEY.md §5)
+    checkpoint_dir: Optional[str] = None  # per-level checkpoints if set
+    resume_from_level: Optional[int] = None  # level index (finest=0) to resume at
+    profile_dir: Optional[str] = None  # jax.profiler trace dir if set
+    log_path: Optional[str] = None  # JSONL structured per-level records
+
+    def __post_init__(self):
+        if self.levels < 1:
+            raise ValueError(f"levels must be >= 1, got {self.levels}")
+        for name in ("patch_size", "coarse_patch_size"):
+            v = getattr(self, name)
+            if v < 1 or v % 2 == 0:
+                raise ValueError(f"{name} must be odd and >= 1, got {v}")
+        if self.kappa < 0:
+            raise ValueError(f"kappa must be >= 0, got {self.kappa}")
+        if self.color_mode not in ("yiq_transfer", "source_rgb"):
+            raise ValueError(f"unknown color_mode {self.color_mode!r}")
+        if self.backend not in ("cpu", "tpu"):
+            raise ValueError(f"unknown backend {self.backend!r}")
+        if self.strategy not in ("exact", "rowwise", "auto"):
+            raise ValueError(f"unknown strategy {self.strategy!r}")
+        if self.db_shards < 1:
+            raise ValueError(f"db_shards must be >= 1, got {self.db_shards}")
+
+    def replace(self, **kw) -> "AnalogyParams":
+        return dataclasses.replace(self, **kw)
+
+    @property
+    def fine_radius(self) -> int:
+        return self.patch_size // 2
+
+    @property
+    def coarse_radius(self) -> int:
+        return self.coarse_patch_size // 2
+
+    def kappa_factor(self, level: int) -> float:
+        """Coherence threshold multiplier at `level` (0 = finest).
+
+        Hertzmann §3.2: 1 + 2^(l-L) * kappa with l counted coarsest->finest;
+        with our finest-first numbering that is 1 + 2^(-level) * kappa.
+        Squared by callers because we compare squared distances.
+        """
+        return 1.0 + (2.0 ** (-level)) * self.kappa
+
+
+# Preset configs matching the five required eval configs (BASELINE.json:7-12).
+PRESETS = {
+    "texture_by_numbers": AnalogyParams(
+        levels=1, patch_size=5, kappa=1.0, remap_luminance=False,
+        color_mode="source_rgb",
+    ),
+    "oil_filter": AnalogyParams(levels=3, patch_size=5, kappa=5.0),
+    "super_resolution": AnalogyParams(levels=2, patch_size=7, kappa=0.5),
+    "npr_1024": AnalogyParams(levels=5, patch_size=5, kappa=5.0),
+    "texture_synthesis": AnalogyParams(
+        levels=3, patch_size=5, kappa=2.0, remap_luminance=False,
+        src_weight=0.0, color_mode="source_rgb",
+    ),
+    "video": AnalogyParams(levels=3, patch_size=5, kappa=5.0,
+                           temporal_weight=1.0),
+}

@@ -1,0 +1,5 @@
+"""Synthesis driver and application modes."""
+
+from image_analogies_tpu.models.analogy import AnalogyResult, create_image_analogy
+
+__all__ = ["AnalogyResult", "create_image_analogy"]

@@ -1,0 +1,167 @@
+"""The synthesis driver (SURVEY.md §1 L5, §3.1): coarse-to-fine over pyramid
+levels, delegating feature building + matching to the pluggable backend.
+
+Per BASELINE.json:5 the coarse-to-fine loop and color plumbing stay host-side;
+only `build_features()` / `best_match()` / the fused `synthesize_level()`
+cross the backend boundary.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from image_analogies_tpu.backends import get_backend
+from image_analogies_tpu.backends.base import LevelJob
+from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.ops import color
+from image_analogies_tpu.ops.features import spec_for_level
+from image_analogies_tpu.ops.pyramid import build_pyramid_np, num_feasible_levels
+from image_analogies_tpu.utils import checkpoint as ckpt
+from image_analogies_tpu.utils import logging as ialog
+
+
+@dataclass
+class AnalogyResult:
+    bp: np.ndarray  # (H,W,3) or (H,W) final B'
+    bp_y: np.ndarray  # (H,W) synthesized filtered plane (luminance)
+    source_map: np.ndarray  # (H,W) int32 flat indices into A (finest level)
+    stats: List[Dict[str, Any]] = field(default_factory=list)
+
+
+def _prep_planes(a, ap, b, params):
+    """Build the src/filt planes per color mode.
+
+    Returns (a_src, b_src, a_filt, ap_rgb, b_yiq) where a_src/b_src are the
+    matching planes ((H,W) or (H,W,C)), a_filt is A' luminance (possibly
+    remapped), ap_rgb is A' as float RGB (for source_rgb reconstruction), and
+    b_yiq is B in YIQ (None when B is grayscale).
+    """
+    a = color.as_float(np.asarray(a))
+    ap = color.as_float(np.asarray(ap))
+    b = color.as_float(np.asarray(b))
+    if a.shape[:2] != ap.shape[:2]:
+        raise ValueError(f"A {a.shape} and A' {ap.shape} must share H,W")
+
+    a_filt = color.luminance(ap)
+    b_yiq = color.rgb2yiq(b) if (b.ndim == 3 and b.shape[-1] == 3) else None
+
+    if params.color_mode == "yiq_transfer":
+        a_src = color.luminance(a)
+        b_src = b_yiq[..., 0] if b_yiq is not None else color.luminance(b)
+        if params.remap_luminance:
+            # ONE affine transform (A's stats -> B's stats) applied to both A
+            # and A' (Hertzmann §3.4); per-plane remapping would cancel any
+            # affine filter A -> A'.
+            a_src, a_filt = color.remap_pair(a_src, a_filt, b_src)
+    else:  # source_rgb: keep label/source channels as-is
+        a_src = a
+        b_src = b
+        a_nc = 1 if a_src.ndim == 2 else a_src.shape[-1]
+        b_nc = 1 if b_src.ndim == 2 else b_src.shape[-1]
+        if a_nc != b_nc:
+            raise ValueError(
+                f"A ({a_nc}ch) and B ({b_nc}ch) must have matching channels")
+        if params.remap_luminance and a_src.ndim == 2:
+            a_src = color.remap_luminance(a_src, b_src)
+    return a_src, b_src, a_filt, ap, b_yiq
+
+
+def create_image_analogy(
+    a: np.ndarray,
+    ap: np.ndarray,
+    b: np.ndarray,
+    params: AnalogyParams = AnalogyParams(),
+    backend=None,
+    a_temporal_pyr: Optional[List[np.ndarray]] = None,
+    b_temporal_pyr: Optional[List[np.ndarray]] = None,
+) -> AnalogyResult:
+    """Synthesize B' such that A : A' :: B : B' (Hertzmann §3 pseudocode).
+
+    `a_temporal_pyr` / `b_temporal_pyr` are optional per-level planes for the
+    video temporal-coherence term (models/video.py passes the previous output
+    frame's pyramid).
+    """
+    if (a_temporal_pyr is None) != (b_temporal_pyr is None):
+        raise ValueError(
+            "a_temporal_pyr and b_temporal_pyr must be given together")
+    backend = backend or get_backend(params)
+    a_src, b_src, a_filt, ap_rgb, b_yiq = _prep_planes(a, ap, b, params)
+
+    min_shape = (min(a_src.shape[0], b_src.shape[0]),
+                 min(a_src.shape[1], b_src.shape[1]))
+    levels = num_feasible_levels(min_shape, params.levels, params.patch_size)
+
+    a_src_pyr = build_pyramid_np(a_src, levels)
+    a_filt_pyr = build_pyramid_np(a_filt, levels)
+    b_src_pyr = build_pyramid_np(b_src, levels)
+    src_channels = 1 if a_src.ndim == 2 else a_src.shape[-1]
+    temporal = a_temporal_pyr is not None
+
+    bp_pyr: List[Optional[np.ndarray]] = [None] * levels
+    s_pyr: List[Optional[np.ndarray]] = [None] * levels
+    stats: List[Dict[str, Any]] = []
+
+    prof = contextlib.nullcontext()
+    if params.profile_dir:
+        import jax
+
+        prof = jax.profiler.trace(params.profile_dir)
+
+    with prof:
+        for level in range(levels - 1, -1, -1):  # coarsest -> finest
+            if (params.checkpoint_dir and params.resume_from_level is not None
+                    and level > params.resume_from_level):
+                loaded = ckpt.load_level(params.checkpoint_dir, level)
+                if loaded is not None:
+                    bp_pyr[level], s_pyr[level] = loaded
+                    ialog.emit({"event": "resume_level", "level": level},
+                               params.log_path)
+                    continue
+            spec = spec_for_level(params, level, levels, src_channels,
+                                  temporal=temporal)
+            job = LevelJob(
+                level=level,
+                spec=spec,
+                kappa_mult=params.kappa_factor(level) ** 2,
+                a_src=a_src_pyr[level],
+                a_filt=a_filt_pyr[level],
+                b_src=b_src_pyr[level],
+                a_src_coarse=(a_src_pyr[level + 1]
+                              if level + 1 < levels else None),
+                a_filt_coarse=(a_filt_pyr[level + 1]
+                               if level + 1 < levels else None),
+                b_src_coarse=(b_src_pyr[level + 1]
+                              if level + 1 < levels else None),
+                b_filt_coarse=(bp_pyr[level + 1]
+                               if level + 1 < levels else None),
+                a_temporal=(a_temporal_pyr[level] if temporal else None),
+                b_temporal=(b_temporal_pyr[level] if temporal else None),
+            )
+            t0 = time.perf_counter()
+            db = backend.build_features(job)
+            bp, s, st = backend.synthesize_level(db, job)
+            st["total_ms"] = (time.perf_counter() - t0) * 1e3
+            bp_pyr[level], s_pyr[level] = bp, s
+            stats.append(st)
+            ialog.emit(st, params.log_path)
+            if params.checkpoint_dir:
+                ckpt.save_level(params.checkpoint_dir, level, bp, s)
+
+    bp_y = bp_pyr[0]
+    s_map = s_pyr[0]
+    if params.color_mode == "source_rgb":
+        ap_flat = ap_rgb.reshape(-1, ap_rgb.shape[-1]) if ap_rgb.ndim == 3 \
+            else ap_rgb.reshape(-1)
+        out = ap_flat[s_map.reshape(-1)].reshape(
+            bp_y.shape + (() if ap_rgb.ndim == 2 else (ap_rgb.shape[-1],)))
+    elif b_yiq is not None:
+        out = color.yiq2rgb(
+            np.stack([bp_y, b_yiq[..., 1], b_yiq[..., 2]], axis=-1))
+    else:
+        out = np.clip(bp_y, 0.0, 1.0)
+    return AnalogyResult(bp=out, bp_y=bp_y, source_map=s_map, stats=stats)

@@ -1,0 +1,1 @@
+"""Array ops: color, pyramid, features, distances, Pallas kernels."""

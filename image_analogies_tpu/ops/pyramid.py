@@ -1,0 +1,119 @@
+"""Gaussian pyramids (SURVEY.md §2 P4 / N2).
+
+The reference builds pyramids with OpenCV/SciPy native blur kernels; the
+TPU-native equivalent is a separable 5-tap binomial stencil expressed as an XLA
+convolution (`lax.conv_general_dilated`) so it tiles onto the VPU/MXU — no
+host round-trips (BASELINE.json:5 "Gaussian-pyramid build ... jax.vmap'd
+stencils").
+
+The NumPy twin is the semantic spec: both paths use the SAME kernel
+([1,4,6,4,1]/16, separable), edge-replicate padding, and even-pixel
+decimation, so backend-equivalence tests can require exact agreement.
+
+Pyramid list convention: index 0 = finest (full resolution), index L-1 =
+coarsest.  Synthesis iterates coarsest -> finest.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# 5-tap binomial approximation of a Gaussian, the classic pyrDown kernel.
+KERNEL_1D = np.array([1.0, 4.0, 6.0, 4.0, 1.0], dtype=np.float32) / 16.0
+
+
+def min_level_size(patch_size: int) -> int:
+    """Smallest usable level edge: at least one full patch."""
+    return max(patch_size, 4)
+
+
+def num_feasible_levels(shape, levels: int, patch_size: int) -> int:
+    """Clamp requested depth so the coarsest level stays >= one patch."""
+    h, w = shape[:2]
+    n = 1
+    while (
+        n < levels
+        and (h + 1) // 2 >= min_level_size(patch_size)
+        and (w + 1) // 2 >= min_level_size(patch_size)
+    ):
+        h, w = (h + 1) // 2, (w + 1) // 2
+        n += 1
+    return n
+
+
+# ---------------------------------------------------------------- NumPy twin
+
+
+def blur_np(img: np.ndarray) -> np.ndarray:
+    """Separable [1,4,6,4,1]/16 blur with edge-replicate padding, (H,W[,C])."""
+    k = KERNEL_1D
+    pad = [(2, 2), (0, 0)] + ([(0, 0)] if img.ndim == 3 else [])
+    x = np.pad(img, pad, mode="edge")
+    x = sum(k[i] * x[i : i + img.shape[0]] for i in range(5))
+    pad = [(0, 0), (2, 2)] + ([(0, 0)] if img.ndim == 3 else [])
+    x = np.pad(x, pad, mode="edge")
+    x = sum(k[i] * x[:, i : i + img.shape[1]] for i in range(5))
+    return x.astype(np.float32)
+
+
+def downsample_np(img: np.ndarray) -> np.ndarray:
+    return blur_np(img)[::2, ::2]
+
+
+def build_pyramid_np(img: np.ndarray, levels: int) -> List[np.ndarray]:
+    """[finest, ..., coarsest], length `levels`."""
+    pyr = [np.asarray(img, dtype=np.float32)]
+    for _ in range(levels - 1):
+        pyr.append(downsample_np(pyr[-1]))
+    return pyr
+
+
+# ------------------------------------------------------------------ JAX twin
+
+
+@jax.jit
+def blur_jax(img: jax.Array) -> jax.Array:
+    """Same stencil as `blur_np`, as an XLA conv on the device.
+
+    Accepts (H,W) or (H,W,C); channels are independent (feature-grouped conv).
+    """
+    squeeze = img.ndim == 2
+    if squeeze:
+        img = img[..., None]
+    h, w, c = img.shape
+    x = jnp.pad(img, ((2, 2), (2, 2), (0, 0)), mode="edge")
+    x = x.transpose(2, 0, 1)[None]  # NCHW
+    k = jnp.asarray(KERNEL_1D)
+    kern2d = jnp.outer(k, k)[None, None]  # (1,1,5,5)
+    kern = jnp.tile(kern2d, (c, 1, 1, 1))  # (C,1,5,5) depthwise
+    y = jax.lax.conv_general_dilated(
+        x, kern, window_strides=(1, 1), padding="VALID",
+        feature_group_count=c,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        # fp32 accumulate: default precision is reduced on TPU and breaks
+        # bitwise-level parity with the NumPy twin (SURVEY.md §7 hard part 2).
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    y = y[0].transpose(1, 2, 0)
+    return y[..., 0] if squeeze else y
+
+
+def downsample_jax(img: jax.Array) -> jax.Array:
+    return blur_jax(img)[::2, ::2]
+
+
+def build_pyramid_jax(img: jax.Array, levels: int) -> List[jax.Array]:
+    """[finest, ..., coarsest], length `levels`.
+
+    Shapes shrink per level, so this stays a Python-level list (each level is
+    its own jitted conv; the per-level shapes are static).
+    """
+    pyr = [jnp.asarray(img, dtype=jnp.float32)]
+    for _ in range(levels - 1):
+        pyr.append(downsample_jax(pyr[-1]))
+    return pyr

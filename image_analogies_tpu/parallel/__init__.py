@@ -1,0 +1,1 @@
+"""Device-mesh parallelism: sharded patch-DB argmin, video frame sharding."""

@@ -1,0 +1,1 @@
+"""Host-side utilities: image I/O, checkpointing, logging, SSIM eval."""

@@ -1,0 +1,37 @@
+"""Per-level checkpoint / resume (SURVEY.md §5.4).
+
+All cross-level state of the synthesis is exactly {B' level plane, source map
+s} (Hertzmann §3), so checkpointing one level is one small ``.npz``.  The
+driver saves after each level and, when ``resume_from_level`` is set, reloads
+every already-finished (coarser) level instead of recomputing it.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def level_path(ckpt_dir: str, level: int) -> str:
+    return os.path.join(ckpt_dir, f"level_{level:02d}.npz")
+
+
+def save_level(ckpt_dir: str, level: int, bp: np.ndarray,
+               s: np.ndarray) -> str:
+    os.makedirs(ckpt_dir, exist_ok=True)
+    path = level_path(ckpt_dir, level)
+    tmp = path + ".tmp.npz"
+    np.savez(tmp, level=level, bp=bp, s=s)
+    os.replace(tmp, path)
+    return path
+
+
+def load_level(ckpt_dir: str, level: int
+               ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    path = level_path(ckpt_dir, level)
+    if not os.path.exists(path):
+        return None
+    with np.load(path) as z:
+        return z["bp"].astype(np.float32), z["s"].astype(np.int32)

@@ -1,0 +1,26 @@
+"""Structured observability (SURVEY.md §5.5).
+
+Per-level synthesis emits one record: level, db_rows, pixels, coherence pick
+ratio, wall-clock ms, backend — appended as JSON lines when a log path is
+configured and mirrored to the standard `logging` module.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger("image_analogies_tpu")
+
+
+def emit(record: Dict[str, Any], path: Optional[str] = None) -> None:
+    record = dict(record)
+    record.setdefault("ts", time.time())
+    logger.info("%s", json.dumps(record, sort_keys=True))
+    if path:
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "a") as f:
+            f.write(json.dumps(record, sort_keys=True) + "\n")

@@ -1,0 +1,33 @@
+"""Test env: force an 8-device virtual CPU mesh BEFORE jax import
+(SURVEY.md §4.5 — the TPU-world analogue of testing multi-node without a
+cluster).  Sharded-argmin/pmin logic is exercised on this mesh."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)
+
+
+def make_pair(h=20, w=22, seed=0, channels=0):
+    """Synthetic (A, A', B) triple: A' is a deterministic filter of A."""
+    r = np.random.default_rng(seed)
+    yy, xx = np.meshgrid(np.linspace(0, 1, h), np.linspace(0, 1, w),
+                         indexing="ij")
+    a = (0.6 * yy + 0.4 * xx + 0.08 * r.standard_normal((h, w))).clip(0, 1)
+    ap = np.round(a * 5) / 5.0
+    b = (0.3 * yy**2 + 0.7 * xx + 0.08 * r.standard_normal((h, w))).clip(0, 1)
+    if channels:
+        a = np.stack([a] * channels, -1) * r.uniform(0.5, 1.0, channels)
+        b = np.stack([b] * channels, -1) * r.uniform(0.5, 1.0, channels)
+    return a.astype(np.float32), ap.astype(np.float32), b.astype(np.float32)

@@ -1,0 +1,62 @@
+"""Color ops (SURVEY.md §4.2: YIQ round-trip, luminance remap statistics)."""
+
+import numpy as np
+
+from image_analogies_tpu.ops import color
+
+
+def test_yiq_roundtrip(rng):
+    rgb = rng.uniform(0, 1, (16, 17, 3)).astype(np.float32)
+    back = color.yiq2rgb(color.rgb2yiq(rgb))
+    np.testing.assert_allclose(back, rgb, atol=1e-5)
+
+
+def test_luminance_of_gray_is_identity(rng):
+    g = rng.uniform(0, 1, (8, 9)).astype(np.float32)
+    np.testing.assert_allclose(color.luminance(g), g)
+
+
+def test_luminance_weights():
+    # Pure white -> Y == 1; pure green has the largest Y coefficient.
+    white = np.ones((2, 2, 3), np.float32)
+    np.testing.assert_allclose(color.luminance(white), 1.0, atol=1e-6)
+    chans = [color.luminance(np.eye(3, dtype=np.float32)[None, c][None])
+             for c in range(3)]
+    ys = [float(c[0, 0]) for c in chans]
+    assert ys[1] > ys[0] > ys[2]  # G > R > B
+
+
+def test_remap_luminance_matches_stats(rng):
+    ya = rng.uniform(0, 1, (32, 32)).astype(np.float32)
+    yb = (rng.uniform(0, 1, (24, 40)) * 0.5 + 0.3).astype(np.float32)
+    out = color.remap_luminance(ya, yb)
+    assert abs(out.mean() - yb.mean()) < 1e-4
+    assert abs(out.std() - yb.std()) < 1e-4
+
+
+def test_remap_constant_source(rng):
+    ya = np.full((8, 8), 0.4, np.float32)
+    yb = rng.uniform(0, 1, (8, 8)).astype(np.float32)
+    out = color.remap_luminance(ya, yb)
+    np.testing.assert_allclose(out, yb.mean(), atol=1e-6)
+
+
+def test_remap_pair_single_transform(rng):
+    """A and A' must get the SAME affine transform — remapping each to B
+    independently would exactly cancel an affine filter A -> A'."""
+    ya = rng.uniform(0, 1, (16, 16)).astype(np.float32)
+    yap = (0.5 * ya + 0.2).astype(np.float32)  # affine "filter"
+    yb = (rng.uniform(0, 1, (16, 16)) * 0.7 + 0.1).astype(np.float32)
+    ra, rap = color.remap_pair(ya, yap, yb)
+    # A's stats now match B's...
+    assert abs(ra.mean() - yb.mean()) < 1e-4
+    # ...and the filter relationship survives: rap = 0.5*ra + const
+    diff = rap - 0.5 * ra
+    assert diff.std() < 1e-5
+    # the filter is NOT cancelled: remapped planes still differ
+    assert np.abs(ra - rap).max() > 1e-3
+
+
+def test_as_float_uint8():
+    u = np.array([[0, 255]], np.uint8)
+    np.testing.assert_allclose(color.as_float(u), [[0.0, 1.0]])

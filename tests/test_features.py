@@ -1,0 +1,161 @@
+"""Feature spec: layout, causal mask, edge clamping, weights, JAX twin
+(SURVEY.md §4.2-4.3)."""
+
+import numpy as np
+import pytest
+
+from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.ops import features as F
+
+
+def _spec(**kw):
+    kw.setdefault("fine_size", 5)
+    kw.setdefault("coarse_size", 3)
+    kw.setdefault("has_coarse", True)
+    kw.setdefault("src_channels", 1)
+    return F.FeatureSpec(**kw)
+
+
+def test_causal_mask_is_strict_raster_half():
+    m = F.causal_mask(3).reshape(3, 3)
+    expect = np.array([[1, 1, 1], [1, 0, 0], [0, 0, 0]], np.float32)
+    np.testing.assert_array_equal(m, expect)
+
+
+def test_window_offsets_row_major():
+    off = F.window_offsets(3)
+    assert off.tolist()[:4] == [[-1, -1], [-1, 0], [-1, 1], [0, -1]]
+    assert off.tolist()[4] == [0, 0]
+
+
+def test_gaussian_window_normalized_and_peaked():
+    w = F.gaussian_window(5)
+    assert abs(w.sum() - 1.0) < 1e-6
+    assert w.argmax() == 12  # center of the 5x5 window
+
+
+def test_feature_layout_sizes():
+    spec = _spec()
+    assert spec.block_sizes == [25, 25, 9, 9, 0]
+    assert spec.total == 68  # SURVEY.md §3.2: F = 25+25+9+9
+    single = _spec(has_coarse=False)
+    assert single.total == 50
+    rgb = _spec(src_channels=3)
+    assert rgb.total == 75 + 25 + 27 + 9
+
+
+def test_extract_patches_edge_clamp():
+    img = np.arange(6, dtype=np.float32).reshape(2, 3)
+    p = F.extract_patches_np(img, 3)
+    # pixel (0,0): neighbors clamp to row/col 0
+    win = p[0].reshape(3, 3)
+    np.testing.assert_array_equal(win, [[0, 0, 1], [0, 0, 1], [3, 3, 4]])
+    # center offset equals the pixel itself everywhere
+    np.testing.assert_array_equal(p[:, 4], img.reshape(-1))
+
+
+def test_db_fine_filt_is_causally_masked(rng):
+    spec = _spec(has_coarse=False, gaussian=False)
+    src = rng.uniform(0, 1, (7, 7)).astype(np.float32)
+    filt = rng.uniform(0, 1, (7, 7)).astype(np.float32)
+    feats = F.build_features_np(spec, src, filt, None, None)
+    blk = feats[:, spec.fine_filt_slice]
+    m = F.causal_mask(5)
+    # masked-out columns all zero, kept columns match raw gathers * weight
+    assert np.all(blk[:, m == 0] == 0)
+    w = spec.sqrt_weights()[spec.fine_filt_slice]
+    raw = F.extract_patches_np(filt, 5)
+    np.testing.assert_allclose(blk[:, m > 0], (raw * w)[:, m > 0], atol=1e-6)
+
+
+def test_query_static_has_zero_fine_filt(rng):
+    spec = _spec(has_coarse=False)
+    src = rng.uniform(0, 1, (6, 6)).astype(np.float32)
+    feats = F.build_features_np(spec, src, None, None, None)
+    assert np.all(feats[:, spec.fine_filt_slice] == 0)
+
+
+def test_coarse_indexing(rng):
+    spec = _spec(gaussian=False)
+    src = rng.uniform(0, 1, (8, 8)).astype(np.float32)
+    filt = rng.uniform(0, 1, (8, 8)).astype(np.float32)
+    srcc = rng.uniform(0, 1, (4, 4)).astype(np.float32)
+    filtc = rng.uniform(0, 1, (4, 4)).astype(np.float32)
+    feats = F.build_features_np(spec, src, filt, srcc, filtc)
+    sl = spec.slices()
+    # coarse_src block of fine pixel (5,3) = 3x3 window of coarse at (2,1)
+    q = 5 * 8 + 3
+    w = spec.sqrt_weights()[sl[2]]
+    expect = F.extract_patches_np(srcc, 3)[2 * 4 + 1] * w
+    np.testing.assert_allclose(feats[q, sl[2]], expect, atol=1e-6)
+
+
+def test_src_weight_zero_kills_src_blocks(rng):
+    spec = _spec(src_weight=0.0)
+    src = rng.uniform(0, 1, (8, 8)).astype(np.float32)
+    filt = rng.uniform(0, 1, (8, 8)).astype(np.float32)
+    srcc = rng.uniform(0, 1, (4, 4)).astype(np.float32)
+    filtc = rng.uniform(0, 1, (4, 4)).astype(np.float32)
+    feats = F.build_features_np(spec, src, filt, srcc, filtc)
+    sl = spec.slices()
+    assert np.all(feats[:, sl[0]] == 0) and np.all(feats[:, sl[2]] == 0)
+    assert np.any(feats[:, sl[1]] != 0) and np.any(feats[:, sl[3]] != 0)
+
+
+def test_temporal_block(rng):
+    spec = _spec(has_coarse=False, temporal_weight=0.5, gaussian=False)
+    assert spec.block_sizes[4] == 25
+    src = rng.uniform(0, 1, (6, 6)).astype(np.float32)
+    tp = rng.uniform(0, 1, (6, 6)).astype(np.float32)
+    feats = F.build_features_np(spec, src, None, None, None, temporal_fine=tp)
+    sl = spec.slices()
+    w = spec.sqrt_weights()[sl[4]]
+    np.testing.assert_allclose(
+        feats[:, sl[4]], F.extract_patches_np(tp, 5) * w, atol=1e-6)
+    # temporal weight scales the block: w = sqrt(0.5 * uniform)
+    np.testing.assert_allclose(w, np.sqrt(0.5 / 25.0), atol=1e-6)
+
+
+def test_jax_twin_matches_numpy(rng):
+    for cs, has_coarse in [(1, True), (3, True), (1, False)]:
+        spec = _spec(src_channels=cs, has_coarse=has_coarse)
+        shape = (9, 10) if cs == 1 else (9, 10, cs)
+        src = rng.uniform(0, 1, shape).astype(np.float32)
+        filt = rng.uniform(0, 1, (9, 10)).astype(np.float32)
+        cshape = (5, 5) if cs == 1 else (5, 5, cs)
+        srcc = rng.uniform(0, 1, cshape).astype(np.float32) if has_coarse else None
+        filtc = rng.uniform(0, 1, (5, 5)).astype(np.float32) if has_coarse else None
+        ref = F.build_features_np(spec, src, filt, srcc, filtc)
+        got = np.asarray(F.build_features_jax(spec, src, filt, srcc, filtc))
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+def test_fine_gather_maps_validity():
+    flat, valid, written = F.fine_gather_maps(4, 5, 3)
+    # pixel (0,0): nothing synthesized before it
+    assert valid[0].sum() == 0
+    # pixel (0,1): only the left neighbor is causal AND in-bounds
+    assert valid[1].sum() == 1
+    # interior pixel: full causal half = 4 of 9
+    q = 2 * 5 + 2
+    assert valid[q].sum() == 4
+    # clipped indices stay in range
+    assert flat.min() >= 0 and flat.max() < 20
+    # written: no query ever reads an index >= itself
+    qcol = np.arange(20).reshape(-1, 1)
+    assert np.all(flat[written > 0].reshape(-1)
+                  < np.broadcast_to(qcol, flat.shape)[written > 0])
+    # interior pixels: written == valid == causal half
+    np.testing.assert_array_equal(written[q], valid[q])
+    # border pixel (1,0): offset (0,-1) clamps to itself -> not written,
+    # but offsets in row 0 clamp to written pixels -> kept
+    qb = 1 * 5 + 0
+    assert written[qb].sum() > 0
+    assert written[qb].sum() >= valid[qb].sum()
+
+
+def test_spec_for_level():
+    p = AnalogyParams(levels=3, patch_size=5, coarse_patch_size=3)
+    s0 = F.spec_for_level(p, 0, 3, 1)
+    s2 = F.spec_for_level(p, 2, 3, 1)
+    assert s0.has_coarse and not s2.has_coarse

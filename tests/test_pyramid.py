@@ -1,0 +1,47 @@
+"""Pyramid: shapes, kernel weights, NumPy/JAX twin agreement (SURVEY.md §4.2-4.3)."""
+
+import numpy as np
+
+from image_analogies_tpu.ops import pyramid
+
+
+def test_shapes_odd_even():
+    img = np.zeros((21, 34), np.float32)
+    pyr = pyramid.build_pyramid_np(img, 3)
+    assert [p.shape for p in pyr] == [(21, 34), (11, 17), (6, 9)]
+
+
+def test_blur_preserves_constant():
+    img = np.full((10, 12), 0.7, np.float32)
+    np.testing.assert_allclose(pyramid.blur_np(img), 0.7, atol=1e-6)
+
+
+def test_blur_kernel_weights():
+    # Impulse response at the center of a large image = outer([1,4,6,4,1])/256.
+    img = np.zeros((11, 11), np.float32)
+    img[5, 5] = 1.0
+    out = pyramid.blur_np(img)
+    k = np.array([1, 4, 6, 4, 1], np.float32) / 16.0
+    expect = np.outer(k, k)
+    np.testing.assert_allclose(out[3:8, 3:8], expect, atol=1e-6)
+    assert out[:3].sum() == 0 and out[8:].sum() == 0
+
+
+def test_jax_matches_numpy(rng):
+    img = rng.uniform(0, 1, (17, 23)).astype(np.float32)
+    for np_lvl, jx_lvl in zip(pyramid.build_pyramid_np(img, 3),
+                              pyramid.build_pyramid_jax(img, 3)):
+        np.testing.assert_allclose(np.asarray(jx_lvl), np_lvl, atol=1e-6)
+
+
+def test_jax_matches_numpy_multichannel(rng):
+    img = rng.uniform(0, 1, (12, 14, 3)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(pyramid.blur_jax(img)), pyramid.blur_np(img), atol=1e-6)
+
+
+def test_num_feasible_levels():
+    assert pyramid.num_feasible_levels((256, 256), 5, 5) == 5
+    assert pyramid.num_feasible_levels((8, 8), 5, 5) == 1
+    assert pyramid.num_feasible_levels((16, 16), 5, 5) == 2
+    assert pyramid.num_feasible_levels((256, 256), 1, 5) == 1
