@@ -1,0 +1,315 @@
+"""TPU backend: JAX/XLA on-device synthesis (BASELINE.json:5 north star).
+
+Design (SURVEY.md §7 steps 4-6):
+
+- Feature building is the JAX twin of the shared spec (`build_features_jax`),
+  one fused XLA program per level — no host round-trips.
+- The within-level raster scan runs ON DEVICE as a single jitted
+  `lax.fori_loop` carrying (B' plane, source map): 10^6 host dispatches at
+  ~100us each would cost >100s alone (SURVEY.md §7 step 5), so only the
+  coarse-to-fine level loop stays in Python.
+- Strategy "exact": every pixel does brute-force approximate search over the
+  full DB via the matmul trick ||a-q||^2 = ||a||^2 - 2 a.q + ||q||^2 (MXU),
+  plus the Ashikhmin coherence candidates and the kappa blend — semantically
+  identical to the CPU oracle's per-pixel decision.
+- Strategy "rowwise": batched approximate search for a whole scan row using a
+  rows-above-only causal mask (one (W,F)x(F,N) MXU matmul / Pallas fused
+  argmin per row), then a sequential within-row pass that computes the EXACT
+  query features for the kappa/coherence resolution.  This is the sanctioned
+  fast path of SURVEY.md §7 hard part 1; candidate selection is approximate,
+  the final decision is exact, parity is validated by SSIM.
+
+The sharded-DB variant (patch DB over the ICI mesh, `lax.pmin`+index
+all-reduce) lives in `parallel/sharded_match.py` and slots into the rowwise
+strategy's approximate search.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from image_analogies_tpu.backends.base import LevelJob, Matcher
+from image_analogies_tpu.ops.features import (
+    build_features_jax,
+    causal_mask,
+    fine_gather_maps,
+    window_offsets,
+)
+
+_F32 = jnp.float32
+_HIGHEST = jax.lax.Precision.HIGHEST
+
+# "auto" strategy: exact per-pixel scan while the DB (fp32) stays within this
+# budget (it then lives happily in VMEM ~ 16-128 MB); rowwise beyond.
+_AUTO_EXACT_MAX_DB_BYTES = 8 * 1024 * 1024
+
+
+@dataclass
+class TpuLevelDB:
+    """Device-resident per-level state."""
+
+    db: jax.Array  # (Na, F)
+    db_sqnorm: jax.Array  # (Na,)
+    static_q: jax.Array  # (Nb, F) fine_filt block zero
+    static_q_row: jax.Array  # (Nb, F) rows-above-only causal variant
+    flat_idx: jax.Array  # (Nb, nf) int32
+    valid: jax.Array  # (Nb, nf) f32
+    written: jax.Array  # (Nb, nf) f32
+    rowsafe: jax.Array  # (nf,) f32: causal offsets with di < 0 only
+    a_filt_flat: jax.Array  # (Na,)
+    fine_sqrtw: jax.Array  # (nf,)
+    off: jax.Array  # (nf, 2) int32 window offsets
+    ha: int
+    wa: int
+    hb: int
+    wb: int
+    fine_start: int  # start of fine_filt block in the feature vector
+    strategy: str
+
+
+class TpuMatcher(Matcher):
+    """JAX/XLA matcher.  Runs on TPU when one is attached; the same program
+    compiles on the CPU backend for the virtual-mesh tests."""
+
+    def build_features(self, job: LevelJob) -> TpuLevelDB:
+        spec = job.spec
+        to_j = lambda x: None if x is None else jnp.asarray(x, _F32)
+        db = build_features_jax(
+            spec, to_j(job.a_src), to_j(job.a_filt), to_j(job.a_src_coarse),
+            to_j(job.a_filt_coarse), temporal_fine=to_j(job.a_temporal))
+        static_q = build_features_jax(
+            spec, to_j(job.b_src), None, to_j(job.b_src_coarse),
+            to_j(job.b_filt_coarse), temporal_fine=to_j(job.b_temporal))
+        hb, wb = job.b_shape
+        ha, wa = job.a_shape
+        flat_idx, valid, written = fine_gather_maps(hb, wb, spec.fine_size)
+        off = window_offsets(spec.fine_size)
+        # rows-above-only mask: the subset of the causal window that is known
+        # at the START of a scan row (di < 0) — used by the rowwise batched
+        # approximate search.
+        rowsafe = ((off[:, 0] < 0).astype(np.float32)
+                   * causal_mask(spec.fine_size))
+
+        n_db = int(db.shape[0]) * int(db.shape[1]) * 4
+        strategy = self.params.strategy
+        if strategy == "auto":
+            strategy = "exact" if n_db <= _AUTO_EXACT_MAX_DB_BYTES else "rowwise"
+
+        return TpuLevelDB(
+            db=db,
+            db_sqnorm=jnp.sum(db * db, axis=1),
+            static_q=static_q,
+            static_q_row=static_q,  # fine_filt block is zero in both
+            flat_idx=jnp.asarray(flat_idx),
+            valid=jnp.asarray(valid),
+            written=jnp.asarray(written),
+            rowsafe=jnp.asarray(rowsafe),
+            a_filt_flat=jnp.asarray(job.a_filt, _F32).reshape(-1),
+            fine_sqrtw=jnp.asarray(spec.sqrt_weights()[spec.fine_filt_slice]),
+            off=jnp.asarray(off),
+            ha=ha,
+            wa=wa,
+            hb=hb,
+            wb=wb,
+            fine_start=spec.fine_filt_slice.start,
+            strategy=strategy,
+        )
+
+    # ------------------------------------------------------------ exact scan
+
+    def _exact_level_fn(self, db: TpuLevelDB, kappa_mult: float):
+        """Jitted whole-level scan, one fori_loop iteration per pixel."""
+        nf = int(db.off.shape[0])
+        nb = db.hb * db.wb
+        fine_start = db.fine_start
+
+        def qvec_at(q, bp):
+            idxq = db.flat_idx[q]  # (nf,)
+            dyn = bp[idxq] * db.written[q] * db.fine_sqrtw
+            base = db.static_q[q]
+            return jax.lax.dynamic_update_slice(base, dyn, (fine_start,))
+
+        def coherence(qvec, q, s):
+            s_r = s[db.flat_idx[q]]  # (nf,)
+            ci = s_r // db.wa - db.off[:, 0]
+            cj = s_r % db.wa - db.off[:, 1]
+            inb = ((ci >= 0) & (ci < db.ha) & (cj >= 0) & (cj < db.wa)
+                   & (db.valid[q] > 0))
+            cand = (jnp.clip(ci, 0, db.ha - 1) * db.wa
+                    + jnp.clip(cj, 0, db.wa - 1))
+            cf = db.db[cand]  # (nf, F) gather
+            dc = jnp.sum((cf - qvec[None, :]) ** 2, axis=1)
+            dc = jnp.where(inb, dc, jnp.inf)
+            k = jnp.argmin(dc)
+            return cand[k], dc[k], inb.any()
+
+        def body(q, state):
+            bp, s, n_coh = state
+            qvec = qvec_at(q, bp)
+            scores = db.db_sqnorm - 2.0 * jnp.dot(
+                db.db, qvec, preferred_element_type=_F32,
+                precision=_HIGHEST)
+            p_app = jnp.argmin(scores)
+            qn = jnp.dot(qvec, qvec, preferred_element_type=_F32,
+                         precision=_HIGHEST)
+            d_app = jnp.maximum(scores[p_app] + qn, 0.0)
+            p_coh, d_coh, has_coh = coherence(qvec, q, s)
+            use_coh = has_coh & (d_coh <= d_app * kappa_mult)
+            p = jnp.where(use_coh, p_coh, p_app).astype(jnp.int32)
+            bp = bp.at[q].set(db.a_filt_flat[p])
+            s = s.at[q].set(p)
+            return bp, s, n_coh + use_coh.astype(jnp.int32)
+
+        def run():
+            bp0 = jnp.zeros((nb,), _F32)
+            s0 = jnp.zeros((nb,), jnp.int32)
+            return jax.lax.fori_loop(0, nb, body, (bp0, s0, jnp.int32(0)))
+
+        return jax.jit(run)
+
+    # ------------------------------------------------------- rowwise scan
+
+    def _rowwise_level_fn(self, db: TpuLevelDB, kappa_mult: float,
+                          approx_fn=None):
+        """Batched approximate search per scan row + sequential resolution.
+
+        approx_fn(queries (W,F)) -> (idx (W,), sqdist (W,)) may be overridden
+        (the Pallas kernel / sharded variant plug in here); default is the
+        XLA matmul + argmin.
+        """
+        nf = int(db.off.shape[0])
+        wb, hb = db.wb, db.hb
+        fine_start = db.fine_start
+
+        if approx_fn is None:
+            def approx_fn(queries):
+                scores = (db.db_sqnorm[None, :] - 2.0 * jnp.dot(
+                    queries, db.db.T, preferred_element_type=_F32,
+                    precision=_HIGHEST))
+                idx = jnp.argmin(scores, axis=1)
+                qn = jnp.sum(queries * queries, axis=1)
+                d = jnp.take_along_axis(scores, idx[:, None], axis=1)[:, 0]
+                return idx.astype(jnp.int32), jnp.maximum(d + qn, 0.0)
+
+        def row_queries(r, bp):
+            """Query features for all pixels of row r using the rows-above
+            causal subset (exact at row start)."""
+            q0 = r * wb
+            idx = jax.lax.dynamic_slice(db.flat_idx, (q0, 0), (wb, nf))
+            wr = jax.lax.dynamic_slice(db.written, (q0, 0), (wb, nf))
+            dyn = bp[idx] * wr * db.rowsafe[None, :] * db.fine_sqrtw[None, :]
+            base = jax.lax.dynamic_slice(
+                db.static_q, (q0, 0), (wb, db.static_q.shape[1]))
+            return jax.lax.dynamic_update_slice(base, dyn, (0, fine_start))
+
+        def exact_qvec(q, bp):
+            idxq = db.flat_idx[q]
+            dyn = bp[idxq] * db.written[q] * db.fine_sqrtw
+            return jax.lax.dynamic_update_slice(
+                db.static_q[q], dyn, (fine_start,))
+
+        def coherence(qvec, q, s):
+            s_r = s[db.flat_idx[q]]
+            ci = s_r // db.wa - db.off[:, 0]
+            cj = s_r % db.wa - db.off[:, 1]
+            inb = ((ci >= 0) & (ci < db.ha) & (cj >= 0) & (cj < db.wa)
+                   & (db.valid[q] > 0))
+            cand = (jnp.clip(ci, 0, db.ha - 1) * db.wa
+                    + jnp.clip(cj, 0, db.wa - 1))
+            cf = db.db[cand]
+            dc = jnp.sum((cf - qvec[None, :]) ** 2, axis=1)
+            dc = jnp.where(inb, dc, jnp.inf)
+            k = jnp.argmin(dc)
+            return cand[k], dc[k], inb.any()
+
+        def pixel_body(j, carry):
+            bp, s, n_coh, r, p_apps = carry
+            q = r * wb + j
+            qvec = exact_qvec(q, bp)
+            p_app = p_apps[j]
+            # exact d_app for the kappa test (candidate from the batched pass)
+            d_app = jnp.sum((db.db[p_app] - qvec) ** 2)
+            p_coh, d_coh, has_coh = coherence(qvec, q, s)
+            use_coh = has_coh & (d_coh <= d_app * kappa_mult)
+            p = jnp.where(use_coh, p_coh, p_app).astype(jnp.int32)
+            bp = bp.at[q].set(db.a_filt_flat[p])
+            s = s.at[q].set(p)
+            return bp, s, n_coh + use_coh.astype(jnp.int32), r, p_apps
+
+        def row_body(r, state):
+            bp, s, n_coh = state
+            queries = row_queries(r, bp)
+            p_apps, _ = approx_fn(queries)
+            bp, s, n_coh, _, _ = jax.lax.fori_loop(
+                0, wb, pixel_body, (bp, s, n_coh, r, p_apps))
+            return bp, s, n_coh
+
+        def run():
+            bp0 = jnp.zeros((hb * wb,), _F32)
+            s0 = jnp.zeros((hb * wb,), jnp.int32)
+            return jax.lax.fori_loop(0, hb, row_body,
+                                     (bp0, s0, jnp.int32(0)))
+
+        return jax.jit(run)
+
+    # ------------------------------------------------------------- protocol
+
+    def best_match(self, db: TpuLevelDB, job: LevelJob, q: int,
+                   bp_flat: np.ndarray, s_flat: np.ndarray
+                   ) -> Tuple[int, float, bool]:
+        """Single-pixel reference path (unit-test seam, not the fast path)."""
+        bp = jnp.asarray(bp_flat, _F32)
+        s = jnp.asarray(s_flat, jnp.int32)
+        dyn = bp[db.flat_idx[q]] * db.written[q] * db.fine_sqrtw
+        qvec = db.static_q[q].at[
+            db.fine_start : db.fine_start + dyn.shape[0]].set(dyn)
+        scores = db.db_sqnorm - 2.0 * jnp.dot(
+            db.db, qvec, preferred_element_type=_F32, precision=_HIGHEST)
+        p_app = int(jnp.argmin(scores))
+        d_app = max(float(scores[p_app] + jnp.dot(qvec, qvec)), 0.0)
+        # coherence
+        s_r = np.asarray(s)[np.asarray(db.flat_idx[q])]
+        off = np.asarray(db.off)
+        ci = s_r // db.wa - off[:, 0]
+        cj = s_r % db.wa - off[:, 1]
+        inb = ((ci >= 0) & (ci < db.ha) & (cj >= 0) & (cj < db.wa)
+               & (np.asarray(db.valid[q]) > 0))
+        if inb.any():
+            cand = (ci[inb] * db.wa + cj[inb]).astype(np.int64)
+            dmat = np.asarray(db.db)[cand] - np.asarray(qvec)[None, :]
+            dc = (dmat * dmat).sum(axis=1)
+            k = int(np.argmin(dc))
+            if float(dc[k]) <= d_app * job.kappa_mult:
+                return int(cand[k]), float(dc[k]), True
+        return p_app, d_app, False
+
+    def synthesize_level(self, db: TpuLevelDB, job: LevelJob
+                         ) -> Tuple[np.ndarray, np.ndarray, Dict[str, Any]]:
+        t0 = time.perf_counter()
+        if db.strategy == "exact":
+            fn = self._exact_level_fn(db, job.kappa_mult)
+        else:
+            fn = self._rowwise_level_fn(db, job.kappa_mult)
+        bp, s, n_coh = fn()
+        bp, s = jax.block_until_ready((bp, s))
+        dt = time.perf_counter() - t0
+        hb, wb = job.b_shape
+        stats = {
+            "level": job.level,
+            "db_rows": int(db.db.shape[0]),
+            "pixels": hb * wb,
+            "coherence_ratio": float(n_coh) / max(hb * wb, 1),
+            "ms": dt * 1e3,
+            "backend": "tpu",
+            "strategy": db.strategy,
+        }
+        return (np.asarray(bp, np.float32).reshape(hb, wb),
+                np.asarray(s, np.int32).reshape(hb, wb), stats)
