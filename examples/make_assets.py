@@ -1,0 +1,131 @@
+"""Generate synthetic example assets (SURVEY.md §2 P10).
+
+The reference ships sample A/A'/B triples (oil-paint filter, textures, label
+maps, blur/sharp pairs).  This box has no network, so we synthesize
+procedurally-generated equivalents covering every BASELINE.json config:
+
+    python examples/make_assets.py [--out examples/assets] [--size 256]
+
+Writes:
+    filter_{a,ap,b}.png          oil-paint-ish posterize+smooth filter pair
+    tbn_{labels_a,texture,labels_b}.png   texture-by-numbers triple
+    sr_{sharp,low}.png           super-resolution pair
+    texture.png                  texture-synthesis exemplar
+    video_f{0..3}.png            four B frames with a moving feature
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from image_analogies_tpu.ops.pyramid import blur_np
+from image_analogies_tpu.utils.imageio import save_image
+
+
+def _perlin_ish(h, w, rng, octaves=4):
+    """Multi-octave value noise — a cheap natural-image stand-in."""
+    out = np.zeros((h, w), np.float64)
+    for o in range(octaves):
+        step = max(2, min(h, w) >> (o + 1))
+        gh, gw = h // step + 2, w // step + 2
+        g = rng.standard_normal((gh, gw))
+        ii = np.arange(h) / step
+        jj = np.arange(w) / step
+        i0 = ii.astype(int)
+        j0 = jj.astype(int)
+        fi = (ii - i0)[:, None]
+        fj = (jj - j0)[None, :]
+        v = (g[i0][:, j0] * (1 - fi) * (1 - fj)
+             + g[i0 + 1][:, j0] * fi * (1 - fj)
+             + g[i0][:, j0 + 1] * (1 - fi) * fj
+             + g[i0 + 1][:, j0 + 1] * fi * fj)
+        out += v * (0.6 ** o)
+    out -= out.min()
+    return (out / max(out.max(), 1e-9)).astype(np.float32)
+
+
+def _oil_filter(img):
+    """The 'A -> A'' training filter: smoothing + posterization (an
+    oil-paint look, same family as the reference's example filters)."""
+    x = blur_np(blur_np(img))
+    return (np.round(x * 6) / 6.0).astype(np.float32)
+
+
+def _texture(h, w, rng, kind):
+    if kind == "stripes":
+        base = 0.5 + 0.35 * np.sin(
+            np.arange(w)[None, :] * 0.55 + 3.0 * _perlin_ish(h, w, rng, 2))
+    elif kind == "spots":
+        base = _perlin_ish(h, w, rng, 2)
+        base = (base > 0.55).astype(np.float32) * 0.6 + 0.2
+        base = blur_np(base)
+    else:
+        base = _perlin_ish(h, w, rng)
+    return (base + 0.05 * rng.standard_normal((h, w))).clip(0, 1).astype(
+        np.float32)
+
+
+def make_all(out_dir: str, size: int = 256, seed: int = 0) -> None:
+    os.makedirs(out_dir, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    h = w = size
+
+    # 1. artistic filter pair + target (BASELINE configs 2/4)
+    a = _perlin_ish(h, w, rng)
+    ap = _oil_filter(a)
+    b = _perlin_ish(h, w, rng)
+    save_image(f"{out_dir}/filter_a.png", a)
+    save_image(f"{out_dir}/filter_ap.png", ap)
+    save_image(f"{out_dir}/filter_b.png", b)
+
+    # 2. texture-by-numbers (BASELINE config 1): 2-region label maps
+    lab_a = np.zeros((h, w, 3), np.float32)
+    split = _perlin_ish(h, w, rng, 2) > 0.5
+    lab_a[..., 0] = split
+    lab_a[..., 1] = ~split
+    tex = np.where(split, _texture(h, w, rng, "stripes"),
+                   _texture(h, w, rng, "spots")).astype(np.float32)
+    lab_b = np.zeros((h, w, 3), np.float32)
+    split_b = _perlin_ish(h, w, np.random.default_rng(seed + 7), 2) > 0.45
+    lab_b[..., 0] = split_b
+    lab_b[..., 1] = ~split_b
+    save_image(f"{out_dir}/tbn_labels_a.png", lab_a)
+    save_image(f"{out_dir}/tbn_texture.png", tex)
+    save_image(f"{out_dir}/tbn_labels_b.png", lab_b)
+
+    # 3. super-resolution pair (BASELINE config 3)
+    sharp = _texture(h, w, rng, "stripes")
+    low = blur_np(blur_np(_texture(h, w, np.random.default_rng(seed + 3),
+                                   "stripes")))
+    save_image(f"{out_dir}/sr_sharp.png", sharp)
+    save_image(f"{out_dir}/sr_low.png", low)
+
+    # 4. texture-synthesis exemplar
+    save_image(f"{out_dir}/texture.png", _texture(h, w, rng, "spots"))
+
+    # 5. video frames (BASELINE config 5): drifting blob over noise
+    base = _perlin_ish(h, w, rng)
+    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    for t in range(4):
+        cx = w * (0.3 + 0.1 * t)
+        blob = np.exp(-((yy - h * 0.5) ** 2 + (xx - cx) ** 2)
+                      / (2 * (0.08 * h) ** 2))
+        frame = (0.7 * base + 0.5 * blob).clip(0, 1).astype(np.float32)
+        save_image(f"{out_dir}/video_f{t}.png", frame)
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(__file__), "assets"))
+    ap.add_argument("--size", type=int, default=256)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    make_all(args.out, args.size, args.seed)
+    print(args.out)

@@ -4,32 +4,37 @@ Design (SURVEY.md §7 steps 4-6):
 
 - Feature building is the JAX twin of the shared spec (`build_features_jax`),
   one fused XLA program per level — no host round-trips.
-- The within-level raster scan runs ON DEVICE as a single jitted
-  `lax.fori_loop` carrying (B' plane, source map): 10^6 host dispatches at
-  ~100us each would cost >100s alone (SURVEY.md §7 step 5), so only the
-  coarse-to-fine level loop stays in Python.
-- Strategy "exact": every pixel does brute-force approximate search over the
-  full DB via the matmul trick ||a-q||^2 = ||a||^2 - 2 a.q + ||q||^2 (MXU),
-  plus the Ashikhmin coherence candidates and the kappa blend — semantically
-  identical to the CPU oracle's per-pixel decision.
-- Strategy "rowwise": batched approximate search for a whole scan row using a
-  rows-above-only causal mask (one (W,F)x(F,N) MXU matmul / Pallas fused
-  argmin per row), then a sequential within-row pass that computes the EXACT
-  query features for the kappa/coherence resolution.  This is the sanctioned
-  fast path of SURVEY.md §7 hard part 1; candidate selection is approximate,
-  the final decision is exact, parity is validated by SSIM.
+- The within-level raster scan runs ON DEVICE inside a single jitted
+  `lax.fori_loop` carrying (B' plane, source map): host dispatches cost
+  ~100ms each over the PJRT tunnel, so only the coarse-to-fine level loop
+  stays in Python (SURVEY.md §7 step 5).
+- All scan functions are MODULE-LEVEL jits over a pytree-registered
+  `TpuLevelDB`, so each (shape, strategy) compiles once per process and is
+  reused across levels/calls — per-call closures would retrace every time.
 
-The sharded-DB variant (patch DB over the ICI mesh, `lax.pmin`+index
-all-reduce) lives in `parallel/sharded_match.py` and slots into the rowwise
-strategy's approximate search.
+Strategies (see config.AnalogyParams.strategy):
+
+- "exact": per-pixel sequential scan; brute-force approximate search via the
+  matmul trick on the MXU + Ashikhmin coherence + kappa blend — semantically
+  the CPU oracle's decision, pixel by pixel.  Slow (loop-carried scalar work),
+  kept for parity validation.
+- "rowwise": batched approximate search per scan row + sequential exact
+  coherence/kappa pass.
+- "batched" (default): the causal window is restricted to strictly-above rows
+  for queries, DB masking AND coherence candidates, so a whole scan row
+  resolves in parallel: one fused Pallas distance+argmin (HBM-resident DB,
+  sharded over the mesh 'db' axis when db_shards > 1), one batched coherence
+  gather, then `refine_passes` cheap vectorized passes that restore same-row
+  left-propagation of the source map (the dominant coherence mechanism).
+  SSIM-validated against the oracle (SURVEY.md §7 hard part 1).
 """
 
 from __future__ import annotations
 
+import functools
 import time
-from dataclasses import dataclass
-from functools import partial
-from typing import Any, Dict, Optional, Tuple
+from dataclasses import dataclass, field, fields
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -43,23 +48,27 @@ from image_analogies_tpu.ops.features import (
     fine_gather_maps,
     window_offsets,
 )
+from image_analogies_tpu.ops.pallas_match import argmin_l2
 
 _F32 = jnp.float32
 _HIGHEST = jax.lax.Precision.HIGHEST
 
-# "auto" strategy: exact per-pixel scan while the DB (fp32) stays within this
-# budget (it then lives happily in VMEM ~ 16-128 MB); rowwise beyond.
-_AUTO_EXACT_MAX_DB_BYTES = 8 * 1024 * 1024
+# Left-propagation refinement passes of the batched strategy (each pass lets
+# coherent source-map runs extend `fine_radius` pixels further left-to-right).
+_REFINE_PASSES = 3
 
 
 @dataclass
 class TpuLevelDB:
-    """Device-resident per-level state."""
+    """Device-resident per-level state.  Registered as a JAX pytree: array
+    fields are leaves, layout ints/strategy are static aux data, so jitted
+    scan functions cache on (shapes, layout) across calls."""
 
     db: jax.Array  # (Na, F)
     db_sqnorm: jax.Array  # (Na,)
+    db_rowsafe: jax.Array  # (Na, F) fine_filt block masked to rows-above
+    db_rowsafe_sqnorm: jax.Array  # (Na,)
     static_q: jax.Array  # (Nb, F) fine_filt block zero
-    static_q_row: jax.Array  # (Nb, F) rows-above-only causal variant
     flat_idx: jax.Array  # (Nb, nf) int32
     valid: jax.Array  # (Nb, nf) f32
     written: jax.Array  # (Nb, nf) f32
@@ -67,17 +76,238 @@ class TpuLevelDB:
     a_filt_flat: jax.Array  # (Na,)
     fine_sqrtw: jax.Array  # (nf,)
     off: jax.Array  # (nf, 2) int32 window offsets
-    ha: int
-    wa: int
-    hb: int
-    wb: int
-    fine_start: int  # start of fine_filt block in the feature vector
-    strategy: str
+    db_sharded: Optional[jax.Array]  # (Npad, F) laid out over mesh 'db' axis
+    dbn_sharded: Optional[jax.Array]
+    ha: int = field(metadata=dict(static=True))
+    wa: int = field(metadata=dict(static=True))
+    hb: int = field(metadata=dict(static=True))
+    wb: int = field(metadata=dict(static=True))
+    fine_start: int = field(metadata=dict(static=True))
+    n_rowsafe: int = field(metadata=dict(static=True))
+    strategy: str = field(metadata=dict(static=True))
+    # shard_map'd argmin fn (cached per mesh, so its identity is stable
+    # across levels and does not defeat the jit cache)
+    sharded_argmin: Optional[Callable] = field(
+        default=None, metadata=dict(static=True))
+
+
+jax.tree_util.register_dataclass(
+    TpuLevelDB,
+    data_fields=[f.name for f in fields(TpuLevelDB)
+                 if not f.metadata.get("static")],
+    meta_fields=[f.name for f in fields(TpuLevelDB)
+                 if f.metadata.get("static")],
+)
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_sharded_argmin(mesh, force_xla: bool):
+    from image_analogies_tpu.parallel.sharded_match import make_sharded_argmin
+
+    return make_sharded_argmin(mesh, force_xla=force_xla)
+
+
+# --------------------------------------------------------------- exact scan
+
+
+def _exact_qvec(db: TpuLevelDB, q, bp):
+    dyn = bp[db.flat_idx[q]] * db.written[q] * db.fine_sqrtw
+    return jax.lax.dynamic_update_slice(
+        db.static_q[q], dyn, (db.fine_start,))
+
+
+def _pixel_coherence(db: TpuLevelDB, qvec, q, s):
+    """Ashikhmin candidates for one pixel from the full causal window."""
+    s_r = s[db.flat_idx[q]]
+    ci = s_r // db.wa - db.off[:, 0]
+    cj = s_r % db.wa - db.off[:, 1]
+    inb = ((ci >= 0) & (ci < db.ha) & (cj >= 0) & (cj < db.wa)
+           & (db.valid[q] > 0))
+    cand = (jnp.clip(ci, 0, db.ha - 1) * db.wa
+            + jnp.clip(cj, 0, db.wa - 1))
+    cf = db.db[cand]
+    dc = jnp.sum((cf - qvec[None, :]) ** 2, axis=1)
+    dc = jnp.where(inb, dc, jnp.inf)
+    k = jnp.argmin(dc)
+    return cand[k], dc[k], inb.any()
+
+
+@jax.jit
+def _run_exact(db: TpuLevelDB, kappa_mult):
+    nb = db.hb * db.wb
+
+    def body(q, state):
+        bp, s, n_coh = state
+        qvec = _exact_qvec(db, q, bp)
+        scores = db.db_sqnorm - 2.0 * jnp.dot(
+            db.db, qvec, preferred_element_type=_F32, precision=_HIGHEST)
+        p_app = jnp.argmin(scores)
+        qn = jnp.dot(qvec, qvec, preferred_element_type=_F32,
+                     precision=_HIGHEST)
+        d_app = jnp.maximum(scores[p_app] + qn, 0.0)
+        p_coh, d_coh, has_coh = _pixel_coherence(db, qvec, q, s)
+        use_coh = has_coh & (d_coh <= d_app * kappa_mult)
+        p = jnp.where(use_coh, p_coh, p_app).astype(jnp.int32)
+        bp = bp.at[q].set(db.a_filt_flat[p])
+        s = s.at[q].set(p)
+        return bp, s, n_coh + use_coh.astype(jnp.int32)
+
+    bp0 = jnp.zeros((nb,), _F32)
+    s0 = jnp.zeros((nb,), jnp.int32)
+    return jax.lax.fori_loop(0, nb, body, (bp0, s0, jnp.int32(0)))
+
+
+# -------------------------------------------------------------- rowwise scan
+
+
+def _row_queries(db: TpuLevelDB, r, bp, mask):
+    """Query features for all pixels of row r; `mask` picks which causal
+    offsets contribute (rowsafe for batched, written-only for rowwise)."""
+    nf = int(db.off.shape[0])
+    q0 = r * db.wb
+    idx = jax.lax.dynamic_slice(db.flat_idx, (q0, 0), (db.wb, nf))
+    wr = jax.lax.dynamic_slice(db.written, (q0, 0), (db.wb, nf))
+    dyn = bp[idx] * wr * mask[None, :] * db.fine_sqrtw[None, :]
+    base = jax.lax.dynamic_slice(
+        db.static_q, (q0, 0), (db.wb, db.static_q.shape[1]))
+    return jax.lax.dynamic_update_slice(base, dyn, (0, db.fine_start))
+
+
+@jax.jit
+def _run_rowwise(db: TpuLevelDB, kappa_mult):
+    wb, hb = db.wb, db.hb
+
+    def approx_fn(queries):
+        return argmin_l2(queries, db.db_rowsafe, db.db_rowsafe_sqnorm)
+
+    def pixel_body(j, carry):
+        bp, s, n_coh, r, p_apps = carry
+        q = r * wb + j
+        qvec = _exact_qvec(db, q, bp)
+        p_app = p_apps[j]
+        d_app = jnp.sum((db.db[p_app] - qvec) ** 2)
+        p_coh, d_coh, has_coh = _pixel_coherence(db, qvec, q, s)
+        use_coh = has_coh & (d_coh <= d_app * kappa_mult)
+        p = jnp.where(use_coh, p_coh, p_app).astype(jnp.int32)
+        bp = bp.at[q].set(db.a_filt_flat[p])
+        s = s.at[q].set(p)
+        return bp, s, n_coh + use_coh.astype(jnp.int32), r, p_apps
+
+    def row_body(r, state):
+        bp, s, n_coh = state
+        queries = _row_queries(db, r, bp, db.rowsafe)
+        p_apps, _ = approx_fn(queries)
+        bp, s, n_coh, _, _ = jax.lax.fori_loop(
+            0, wb, pixel_body, (bp, s, n_coh, r, p_apps))
+        return bp, s, n_coh
+
+    bp0 = jnp.zeros((hb * wb,), _F32)
+    s0 = jnp.zeros((hb * wb,), jnp.int32)
+    return jax.lax.fori_loop(0, hb, row_body, (bp0, s0, jnp.int32(0)))
+
+
+# -------------------------------------------------------------- batched scan
+
+
+def _left_refine(db: TpuLevelDB, queries, p, d_pick, d_app, kappa_mult):
+    """One vectorized left-propagation pass over a resolved row.
+
+    Adds the same-row coherence candidates {s(j-d) + (0, d)} (d = 1..radius)
+    computed from the CURRENT row estimate, and re-runs the kappa decision.
+    `d_pick` is the distance of the currently-picked source (inf where the
+    approx candidate was picked — the kappa rule only switches to a coherence
+    candidate if it beats d_app * kappa_mult; among coherence candidates the
+    closest wins).
+    """
+    wb = queries.shape[0]
+    jcol = jnp.arange(wb)
+    radius = int(round(int(db.off.shape[0]) ** 0.5)) // 2
+    best_d, best_p = d_pick, p
+    for d in range(1, radius + 1):
+        pj = jnp.roll(p, d)  # p[j-d] aligned at j
+        si = pj // db.wa
+        sj = pj % db.wa + d
+        ok = (jcol >= d) & (sj < db.wa)
+        cand = si * db.wa + jnp.minimum(sj, db.wa - 1)
+        cf = db.db_rowsafe[cand]
+        dc = jnp.sum((cf - queries) ** 2, axis=1)
+        dc = jnp.where(ok, dc, jnp.inf)
+        passes = dc <= d_app * kappa_mult
+        better = passes & (dc < best_d)
+        best_p = jnp.where(better, cand, best_p)
+        best_d = jnp.where(better, dc, best_d)
+    return best_p.astype(jnp.int32), best_d
+
+
+@jax.jit
+def _run_batched(db: TpuLevelDB, kappa_mult):
+    nf = int(db.off.shape[0])
+    nrs = db.n_rowsafe
+    wb, hb = db.wb, db.hb
+
+    if db.sharded_argmin is not None:
+        def approx_fn(queries):
+            return db.sharded_argmin(queries, db.db_sharded, db.dbn_sharded)
+    else:
+        def approx_fn(queries):
+            return argmin_l2(queries, db.db_rowsafe, db.db_rowsafe_sqnorm)
+
+    off_i = db.off[:nrs, 0]
+    off_j = db.off[:nrs, 1]
+
+    def row_body(r, state):
+        bp, s, n_coh = state
+        q0 = r * wb
+        queries = _row_queries(db, r, bp, db.rowsafe)
+        p_app, d_app = approx_fn(queries)
+
+        # rows-above coherence candidates (positions known at row start)
+        idx_c = jax.lax.dynamic_slice(
+            db.flat_idx, (q0, 0), (wb, nf))[:, :nrs]
+        ok = (jax.lax.dynamic_slice(db.valid, (q0, 0), (wb, nf))[:, :nrs]
+              > 0)
+        s_r = s[idx_c]  # (W, nrs)
+        ci = s_r // db.wa - off_i[None, :]
+        cj = s_r % db.wa - off_j[None, :]
+        ok = ok & (ci >= 0) & (ci < db.ha) & (cj >= 0) & (cj < db.wa)
+        cand = (jnp.clip(ci, 0, db.ha - 1) * db.wa
+                + jnp.clip(cj, 0, db.wa - 1))
+        cf = db.db_rowsafe[cand]  # (W, nrs, F)
+        dc = jnp.sum((cf - queries[:, None, :]) ** 2, axis=-1)
+        dc = jnp.where(ok, dc, jnp.inf)
+        k = jnp.argmin(dc, axis=1)
+        d_coh = jnp.take_along_axis(dc, k[:, None], axis=1)[:, 0]
+        p_coh = jnp.take_along_axis(cand, k[:, None], axis=1)[:, 0]
+
+        use_coh = ok.any(axis=1) & (d_coh <= d_app * kappa_mult)
+        p = jnp.where(use_coh, p_coh, p_app).astype(jnp.int32)
+        d_pick = jnp.where(use_coh, d_coh, jnp.inf)
+
+        # restore same-row left-propagation with cheap vectorized passes
+        for _ in range(_REFINE_PASSES):
+            p, d_pick = _left_refine(db, queries, p, d_pick, d_app,
+                                     kappa_mult)
+
+        bp = jax.lax.dynamic_update_slice(bp, db.a_filt_flat[p], (q0,))
+        s = jax.lax.dynamic_update_slice(s, p, (q0,))
+        n_coh = n_coh + (d_pick < jnp.inf).sum(dtype=jnp.int32)
+        return bp, s, n_coh
+
+    bp0 = jnp.zeros((hb * wb,), _F32)
+    s0 = jnp.zeros((hb * wb,), jnp.int32)
+    return jax.lax.fori_loop(0, hb, row_body, (bp0, s0, jnp.int32(0)))
+
+
+_RUNNERS = {
+    "exact": _run_exact,
+    "rowwise": _run_rowwise,
+    "batched": _run_batched,
+}
 
 
 class TpuMatcher(Matcher):
-    """JAX/XLA matcher.  Runs on TPU when one is attached; the same program
-    compiles on the CPU backend for the virtual-mesh tests."""
+    """JAX/XLA matcher.  Runs on TPU when one is attached; the same programs
+    compile on the CPU backend for the virtual-mesh tests."""
 
     def build_features(self, job: LevelJob) -> TpuLevelDB:
         spec = job.spec
@@ -92,173 +322,53 @@ class TpuMatcher(Matcher):
         ha, wa = job.a_shape
         flat_idx, valid, written = fine_gather_maps(hb, wb, spec.fine_size)
         off = window_offsets(spec.fine_size)
-        # rows-above-only mask: the subset of the causal window that is known
-        # at the START of a scan row (di < 0) — used by the rowwise batched
-        # approximate search.
+        # rows-above-only subset of the causal window: known at row start.
         rowsafe = ((off[:, 0] < 0).astype(np.float32)
                    * causal_mask(spec.fine_size))
 
-        n_db = int(db.shape[0]) * int(db.shape[1]) * 4
         strategy = self.params.strategy
         if strategy == "auto":
-            strategy = "exact" if n_db <= _AUTO_EXACT_MAX_DB_BYTES else "rowwise"
+            strategy = "batched"
+
+        fsl = spec.fine_filt_slice
+        db_rowsafe = db.at[:, fsl].multiply(jnp.asarray(rowsafe)[None, :])
+        db_rowsafe_sqnorm = jnp.sum(db_rowsafe * db_rowsafe, axis=1)
+
+        sharded_argmin = db_sharded = dbn_sharded = None
+        if self.params.db_shards > 1 and strategy == "batched":
+            from image_analogies_tpu.parallel.mesh import make_mesh
+            from image_analogies_tpu.parallel.sharded_match import shard_db
+
+            mesh = make_mesh(db_shards=self.params.db_shards)
+            db_sharded, dbn_sharded = shard_db(
+                db_rowsafe, db_rowsafe_sqnorm, mesh)
+            sharded_argmin = _cached_sharded_argmin(
+                mesh, jax.default_backend() != "tpu")
 
         return TpuLevelDB(
             db=db,
             db_sqnorm=jnp.sum(db * db, axis=1),
+            db_rowsafe=db_rowsafe,
+            db_rowsafe_sqnorm=db_rowsafe_sqnorm,
             static_q=static_q,
-            static_q_row=static_q,  # fine_filt block is zero in both
             flat_idx=jnp.asarray(flat_idx),
             valid=jnp.asarray(valid),
             written=jnp.asarray(written),
             rowsafe=jnp.asarray(rowsafe),
             a_filt_flat=jnp.asarray(job.a_filt, _F32).reshape(-1),
-            fine_sqrtw=jnp.asarray(spec.sqrt_weights()[spec.fine_filt_slice]),
+            fine_sqrtw=jnp.asarray(spec.sqrt_weights()[fsl]),
             off=jnp.asarray(off),
+            db_sharded=db_sharded,
+            dbn_sharded=dbn_sharded,
             ha=ha,
             wa=wa,
             hb=hb,
             wb=wb,
-            fine_start=spec.fine_filt_slice.start,
+            fine_start=fsl.start,
+            n_rowsafe=(spec.fine_size // 2) * spec.fine_size,
             strategy=strategy,
+            sharded_argmin=sharded_argmin,
         )
-
-    # ------------------------------------------------------------ exact scan
-
-    def _exact_level_fn(self, db: TpuLevelDB, kappa_mult: float):
-        """Jitted whole-level scan, one fori_loop iteration per pixel."""
-        nf = int(db.off.shape[0])
-        nb = db.hb * db.wb
-        fine_start = db.fine_start
-
-        def qvec_at(q, bp):
-            idxq = db.flat_idx[q]  # (nf,)
-            dyn = bp[idxq] * db.written[q] * db.fine_sqrtw
-            base = db.static_q[q]
-            return jax.lax.dynamic_update_slice(base, dyn, (fine_start,))
-
-        def coherence(qvec, q, s):
-            s_r = s[db.flat_idx[q]]  # (nf,)
-            ci = s_r // db.wa - db.off[:, 0]
-            cj = s_r % db.wa - db.off[:, 1]
-            inb = ((ci >= 0) & (ci < db.ha) & (cj >= 0) & (cj < db.wa)
-                   & (db.valid[q] > 0))
-            cand = (jnp.clip(ci, 0, db.ha - 1) * db.wa
-                    + jnp.clip(cj, 0, db.wa - 1))
-            cf = db.db[cand]  # (nf, F) gather
-            dc = jnp.sum((cf - qvec[None, :]) ** 2, axis=1)
-            dc = jnp.where(inb, dc, jnp.inf)
-            k = jnp.argmin(dc)
-            return cand[k], dc[k], inb.any()
-
-        def body(q, state):
-            bp, s, n_coh = state
-            qvec = qvec_at(q, bp)
-            scores = db.db_sqnorm - 2.0 * jnp.dot(
-                db.db, qvec, preferred_element_type=_F32,
-                precision=_HIGHEST)
-            p_app = jnp.argmin(scores)
-            qn = jnp.dot(qvec, qvec, preferred_element_type=_F32,
-                         precision=_HIGHEST)
-            d_app = jnp.maximum(scores[p_app] + qn, 0.0)
-            p_coh, d_coh, has_coh = coherence(qvec, q, s)
-            use_coh = has_coh & (d_coh <= d_app * kappa_mult)
-            p = jnp.where(use_coh, p_coh, p_app).astype(jnp.int32)
-            bp = bp.at[q].set(db.a_filt_flat[p])
-            s = s.at[q].set(p)
-            return bp, s, n_coh + use_coh.astype(jnp.int32)
-
-        def run():
-            bp0 = jnp.zeros((nb,), _F32)
-            s0 = jnp.zeros((nb,), jnp.int32)
-            return jax.lax.fori_loop(0, nb, body, (bp0, s0, jnp.int32(0)))
-
-        return jax.jit(run)
-
-    # ------------------------------------------------------- rowwise scan
-
-    def _rowwise_level_fn(self, db: TpuLevelDB, kappa_mult: float,
-                          approx_fn=None):
-        """Batched approximate search per scan row + sequential resolution.
-
-        approx_fn(queries (W,F)) -> (idx (W,), sqdist (W,)) may be overridden
-        (the Pallas kernel / sharded variant plug in here); default is the
-        XLA matmul + argmin.
-        """
-        nf = int(db.off.shape[0])
-        wb, hb = db.wb, db.hb
-        fine_start = db.fine_start
-
-        if approx_fn is None:
-            def approx_fn(queries):
-                scores = (db.db_sqnorm[None, :] - 2.0 * jnp.dot(
-                    queries, db.db.T, preferred_element_type=_F32,
-                    precision=_HIGHEST))
-                idx = jnp.argmin(scores, axis=1)
-                qn = jnp.sum(queries * queries, axis=1)
-                d = jnp.take_along_axis(scores, idx[:, None], axis=1)[:, 0]
-                return idx.astype(jnp.int32), jnp.maximum(d + qn, 0.0)
-
-        def row_queries(r, bp):
-            """Query features for all pixels of row r using the rows-above
-            causal subset (exact at row start)."""
-            q0 = r * wb
-            idx = jax.lax.dynamic_slice(db.flat_idx, (q0, 0), (wb, nf))
-            wr = jax.lax.dynamic_slice(db.written, (q0, 0), (wb, nf))
-            dyn = bp[idx] * wr * db.rowsafe[None, :] * db.fine_sqrtw[None, :]
-            base = jax.lax.dynamic_slice(
-                db.static_q, (q0, 0), (wb, db.static_q.shape[1]))
-            return jax.lax.dynamic_update_slice(base, dyn, (0, fine_start))
-
-        def exact_qvec(q, bp):
-            idxq = db.flat_idx[q]
-            dyn = bp[idxq] * db.written[q] * db.fine_sqrtw
-            return jax.lax.dynamic_update_slice(
-                db.static_q[q], dyn, (fine_start,))
-
-        def coherence(qvec, q, s):
-            s_r = s[db.flat_idx[q]]
-            ci = s_r // db.wa - db.off[:, 0]
-            cj = s_r % db.wa - db.off[:, 1]
-            inb = ((ci >= 0) & (ci < db.ha) & (cj >= 0) & (cj < db.wa)
-                   & (db.valid[q] > 0))
-            cand = (jnp.clip(ci, 0, db.ha - 1) * db.wa
-                    + jnp.clip(cj, 0, db.wa - 1))
-            cf = db.db[cand]
-            dc = jnp.sum((cf - qvec[None, :]) ** 2, axis=1)
-            dc = jnp.where(inb, dc, jnp.inf)
-            k = jnp.argmin(dc)
-            return cand[k], dc[k], inb.any()
-
-        def pixel_body(j, carry):
-            bp, s, n_coh, r, p_apps = carry
-            q = r * wb + j
-            qvec = exact_qvec(q, bp)
-            p_app = p_apps[j]
-            # exact d_app for the kappa test (candidate from the batched pass)
-            d_app = jnp.sum((db.db[p_app] - qvec) ** 2)
-            p_coh, d_coh, has_coh = coherence(qvec, q, s)
-            use_coh = has_coh & (d_coh <= d_app * kappa_mult)
-            p = jnp.where(use_coh, p_coh, p_app).astype(jnp.int32)
-            bp = bp.at[q].set(db.a_filt_flat[p])
-            s = s.at[q].set(p)
-            return bp, s, n_coh + use_coh.astype(jnp.int32), r, p_apps
-
-        def row_body(r, state):
-            bp, s, n_coh = state
-            queries = row_queries(r, bp)
-            p_apps, _ = approx_fn(queries)
-            bp, s, n_coh, _, _ = jax.lax.fori_loop(
-                0, wb, pixel_body, (bp, s, n_coh, r, p_apps))
-            return bp, s, n_coh
-
-        def run():
-            bp0 = jnp.zeros((hb * wb,), _F32)
-            s0 = jnp.zeros((hb * wb,), jnp.int32)
-            return jax.lax.fori_loop(0, hb, row_body,
-                                     (bp0, s0, jnp.int32(0)))
-
-        return jax.jit(run)
 
     # ------------------------------------------------------------- protocol
 
@@ -268,38 +378,23 @@ class TpuMatcher(Matcher):
         """Single-pixel reference path (unit-test seam, not the fast path)."""
         bp = jnp.asarray(bp_flat, _F32)
         s = jnp.asarray(s_flat, jnp.int32)
-        dyn = bp[db.flat_idx[q]] * db.written[q] * db.fine_sqrtw
-        qvec = db.static_q[q].at[
-            db.fine_start : db.fine_start + dyn.shape[0]].set(dyn)
+        qvec = _exact_qvec(db, q, bp)
         scores = db.db_sqnorm - 2.0 * jnp.dot(
             db.db, qvec, preferred_element_type=_F32, precision=_HIGHEST)
         p_app = int(jnp.argmin(scores))
         d_app = max(float(scores[p_app] + jnp.dot(qvec, qvec)), 0.0)
-        # coherence
-        s_r = np.asarray(s)[np.asarray(db.flat_idx[q])]
-        off = np.asarray(db.off)
-        ci = s_r // db.wa - off[:, 0]
-        cj = s_r % db.wa - off[:, 1]
-        inb = ((ci >= 0) & (ci < db.ha) & (cj >= 0) & (cj < db.wa)
-               & (np.asarray(db.valid[q]) > 0))
-        if inb.any():
-            cand = (ci[inb] * db.wa + cj[inb]).astype(np.int64)
-            dmat = np.asarray(db.db)[cand] - np.asarray(qvec)[None, :]
-            dc = (dmat * dmat).sum(axis=1)
-            k = int(np.argmin(dc))
-            if float(dc[k]) <= d_app * job.kappa_mult:
-                return int(cand[k]), float(dc[k]), True
+        p_coh, d_coh, has_coh = _pixel_coherence(db, qvec, q, s)
+        if bool(has_coh) and float(d_coh) <= d_app * job.kappa_mult:
+            return int(p_coh), float(d_coh), True
         return p_app, d_app, False
 
     def synthesize_level(self, db: TpuLevelDB, job: LevelJob
                          ) -> Tuple[np.ndarray, np.ndarray, Dict[str, Any]]:
         t0 = time.perf_counter()
-        if db.strategy == "exact":
-            fn = self._exact_level_fn(db, job.kappa_mult)
-        else:
-            fn = self._rowwise_level_fn(db, job.kappa_mult)
-        bp, s, n_coh = fn()
-        bp, s = jax.block_until_ready((bp, s))
+        runner = _RUNNERS[db.strategy]
+        bp, s, n_coh = runner(db, jnp.float32(job.kappa_mult))
+        bp = np.asarray(bp, np.float32)  # forces device completion
+        s = np.asarray(s, np.int32)
         dt = time.perf_counter() - t0
         hb, wb = job.b_shape
         stats = {
@@ -311,5 +406,4 @@ class TpuMatcher(Matcher):
             "backend": "tpu",
             "strategy": db.strategy,
         }
-        return (np.asarray(bp, np.float32).reshape(hb, wb),
-                np.asarray(s, np.int32).reshape(hb, wb), stats)
+        return bp.reshape(hb, wb), s.reshape(hb, wb), stats

@@ -1,0 +1,190 @@
+"""Command-line interface (SURVEY.md §2 P1, §5.6).
+
+Mirrors the reference's flag surface — paths for A/A'/B and output, kappa,
+levels, patch sizes, ANN toggle, mode — plus the TPU framework's additions:
+backend/strategy/db-shards, checkpointing, structured logging, profiling, and
+an `eval` command computing SSIM between two images.
+
+    python -m image_analogies_tpu.cli run --a A.png --ap Ap.png --b B.png \
+        --out Bp.png --mode filter --levels 3 --kappa 5 --backend tpu
+    python -m image_analogies_tpu.cli video --a A.png --ap Ap.png \
+        --frames f0.png f1.png f2.png --out-dir out/
+    python -m image_analogies_tpu.cli eval --a out.png --b ref.png
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+import numpy as np
+
+from image_analogies_tpu.config import PRESETS, AnalogyParams
+from image_analogies_tpu.models import modes
+from image_analogies_tpu.models.video import video_analogy
+from image_analogies_tpu.utils.imageio import load_image, save_image
+from image_analogies_tpu.utils.ssim import ssim
+
+MODES = ("filter", "texture_by_numbers", "super_resolution",
+         "texture_synthesis")
+
+
+def _add_engine_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--levels", type=int, default=None)
+    p.add_argument("--kappa", type=float, default=None)
+    p.add_argument("--patch-size", type=int, default=None)
+    p.add_argument("--coarse-patch-size", type=int, default=None)
+    p.add_argument("--backend", choices=("cpu", "tpu"), default=None)
+    p.add_argument("--strategy",
+                   choices=("exact", "rowwise", "batched", "auto"),
+                   default=None)
+    p.add_argument("--db-shards", type=int, default=None)
+    p.add_argument("--no-ann", action="store_true",
+                   help="disable the cKDTree index (CPU backend brute force)")
+    p.add_argument("--no-remap", action="store_true",
+                   help="disable luminance remapping")
+    p.add_argument("--no-gaussian", action="store_true",
+                   help="unweighted (flat) neighborhood distances")
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--resume-from-level", type=int, default=None)
+    p.add_argument("--log-path", default=None)
+    p.add_argument("--profile-dir", default=None)
+
+
+def _params_from_args(args, base: AnalogyParams) -> AnalogyParams:
+    kw = {}
+    for name in ("levels", "kappa", "backend", "strategy", "db_shards",
+                 "checkpoint_dir", "resume_from_level", "log_path",
+                 "profile_dir"):
+        v = getattr(args, name)
+        if v is not None:
+            kw[name] = v
+    if args.patch_size is not None:
+        kw["patch_size"] = args.patch_size
+    if args.coarse_patch_size is not None:
+        kw["coarse_patch_size"] = args.coarse_patch_size
+    if args.no_ann:
+        kw["use_ann"] = False
+    if args.no_remap:
+        kw["remap_luminance"] = False
+    if args.no_gaussian:
+        kw["gaussian_weights"] = False
+    return base.replace(**kw)
+
+
+def _emit_stats(res) -> None:
+    for st in res.stats:
+        print(json.dumps(st, sort_keys=True), file=sys.stderr)
+
+
+def cmd_run(args) -> int:
+    mode = args.mode
+    base = {
+        "filter": PRESETS["oil_filter"],
+        "texture_by_numbers": PRESETS["texture_by_numbers"],
+        "super_resolution": PRESETS["super_resolution"],
+        "texture_synthesis": PRESETS["texture_synthesis"],
+    }[mode]
+    params = _params_from_args(args, base)
+
+    if mode == "texture_synthesis":
+        ap = load_image(args.ap)
+        shape = tuple(int(x) for x in args.out_shape.split("x"))
+        res = modes.texture_synthesis(ap, shape, params)
+    else:
+        a = load_image(args.a)
+        ap = load_image(args.ap)
+        b = load_image(args.b)
+        if mode == "filter":
+            res = modes.artistic_filter(a, ap, b, params)
+        elif mode == "texture_by_numbers":
+            res = modes.texture_by_numbers(a, ap, b, params)
+        else:
+            res = modes.super_resolution(ap, b, params,
+                                         blur_passes=args.blur_passes)
+    save_image(args.out, res.bp)
+    _emit_stats(res)
+    print(args.out)
+    return 0
+
+
+def cmd_video(args) -> int:
+    a = load_image(args.a)
+    ap = load_image(args.ap)
+    frames = [load_image(f) for f in args.frames]
+    base = PRESETS["video"]
+    params = _params_from_args(args, base)
+    if args.temporal_weight is not None:
+        params = params.replace(temporal_weight=args.temporal_weight)
+    res = video_analogy(a, ap, frames, params, scheme=args.scheme)
+    os.makedirs(args.out_dir, exist_ok=True)
+    outs = []
+    for t, frame in enumerate(res.frames):
+        path = os.path.join(args.out_dir, f"frame_{t:04d}.png")
+        save_image(path, frame)
+        outs.append(path)
+    for st in res.stats:
+        print(json.dumps(st, sort_keys=True), file=sys.stderr)
+    print("\n".join(outs))
+    return 0
+
+
+def cmd_eval(args) -> int:
+    x = load_image(args.a)
+    y = load_image(args.b)
+    print(json.dumps({"ssim": ssim(x, y)}))
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="image_analogies_tpu",
+        description="TPU-native Image Analogies (Hertzmann et al. 2001)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    run = sub.add_parser("run", help="single-image analogy")
+    run.add_argument("--mode", choices=MODES, default="filter")
+    run.add_argument("--a", help="unfiltered source image A")
+    run.add_argument("--ap", required=True, help="filtered source image A'")
+    run.add_argument("--b", help="target image B")
+    run.add_argument("--out", required=True)
+    run.add_argument("--out-shape", default="256x256",
+                     help="HxW for texture_synthesis")
+    run.add_argument("--blur-passes", type=int, default=2,
+                     help="degradation strength for super_resolution")
+    _add_engine_flags(run)
+    run.set_defaults(fn=cmd_run)
+
+    vid = sub.add_parser("video", help="batched video analogy")
+    vid.add_argument("--a", required=True)
+    vid.add_argument("--ap", required=True)
+    vid.add_argument("--frames", nargs="+", required=True)
+    vid.add_argument("--out-dir", required=True)
+    vid.add_argument("--scheme", choices=("sequential", "two_phase"),
+                     default="two_phase")
+    vid.add_argument("--temporal-weight", type=float, default=None)
+    _add_engine_flags(vid)
+    vid.set_defaults(fn=cmd_video)
+
+    ev = sub.add_parser("eval", help="SSIM between two images")
+    ev.add_argument("--a", required=True)
+    ev.add_argument("--b", required=True)
+    ev.set_defaults(fn=cmd_eval)
+    return ap
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.cmd == "run" and args.mode != "texture_synthesis":
+        missing = [k for k in ("a", "b") if getattr(args, k) is None]
+        if missing:
+            build_parser().error(
+                f"--{' --'.join(missing)} required for mode {args.mode}")
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -54,11 +54,17 @@ class AnalogyParams:
 
     # TPU match strategy:
     #   "exact"   - per-pixel on-device scan, bit-matches the oracle's
-    #               candidate selection (modulo fp associativity).
+    #               candidate selection (modulo fp associativity).  Slowest:
+    #               the loop-carried scan costs ~1ms/pixel in XLA.
     #   "rowwise" - batched approximate search per scan row (rows-above-only
-    #               causal mask) + sequential coherence/kappa resolution; the
-    #               fast path (SURVEY.md §7 hard part 1's sanctioned lever).
-    #   "auto"    - exact while the DB fits comfortably in VMEM, else rowwise.
+    #               causal mask) + sequential exact coherence/kappa pass.
+    #   "batched" - the fast path: the causal window is restricted to
+    #               strictly-above rows for approximate AND coherence
+    #               candidates, so each scan row resolves fully in parallel
+    #               (one fused Pallas argmin + one batched coherence gather
+    #               per row).  SURVEY.md §7 hard part 1's sanctioned lever,
+    #               SSIM-validated against the oracle.
+    #   "auto"    - batched.
     strategy: str = "auto"
 
     # Use the cKDTree index for the CPU approximate match (the reference's ANN
@@ -92,7 +98,7 @@ class AnalogyParams:
             raise ValueError(f"unknown color_mode {self.color_mode!r}")
         if self.backend not in ("cpu", "tpu"):
             raise ValueError(f"unknown backend {self.backend!r}")
-        if self.strategy not in ("exact", "rowwise", "auto"):
+        if self.strategy not in ("exact", "rowwise", "batched", "auto"):
             raise ValueError(f"unknown strategy {self.strategy!r}")
         if self.db_shards < 1:
             raise ValueError(f"db_shards must be >= 1, got {self.db_shards}")

@@ -77,18 +77,15 @@ def create_image_analogy(
     b: np.ndarray,
     params: AnalogyParams = AnalogyParams(),
     backend=None,
-    a_temporal_pyr: Optional[List[np.ndarray]] = None,
-    b_temporal_pyr: Optional[List[np.ndarray]] = None,
+    temporal_prev: Optional[np.ndarray] = None,
 ) -> AnalogyResult:
     """Synthesize B' such that A : A' :: B : B' (Hertzmann §3 pseudocode).
 
-    `a_temporal_pyr` / `b_temporal_pyr` are optional per-level planes for the
-    video temporal-coherence term (models/video.py passes the previous output
-    frame's pyramid).
+    `temporal_prev` is the previous output frame's synthesized luminance
+    (B'_{t-1}, same shape as B) for video mode: with
+    ``params.temporal_weight > 0`` its windows join the feature vector and
+    are matched against A' windows on the DB side (BASELINE.json:12).
     """
-    if (a_temporal_pyr is None) != (b_temporal_pyr is None):
-        raise ValueError(
-            "a_temporal_pyr and b_temporal_pyr must be given together")
     backend = backend or get_backend(params)
     a_src, b_src, a_filt, ap_rgb, b_yiq = _prep_planes(a, ap, b, params)
 
@@ -100,7 +97,11 @@ def create_image_analogy(
     a_filt_pyr = build_pyramid_np(a_filt, levels)
     b_src_pyr = build_pyramid_np(b_src, levels)
     src_channels = 1 if a_src.ndim == 2 else a_src.shape[-1]
-    temporal = a_temporal_pyr is not None
+    temporal = params.temporal_weight > 0 and temporal_prev is not None
+    # DB-side temporal plane is A' (same remapped plane the features use);
+    # query side is the previous output frame's pyramid.
+    b_temporal_pyr = (build_pyramid_np(
+        np.asarray(temporal_prev, np.float32), levels) if temporal else None)
 
     bp_pyr: List[Optional[np.ndarray]] = [None] * levels
     s_pyr: List[Optional[np.ndarray]] = [None] * levels
@@ -139,7 +140,7 @@ def create_image_analogy(
                               if level + 1 < levels else None),
                 b_filt_coarse=(bp_pyr[level + 1]
                                if level + 1 < levels else None),
-                a_temporal=(a_temporal_pyr[level] if temporal else None),
+                a_temporal=(a_filt_pyr[level] if temporal else None),
                 b_temporal=(b_temporal_pyr[level] if temporal else None),
             )
             t0 = time.perf_counter()

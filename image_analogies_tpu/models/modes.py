@@ -1,0 +1,79 @@
+"""Application modes (SURVEY.md §2 P9, §3.4-3.5, BASELINE.json:6-12).
+
+One engine, several applications by varying inputs (Hertzmann §6):
+
+- ``artistic_filter``     A : A' :: B : B' with a filtered training pair
+                          (oil paint, watercolor, line art, blur pairs).
+- ``texture_by_numbers``  A = label map, A' = real texture; paint a new label
+                          map B and get a plausible B' texture.
+- ``super_resolution``    A = downgraded A', so the analogy learns
+                          low-res -> high-res detail; apply to a low-res B.
+- ``texture_synthesis``   degenerate analogy with the unfiltered planes
+                          ignored (src_weight = 0): plain patch-based
+                          synthesis of more texture like A'.
+- ``video``               batched B-frames with a temporal-coherence term —
+                          see models/video.py.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from image_analogies_tpu.config import PRESETS, AnalogyParams
+from image_analogies_tpu.models.analogy import AnalogyResult, create_image_analogy
+from image_analogies_tpu.ops import color, pyramid
+
+
+def artistic_filter(a, ap, b, params: Optional[AnalogyParams] = None,
+                    **overrides) -> AnalogyResult:
+    """Classic A : A' :: B : B' filter transfer (BASELINE config 2/4)."""
+    params = (params or PRESETS["oil_filter"]).replace(**overrides)
+    return create_image_analogy(a, ap, b, params)
+
+
+def texture_by_numbers(labels_a, texture_a, labels_b,
+                       params: Optional[AnalogyParams] = None,
+                       **overrides) -> AnalogyResult:
+    """A = label map, A' = texture, B = new label map (BASELINE config 1)."""
+    params = (params or PRESETS["texture_by_numbers"]).replace(**overrides)
+    return create_image_analogy(labels_a, texture_a, labels_b, params)
+
+
+def blur_for_superres(img: np.ndarray, passes: int = 2) -> np.ndarray:
+    """The degradation used to build the super-res training pair: repeated
+    binomial blur (matching the pyramid stencil, so the coarse statistics
+    of A and B agree)."""
+    out = color.as_float(img)
+    for _ in range(passes):
+        out = pyramid.blur_np(out)
+    return out
+
+
+def super_resolution(sharp_a: np.ndarray, low_b: np.ndarray,
+                     params: Optional[AnalogyParams] = None,
+                     blur_passes: int = 2, **overrides) -> AnalogyResult:
+    """Sharpen `low_b` by analogy with a sharp exemplar (BASELINE config 3).
+
+    A = blur(A'), A' = sharp_a; B = low_b (blurred the same way so its
+    statistics match A's).
+    """
+    params = (params or PRESETS["super_resolution"]).replace(**overrides)
+    a = blur_for_superres(sharp_a, blur_passes)
+    b = blur_for_superres(low_b, 0)
+    return create_image_analogy(a, sharp_a, b, params)
+
+
+def texture_synthesis(texture: np.ndarray, out_shape,
+                      params: Optional[AnalogyParams] = None,
+                      **overrides) -> AnalogyResult:
+    """Synthesize an out_shape patch of more `texture` (src_weight = 0: only
+    the causal B' windows drive matching — Ashikhmin-style synthesis)."""
+    params = (params or PRESETS["texture_synthesis"]).replace(**overrides)
+    if params.src_weight != 0.0:
+        params = params.replace(src_weight=0.0)
+    tex = color.as_float(texture)
+    a = np.zeros(tex.shape[:2], np.float32)
+    b = np.zeros(tuple(out_shape), np.float32)
+    return create_image_analogy(a, tex, b, params)

@@ -1,0 +1,81 @@
+"""Batched video analogies (SURVEY.md §2.3 T3, BASELINE.json:12).
+
+Applies one training pair A -> A' to a sequence of B frames with a
+temporal-coherence term: each frame's feature vectors carry windows of the
+PREVIOUS OUTPUT frame (matched against A' windows on the DB side), weighted by
+``params.temporal_weight``, so the synthesis prefers sources consistent with
+where it looked last frame — suppressing frame-to-frame flicker.
+
+Two execution schemes:
+
+- ``scheme="sequential"``: frame t consumes frame t-1's actual output.
+  Highest temporal fidelity, strictly serial.
+- ``scheme="two_phase"`` (default): phase 1 synthesizes ALL frames
+  independently (embarrassingly parallel — this is the axis that shards over
+  the mesh 'data' axis); phase 2 re-synthesizes every frame with the temporal
+  term fed by phase 1's neighbor output.  Both phases are data-parallel over
+  frames, trading one extra pass for a pod-width speedup (a Jacobi iteration
+  of the sequential recurrence).
+
+The per-frame engine is the full pluggable-backend pipeline, so video mode
+composes with db-sharding: a (data, db) mesh shards frames x patch-DB.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.models.analogy import AnalogyResult, create_image_analogy
+
+
+@dataclass
+class VideoResult:
+    frames: List[np.ndarray]  # synthesized B' frames
+    frames_y: List[np.ndarray]  # synthesized luminance planes
+    stats: List[Dict[str, Any]] = field(default_factory=list)
+
+
+def video_analogy(
+    a: np.ndarray,
+    ap: np.ndarray,
+    frames: Sequence[np.ndarray],
+    params: AnalogyParams = AnalogyParams(temporal_weight=1.0),
+    scheme: str = "two_phase",
+    backend=None,
+) -> VideoResult:
+    if scheme not in ("sequential", "two_phase"):
+        raise ValueError(f"unknown scheme {scheme!r}")
+    frames = list(frames)
+    if not frames:
+        return VideoResult(frames=[], frames_y=[])
+
+    stats: List[Dict[str, Any]] = []
+
+    def synth(b, prev_y, tag, idx):
+        res = create_image_analogy(a, ap, b, params, backend=backend,
+                                   temporal_prev=prev_y)
+        for st in res.stats:
+            st.update(frame=idx, phase=tag)
+            stats.append(st)
+        return res
+
+    if scheme == "sequential":
+        outs, prev_y = [], None
+        for t, b in enumerate(frames):
+            res = synth(b, prev_y, "seq", t)
+            prev_y = res.bp_y
+            outs.append(res)
+        return VideoResult(frames=[r.bp for r in outs],
+                           frames_y=[r.bp_y for r in outs], stats=stats)
+
+    # two_phase: phase 1 frames are independent (shardable over 'data')
+    phase1 = [synth(b, None, "phase1", t) for t, b in enumerate(frames)]
+    outs = [phase1[0]]
+    for t in range(1, len(frames)):
+        outs.append(synth(frames[t], phase1[t - 1].bp_y, "phase2", t))
+    return VideoResult(frames=[r.bp for r in outs],
+                       frames_y=[r.bp_y for r in outs], stats=stats)

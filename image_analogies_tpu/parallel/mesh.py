@@ -1,0 +1,41 @@
+"""Device-mesh helpers (SURVEY.md §5.8).
+
+The framework's two parallel axes (SURVEY.md §2.3 T2/T3):
+
+- ``db``:   the A/A' patch database sharded across chips — exemplar size
+  scales with pod size (BASELINE.json:5).
+- ``data``: batched video B-frames sharded across chips (BASELINE.json:12).
+
+Collectives ride the ICI mesh via `shard_map` + XLA (`all_gather`/`pmin`);
+multi-host DCN meshes come for free from `jax.make_mesh` device ordering.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(db_shards: int = 1, data_shards: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """A (data, db) mesh over the available devices.
+
+    `db_shards * data_shards` must divide the device count; surplus devices
+    are left unused (single-chip dev boxes just get a 1x1 mesh).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    need = db_shards * data_shards
+    if need > len(devices):
+        raise ValueError(
+            f"mesh needs {need} devices (data={data_shards} x db={db_shards}) "
+            f"but only {len(devices)} are available")
+    dev = np.asarray(devices[:need]).reshape(data_shards, db_shards)
+    return Mesh(dev, ("data", "db"))
+
+
+def pad_to_shards(n: int, shards: int) -> int:
+    """Rows the DB must be padded to so every shard gets an equal slice."""
+    return (n + shards - 1) // shards * shards

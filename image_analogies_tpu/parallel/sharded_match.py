@@ -1,0 +1,71 @@
+"""Sharded patch-DB argmin over the device mesh (BASELINE.json:5).
+
+The A/A' feature database is sharded row-wise across the ``db`` mesh axis;
+each chip computes a local (min-distance, argmin) over its shard with the
+fused Pallas kernel, and the global winner is resolved with a min+argmin
+all-reduce: `all_gather` the per-shard (dist, global-index) pairs (one pair
+per query — tiny) and select the minimum, ties -> lowest global index, i.e.
+bitwise the same ordering as the single-chip kernel.
+
+This is the framework's answer to SURVEY.md §5.7: the scaling axis of Image
+Analogies is exemplar-database size, and it scales with pod size.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from image_analogies_tpu.ops.pallas_match import argmin_l2
+
+
+def shard_db(db: jax.Array, db_sqnorm: jax.Array, mesh: Mesh,
+             axis: str = "db") -> Tuple[jax.Array, jax.Array]:
+    """Pad DB rows to a multiple of the axis size and lay them out sharded.
+
+    Padding rows get +inf sqnorm so they can never win the argmin.
+    """
+    shards = mesh.shape[axis]
+    n, f = db.shape
+    npad = (n + shards - 1) // shards * shards
+    dbp = jnp.zeros((npad, f), db.dtype).at[:n].set(db)
+    dbnp = jnp.full((npad,), jnp.inf, jnp.float32).at[:n].set(db_sqnorm)
+    spec_db = NamedSharding(mesh, P(axis, None))
+    spec_n = NamedSharding(mesh, P(axis))
+    return (jax.device_put(dbp, spec_db), jax.device_put(dbnp, spec_n))
+
+
+def make_sharded_argmin(mesh: Mesh, axis: str = "db",
+                        force_xla: bool = False) -> Callable:
+    """Returns argmin_fn(queries (M,F), db_sharded, dbn_sharded) -> (idx, d).
+
+    Queries are replicated over `axis`; the DB stays sharded.  The returned
+    global index refers to the PADDED row space (callers built it via
+    `shard_db`, real rows come first so indices < n are unaffected).
+    """
+
+    def local(q, db_shard, dbn_shard):
+        idx, d = argmin_l2(q, db_shard, dbn_shard, force_xla=force_xla)
+        shard = jax.lax.axis_index(axis)
+        gidx = idx + shard * db_shard.shape[0]
+        # min+argmin all-reduce: per-shard winners are (M,) scalars -> the
+        # gather is D x M tiny; ties resolve to the lowest shard, matching
+        # the single-chip lowest-index tie-break.
+        alld = jax.lax.all_gather(d, axis)  # (D, M)
+        alli = jax.lax.all_gather(gidx, axis)  # (D, M)
+        k = jnp.argmin(alld, axis=0)
+        d = jnp.take_along_axis(alld, k[None], axis=0)[0]
+        i = jnp.take_along_axis(alli, k[None], axis=0)[0]
+        return i.astype(jnp.int32), d
+
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(P(), P(axis, None), P(axis)),
+        out_specs=(P(), P()),
+        check_rep=False,
+    )

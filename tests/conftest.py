@@ -4,11 +4,22 @@ cluster).  Sharded-argmin/pmin logic is exercised on this mesh."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU (the box's sitecustomize registers the axon TPU plugin and sets
+# jax_platforms programmatically, overriding the env var — so override the
+# config after import, before any device is touched).  Set
+# IA_TEST_PLATFORM=axon to run the suite against the real chip instead.
+_platform = os.environ.get("IA_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", _platform)
+if _platform == "cpu":
+    jax.config.update("jax_num_cpu_devices", 8)
 
 import numpy as np
 import pytest

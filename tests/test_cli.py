@@ -1,0 +1,81 @@
+"""CLI surface (SURVEY.md §2 P1): arg parsing, modes, eval, error paths."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from image_analogies_tpu.cli import build_parser, main
+from image_analogies_tpu.utils.imageio import load_image, save_image
+from tests.conftest import make_pair
+
+
+@pytest.fixture
+def assets(tmp_path):
+    a, ap, b = make_pair(16, 16, seed=1)
+    paths = {}
+    for name, img in [("a", a), ("ap", ap), ("b", b)]:
+        p = str(tmp_path / f"{name}.png")
+        save_image(p, img)
+        paths[name] = p
+    return paths, tmp_path
+
+
+def test_run_filter(assets, capsys):
+    paths, tmp = assets
+    out = str(tmp / "out.png")
+    rc = main(["run", "--mode", "filter", "--a", paths["a"], "--ap",
+               paths["ap"], "--b", paths["b"], "--out", out,
+               "--levels", "1", "--backend", "cpu", "--kappa", "2"])
+    assert rc == 0 and os.path.exists(out)
+    img = load_image(out)
+    assert img.shape[:2] == (16, 16)
+
+
+def test_run_texture_synthesis(assets):
+    paths, tmp = assets
+    out = str(tmp / "tex.png")
+    rc = main(["run", "--mode", "texture_synthesis", "--ap", paths["ap"],
+               "--out", out, "--out-shape", "12x12", "--levels", "1",
+               "--backend", "cpu"])
+    assert rc == 0
+    assert load_image(out).shape[:2] == (12, 12)
+
+
+def test_run_missing_b_errors(assets):
+    paths, _ = assets
+    with pytest.raises(SystemExit):
+        main(["run", "--mode", "filter", "--ap", paths["ap"],
+              "--out", "/tmp/x.png"])
+
+
+def test_eval(assets, capsys):
+    paths, _ = assets
+    rc = main(["eval", "--a", paths["a"], "--b", paths["a"]])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["ssim"] == pytest.approx(1.0, abs=1e-6)
+
+
+def test_video_cli(assets, capsys):
+    paths, tmp = assets
+    outdir = str(tmp / "vid")
+    rc = main(["video", "--a", paths["a"], "--ap", paths["ap"],
+               "--frames", paths["b"], paths["b"], "--out-dir", outdir,
+               "--levels", "1", "--backend", "cpu"])
+    assert rc == 0
+    assert sorted(os.listdir(outdir)) == ["frame_0000.png", "frame_0001.png"]
+
+
+def test_engine_flags_map_to_params(assets):
+    parser = build_parser()
+    args = parser.parse_args(
+        ["run", "--ap", "x", "--out", "y", "--no-ann", "--no-remap",
+         "--kappa", "7", "--db-shards", "4", "--strategy", "batched"])
+    from image_analogies_tpu.cli import _params_from_args
+    from image_analogies_tpu.config import PRESETS
+
+    p = _params_from_args(args, PRESETS["oil_filter"])
+    assert p.kappa == 7 and not p.use_ann and not p.remap_luminance
+    assert p.db_shards == 4 and p.strategy == "batched"

@@ -1,0 +1,92 @@
+"""Application modes and video (SURVEY.md §3.4-3.5, BASELINE configs 1-5)."""
+
+import numpy as np
+import pytest
+
+from image_analogies_tpu.config import PRESETS, AnalogyParams
+from image_analogies_tpu.models import modes
+from image_analogies_tpu.models.video import video_analogy
+from image_analogies_tpu.ops.features import spec_for_level
+from tests.conftest import make_pair
+
+
+@pytest.fixture
+def small():
+    return make_pair(16, 16, seed=11)
+
+
+def _params(**kw):
+    kw.setdefault("levels", 1)
+    kw.setdefault("backend", "cpu")
+    return AnalogyParams(**kw)
+
+
+def test_artistic_filter(small):
+    a, ap, b = small
+    res = modes.artistic_filter(a, ap, b, _params(levels=2))
+    assert res.bp.shape == b.shape
+
+
+def test_texture_by_numbers_rgb_labels(rng):
+    lab_a = np.zeros((16, 16, 3), np.float32)
+    lab_a[:, :8, 0] = 1
+    lab_a[:, 8:, 2] = 1
+    tex = rng.uniform(0, 1, (16, 16, 3)).astype(np.float32)
+    lab_b = lab_a[:, ::-1].copy()
+    res = modes.texture_by_numbers(
+        lab_a, tex, lab_b, PRESETS["texture_by_numbers"].replace(levels=1))
+    assert res.bp.shape == (16, 16, 3)
+
+
+def test_super_resolution(small):
+    a, ap, _ = small
+    res = modes.super_resolution(ap, ap, _params(patch_size=5, levels=1))
+    assert res.bp.shape == ap.shape[:2]
+
+
+def test_texture_synthesis_ignores_src(rng):
+    tex = rng.uniform(0, 1, (16, 16)).astype(np.float32)
+    res = modes.texture_synthesis(
+        tex, (12, 14), PRESETS["texture_synthesis"].replace(levels=1))
+    assert res.bp.shape == (12, 14)
+    # every output pixel is copied verbatim from the exemplar
+    assert np.isin(res.bp.ravel(), tex.ravel()).all()
+
+
+def test_video_two_phase_and_sequential(small):
+    a, ap, _ = small
+    r = np.random.default_rng(0)
+    frames = [np.clip(a + 0.02 * t + 0.01 * r.standard_normal(a.shape), 0, 1)
+              .astype(np.float32) for t in range(3)]
+    p = _params(temporal_weight=1.0)
+    res2 = video_analogy(a, ap, frames, p, scheme="two_phase")
+    assert len(res2.frames) == 3
+    phases = {s["phase"] for s in res2.stats}
+    assert phases == {"phase1", "phase2"}
+    res_seq = video_analogy(a, ap, frames, p, scheme="sequential")
+    assert len(res_seq.frames) == 3
+    with pytest.raises(ValueError):
+        video_analogy(a, ap, frames, p, scheme="bogus")
+
+
+def test_video_temporal_term_increases_frame_coherence(small):
+    """With a strong temporal term, consecutive output frames of a static
+    scene must be closer than without it."""
+    a, ap, _ = small
+    r = np.random.default_rng(1)
+    frames = [np.clip(a + 0.04 * r.standard_normal(a.shape), 0, 1)
+              .astype(np.float32) for _ in range(2)]
+    p0 = _params(temporal_weight=0.0)
+    pt = _params(temporal_weight=8.0)
+    r0 = video_analogy(a, ap, frames, p0, scheme="sequential")
+    rt = video_analogy(a, ap, frames, pt, scheme="sequential")
+    d0 = np.abs(r0.frames_y[1] - r0.frames_y[0]).mean()
+    dt = np.abs(rt.frames_y[1] - rt.frames_y[0]).mean()
+    assert dt <= d0 + 1e-6, (dt, d0)
+
+
+def test_temporal_spec_only_with_prev_frame():
+    p = AnalogyParams(temporal_weight=1.0)
+    s_on = spec_for_level(p, 0, 1, 1, temporal=True)
+    s_off = spec_for_level(p, 0, 1, 1, temporal=False)
+    assert s_on.temporal_n > 0 and s_off.temporal_n == 0

@@ -1,0 +1,84 @@
+"""Sharded patch-DB argmin on the 8-device virtual CPU mesh (SURVEY.md §4.5).
+
+Exercises the `lax.pmin`+index all-reduce logic without a pod: conftest forces
+XLA_FLAGS=--xla_force_host_platform_device_count=8.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.models.analogy import create_image_analogy
+from image_analogies_tpu.ops.pallas_match import xla_argmin_l2
+from image_analogies_tpu.parallel.mesh import make_mesh
+from image_analogies_tpu.parallel.sharded_match import (
+    make_sharded_argmin,
+    shard_db,
+)
+from image_analogies_tpu.utils.ssim import ssim
+from tests.conftest import make_pair
+
+
+def test_mesh_shape():
+    assert jax.device_count() == 8, "conftest must provide 8 virtual devices"
+    mesh = make_mesh(db_shards=4, data_shards=2)
+    assert mesh.shape == {"data": 2, "db": 4}
+    with pytest.raises(ValueError):
+        make_mesh(db_shards=16)
+
+
+@pytest.mark.parametrize("shards", [1, 2, 4, 8])
+@pytest.mark.parametrize("n", [64, 100])  # 100: padding rows in play
+def test_sharded_argmin_matches_single_device(shards, n, rng):
+    f, m = 40, 16
+    db = jnp.asarray(rng.standard_normal((n, f)), jnp.float32)
+    dbn = jnp.sum(db * db, axis=1)
+    q = jnp.asarray(rng.standard_normal((m, f)), jnp.float32)
+
+    ref_idx, ref_d = xla_argmin_l2(q, db, dbn)
+
+    mesh = make_mesh(db_shards=shards)
+    db_sh, dbn_sh = shard_db(db, dbn, mesh)
+    fn = make_sharded_argmin(mesh, force_xla=True)
+    idx, d = fn(q, db_sh, dbn_sh)
+
+    np.testing.assert_allclose(np.asarray(d), np.asarray(ref_d), atol=1e-3)
+    # indices agree except on fp ties; where they differ, distances must tie
+    ii, ri = np.asarray(idx), np.asarray(ref_idx)
+    diff = ii != ri
+    if diff.any():
+        np.testing.assert_allclose(np.asarray(d)[diff],
+                                   np.asarray(ref_d)[diff], atol=1e-3)
+
+
+def test_sharded_argmin_tie_break_lowest_index(rng):
+    """Duplicate rows across shards: the LOWEST global index must win,
+    matching the single-chip kernel's tie-break."""
+    f = 8
+    row = rng.standard_normal(f).astype(np.float32)
+    db = np.tile(row, (16, 1)).astype(np.float32)  # all rows identical
+    dbn = jnp.sum(jnp.asarray(db) ** 2, axis=1)
+    q = jnp.asarray(row[None, :] + 0.01)
+    mesh = make_mesh(db_shards=4)
+    db_sh, dbn_sh = shard_db(jnp.asarray(db), dbn, mesh)
+    fn = make_sharded_argmin(mesh, force_xla=True)
+    idx, _ = fn(q, db_sh, dbn_sh)
+    assert int(idx[0]) == 0
+
+
+def test_end_to_end_sharded_matches_unsharded(rng):
+    """db_shards=4 on the virtual mesh must reproduce the single-device
+    batched output exactly (same candidates, same tie-breaks)."""
+    a, ap, b = make_pair(20, 20, seed=7)
+    p1 = AnalogyParams(levels=2, kappa=2.0, backend="tpu",
+                       strategy="batched", db_shards=1)
+    p4 = p1.replace(db_shards=4)
+    r1 = create_image_analogy(a, ap, b, p1)
+    r4 = create_image_analogy(a, ap, b, p4)
+    sv = ssim(r1.bp_y, r4.bp_y, data_range=1.0)
+    assert sv >= 0.99, f"sharded-vs-unsharded SSIM {sv}"
+    agree = (r1.source_map == r4.source_map).mean()
+    assert agree >= 0.95, f"source-map agreement {agree}"
