@@ -1,0 +1,74 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Measures the classic A:A'::B:B' filter config (BASELINE.json config 2 shape:
+256x256, 3-level pyramid, kappa=5) end-to-end on the TPU backend (batched
+strategy, Pallas fused argmin) and on the reference-equivalent NumPy/cKDTree
+CPU oracle, on this machine.
+
+    metric      : config + hardware
+    value       : TPU wall-clock (warm, compile excluded), seconds
+    vs_baseline : CPU-oracle wall-clock / TPU wall-clock  (the ">= 50x the
+                  NumPy/cKDTree path" axis of BASELINE.json:5; >1 = faster)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def make_inputs(h: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    yy, xx = np.meshgrid(np.linspace(0, 1, h), np.linspace(0, 1, h),
+                         indexing="ij")
+    base = 0.5 * yy + 0.5 * xx
+    a = (base + 0.08 * rng.standard_normal((h, h))).clip(0, 1).astype(
+        np.float32)
+    ap = (np.round(a * 6) / 6).astype(np.float32)
+    b = (0.35 * yy ** 2 + 0.65 * xx
+         + 0.08 * rng.standard_normal((h, h))).clip(0, 1).astype(np.float32)
+    return a, ap, b
+
+
+def main() -> int:
+    import jax
+
+    from image_analogies_tpu.config import AnalogyParams
+    from image_analogies_tpu.models.analogy import create_image_analogy
+
+    size = 256
+    levels = 3
+    kappa = 5.0
+    a, ap, b = make_inputs(size)
+
+    p_tpu = AnalogyParams(levels=levels, kappa=kappa, backend="tpu",
+                          strategy="batched")
+    # warm-up: compile every level's scan once
+    create_image_analogy(a, ap, b, p_tpu)
+    t0 = time.perf_counter()
+    res_tpu = create_image_analogy(a, ap, b, p_tpu)
+    tpu_s = time.perf_counter() - t0
+
+    p_cpu = AnalogyParams(levels=levels, kappa=kappa, backend="cpu")
+    t0 = time.perf_counter()
+    create_image_analogy(a, ap, b, p_cpu)
+    cpu_s = time.perf_counter() - t0
+
+    dev = jax.devices()[0].device_kind
+    print(json.dumps({
+        "metric": f"{size}x{size} B' synthesis wall-clock, {levels}-level "
+                  f"pyramid, kappa={kappa} (oil-filter config) on {dev}",
+        "value": round(tpu_s, 3),
+        "unit": "s",
+        "vs_baseline": round(cpu_s / tpu_s, 2),
+    }))
+    print(f"# cpu_oracle={cpu_s:.2f}s tpu={tpu_s:.2f}s "
+          f"levels={[s['ms'] for s in res_tpu.stats]}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

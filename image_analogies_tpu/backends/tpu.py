@@ -239,18 +239,17 @@ def _left_refine(db: TpuLevelDB, queries, p, d_pick, d_app, kappa_mult):
     return best_p.astype(jnp.int32), best_d
 
 
-@jax.jit
-def _run_batched(db: TpuLevelDB, kappa_mult):
+def batched_scan_core(db: TpuLevelDB, kappa_mult, approx_fn):
+    """The batched level scan given an approximate-match function.
+
+    `approx_fn(queries (W,F)) -> (idx, sqdist)` is the pluggable piece: the
+    local fused Pallas kernel, or its mesh-sharded variant (local kernel +
+    min/argmin all-reduce over the 'db' axis — parallel/step.py calls this
+    core from inside shard_map for the multi-chip video step).
+    """
     nf = int(db.off.shape[0])
     nrs = db.n_rowsafe
     wb, hb = db.wb, db.hb
-
-    if db.sharded_argmin is not None:
-        def approx_fn(queries):
-            return db.sharded_argmin(queries, db.db_sharded, db.dbn_sharded)
-    else:
-        def approx_fn(queries):
-            return argmin_l2(queries, db.db_rowsafe, db.db_rowsafe_sqnorm)
 
     off_i = db.off[:nrs, 0]
     off_j = db.off[:nrs, 1]
@@ -296,6 +295,18 @@ def _run_batched(db: TpuLevelDB, kappa_mult):
     bp0 = jnp.zeros((hb * wb,), _F32)
     s0 = jnp.zeros((hb * wb,), jnp.int32)
     return jax.lax.fori_loop(0, hb, row_body, (bp0, s0, jnp.int32(0)))
+
+
+@jax.jit
+def _run_batched(db: TpuLevelDB, kappa_mult):
+    if db.sharded_argmin is not None:
+        def approx_fn(queries):
+            return db.sharded_argmin(queries, db.db_sharded, db.dbn_sharded)
+    else:
+        def approx_fn(queries):
+            return argmin_l2(queries, db.db_rowsafe, db.db_rowsafe_sqnorm)
+
+    return batched_scan_core(db, kappa_mult, approx_fn)
 
 
 _RUNNERS = {
