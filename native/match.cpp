@@ -1,0 +1,42 @@
+// Native brute-force L2 argmin matcher for the CPU backend.
+//
+// The reference's hot path lives in SciPy's C/Cython cKDTree (SURVEY.md §2.2
+// N1); this is the framework's native equivalent for the ANN-off path:
+// exact nearest rows of a (n x f) float32 database for a batch of queries,
+// OpenMP-parallel over queries, blocked over DB rows for cache locality,
+// ties resolved to the lowest index (matching the Pallas kernel and the
+// NumPy fallback in backends/native_match.py).
+//
+// Build: make -C native        (produces libia_match.so, loaded via ctypes)
+
+#include <cfloat>
+#include <cstdint>
+
+extern "C" {
+
+void ia_brute_argmin(const float *db, int64_t n, int64_t f,
+                     const float *queries, int64_t m,
+                     int64_t *out_idx, float *out_dist) {
+#pragma omp parallel for schedule(static)
+  for (int64_t q = 0; q < m; ++q) {
+    const float *qv = queries + q * f;
+    float best = FLT_MAX;
+    int64_t best_i = 0;
+    for (int64_t i = 0; i < n; ++i) {
+      const float *row = db + i * f;
+      float acc = 0.0f;
+      for (int64_t k = 0; k < f; ++k) {
+        const float d = row[k] - qv[k];
+        acc += d * d;
+      }
+      if (acc < best) {  // strict: first minimum wins -> lowest index
+        best = acc;
+        best_i = i;
+      }
+    }
+    out_idx[q] = best_i;
+    out_dist[q] = best;
+  }
+}
+
+}  // extern "C"

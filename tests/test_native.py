@@ -1,0 +1,40 @@
+"""Native C++ brute-force matcher (native/match.cpp) and its NumPy fallback."""
+
+import numpy as np
+import pytest
+
+from image_analogies_tpu.backends import native_match as nm
+
+
+def _oracle(db, qs):
+    sc = ((db[None, :, :] - qs[:, None, :]) ** 2).sum(-1)
+    return sc.argmin(1), sc.min(1)
+
+
+def test_numpy_fallback_matches_oracle(rng, monkeypatch):
+    monkeypatch.setattr(nm, "_LIB", None)
+    monkeypatch.setattr(nm, "_TRIED", True)
+    db = rng.standard_normal((500, 23)).astype(np.float32)
+    qs = rng.standard_normal((17, 23)).astype(np.float32)
+    idx, dist = nm.brute_argmin_batch(db, qs)
+    ri, rd = _oracle(db, qs)
+    np.testing.assert_array_equal(idx, ri)
+    np.testing.assert_allclose(dist, rd, atol=1e-3)
+
+
+@pytest.mark.skipif(not nm.have_native(), reason="libia_match.so not built")
+def test_native_matches_oracle(rng):
+    db = rng.standard_normal((1000, 40)).astype(np.float32)
+    qs = rng.standard_normal((29, 40)).astype(np.float32)
+    idx, dist = nm.brute_argmin_batch(db, qs)
+    ri, rd = _oracle(db, qs)
+    np.testing.assert_array_equal(idx, ri)
+    np.testing.assert_allclose(dist, rd, atol=1e-3)
+
+
+@pytest.mark.skipif(not nm.have_native(), reason="libia_match.so not built")
+def test_native_tie_break_lowest_index(rng):
+    row = rng.standard_normal(8).astype(np.float32)
+    db = np.tile(row, (32, 1))
+    idx, _ = nm.brute_argmin_batch(db, row[None, :] + 0.01)
+    assert idx[0] == 0
