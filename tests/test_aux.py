@@ -1,0 +1,75 @@
+"""Aux subsystems (SURVEY.md §5): checkpoint/resume, structured logging,
+profiling hook, SSIM metric."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.models.analogy import create_image_analogy
+from image_analogies_tpu.utils import checkpoint as ckpt
+from image_analogies_tpu.utils.ssim import ssim
+from tests.conftest import make_pair
+
+
+def test_checkpoint_roundtrip(tmp_path, rng):
+    bp = rng.uniform(0, 1, (8, 9)).astype(np.float32)
+    s = rng.integers(0, 72, (8, 9)).astype(np.int32)
+    ckpt.save_level(str(tmp_path), 2, bp, s)
+    out = ckpt.load_level(str(tmp_path), 2)
+    assert out is not None
+    np.testing.assert_array_equal(out[0], bp)
+    np.testing.assert_array_equal(out[1], s)
+    assert ckpt.load_level(str(tmp_path), 3) is None
+
+
+def test_resume_reuses_coarse_levels(tmp_path, rng):
+    a, ap, b = make_pair(16, 16, seed=5)
+    log1 = str(tmp_path / "log1.jsonl")
+    log2 = str(tmp_path / "log2.jsonl")
+    p = AnalogyParams(levels=2, backend="cpu",
+                      checkpoint_dir=str(tmp_path / "ck"), log_path=log1)
+    r1 = create_image_analogy(a, ap, b, p)
+    p2 = p.replace(resume_from_level=0, log_path=log2)
+    r2 = create_image_analogy(a, ap, b, p2)
+    np.testing.assert_array_equal(r1.bp_y, r2.bp_y)
+    recs = [json.loads(l) for l in open(log2)]
+    assert any(r.get("event") == "resume_level" for r in recs)
+
+
+def test_structured_log_records(tmp_path, rng):
+    a, ap, b = make_pair(12, 12, seed=5)
+    log = str(tmp_path / "log.jsonl")
+    p = AnalogyParams(levels=2, backend="cpu", log_path=log)
+    create_image_analogy(a, ap, b, p)
+    recs = [json.loads(l) for l in open(log)]
+    assert len(recs) == 2
+    for r in recs:
+        for key in ("level", "db_rows", "pixels", "coherence_ratio", "ms",
+                    "backend", "ts"):
+            assert key in r, key
+
+
+def test_profile_dir_writes_trace(tmp_path, rng):
+    a, ap, b = make_pair(12, 12, seed=5)
+    prof = str(tmp_path / "prof")
+    p = AnalogyParams(levels=1, backend="tpu", strategy="batched",
+                      profile_dir=prof)
+    create_image_analogy(a, ap, b, p)
+    found = []
+    for root, _, files in os.walk(prof):
+        found.extend(files)
+    assert found, "profiler produced no trace files"
+
+
+def test_ssim_properties(rng):
+    x = rng.uniform(0, 1, (32, 32))
+    assert ssim(x, x) == pytest.approx(1.0, abs=1e-9)
+    noisy = np.clip(x + 0.2 * rng.standard_normal(x.shape), 0, 1)
+    v = ssim(x, noisy)
+    assert 0.0 < v < 0.95
+    assert ssim(x, noisy) > ssim(x, 1.0 - x)
+    with pytest.raises(ValueError):
+        ssim(x, x[:16])
