@@ -48,7 +48,12 @@ from image_analogies_tpu.ops.features import (
     fine_gather_maps,
     window_offsets,
 )
-from image_analogies_tpu.ops.pallas_match import argmin_l2
+from image_analogies_tpu.ops.pallas_match import (
+    argmin_l2,
+    pallas_argmin_l2_prepadded,
+)
+
+_ARGMIN_TILE = 2048
 
 _F32 = jnp.float32
 _HIGHEST = jax.lax.Precision.HIGHEST
@@ -78,6 +83,11 @@ class TpuLevelDB:
     off: jax.Array  # (nf, 2) int32 window offsets
     db_sharded: Optional[jax.Array]  # (Npad, F) laid out over mesh 'db' axis
     dbn_sharded: Optional[jax.Array]
+    # Pre-padded rowsafe DB for the hot loop (tile-aligned rows, 128-aligned
+    # features, +inf norms on padding) — pads ONCE per level instead of every
+    # scan row inside the fori_loop.
+    db_pad: Optional[jax.Array]  # (Npad128, Fp)
+    dbn_pad: Optional[jax.Array]  # (1, Npad128)
     ha: int = field(metadata=dict(static=True))
     wa: int = field(metadata=dict(static=True))
     hb: int = field(metadata=dict(static=True))
@@ -105,6 +115,65 @@ def _cached_sharded_argmin(mesh, force_xla: bool):
     from image_analogies_tpu.parallel.sharded_match import make_sharded_argmin
 
     return make_sharded_argmin(mesh, force_xla=force_xla)
+
+
+@functools.lru_cache(maxsize=64)
+def _gather_maps_device(h: int, w: int, p: int):
+    """Device-computed twin of ops.features.fine_gather_maps.
+
+    These maps are (H*W, p*p) — ~100 MB each at 1024^2.  Computing them from
+    iota on the device (and caching per shape) avoids shipping hundreds of MB
+    from the host per level, which dominated level wall-clock over the PJRT
+    tunnel.  Semantics are locked to the NumPy twin by
+    tests/test_backend_equivalence.py::test_device_gather_maps_match_numpy.
+    """
+    off = jnp.asarray(window_offsets(p))  # (n,2) tiny host->device
+    n = p * p
+    ii = jax.lax.broadcasted_iota(jnp.int32, (h, w), 0).reshape(-1, 1)
+    jj = jax.lax.broadcasted_iota(jnp.int32, (h, w), 1).reshape(-1, 1)
+    qi = ii + off[None, :, 0].reshape(1, n)
+    qj = jj + off[None, :, 1].reshape(1, n)
+    inb = (qi >= 0) & (qi < h) & (qj >= 0) & (qj < w)
+    flat = jnp.clip(qi, 0, h - 1) * w + jnp.clip(qj, 0, w - 1)
+    causal = jnp.asarray(causal_mask(p) > 0)[None, :]
+    q = ii * w + jj
+    valid = (inb & causal).astype(jnp.float32)
+    written = (causal & (flat < q)).astype(jnp.float32)
+    return (jax.device_put(flat.astype(jnp.int32)),
+            jax.device_put(valid), jax.device_put(written))
+
+
+@functools.partial(jax.jit, static_argnames=("spec", "pad_tile"))
+def _prepare_level_arrays(
+    spec, a_src, a_filt, a_src_coarse, a_filt_coarse, a_temporal,
+    b_src, b_src_coarse, b_filt_coarse, b_temporal, rowsafe, pad_tile,
+):
+    """All device-side level preparation fused into ONE program: eager
+    per-op dispatch over the PJRT tunnel costs ~1s/level otherwise."""
+    db = build_features_jax(spec, a_src, a_filt, a_src_coarse, a_filt_coarse,
+                            temporal_fine=a_temporal)
+    static_q = build_features_jax(spec, b_src, None, b_src_coarse,
+                                  b_filt_coarse, temporal_fine=b_temporal)
+    fsl = spec.fine_filt_slice
+    db_rowsafe = db.at[:, fsl].multiply(rowsafe[None, :])
+    out = {
+        "db": db,
+        "db_sqnorm": jnp.sum(db * db, axis=1),
+        "db_rowsafe": db_rowsafe,
+        "db_rowsafe_sqnorm": jnp.sum(db_rowsafe * db_rowsafe, axis=1),
+        "static_q": static_q,
+        "a_filt_flat": a_filt.reshape(-1),
+        "db_pad": None,
+        "dbn_pad": None,
+    }
+    if pad_tile:
+        n, f = db_rowsafe.shape
+        fp = max((f + 127) // 128 * 128, 128)
+        npad = (n + pad_tile - 1) // pad_tile * pad_tile
+        out["db_pad"] = jnp.zeros((npad, fp), _F32).at[:n, :f].set(db_rowsafe)
+        out["dbn_pad"] = jnp.full((1, npad), jnp.inf, _F32).at[0, :n].set(
+            out["db_rowsafe_sqnorm"])
+    return out
 
 
 # --------------------------------------------------------------- exact scan
@@ -302,6 +371,16 @@ def _run_batched(db: TpuLevelDB, kappa_mult):
     if db.sharded_argmin is not None:
         def approx_fn(queries):
             return db.sharded_argmin(queries, db.db_sharded, db.dbn_sharded)
+    elif db.db_pad is not None:
+        def approx_fn(queries):
+            m, f = queries.shape
+            mp = (m + 7) // 8 * 8
+            fp = db.db_pad.shape[1]
+            qp = jnp.zeros((mp, fp), _F32).at[:m, :f].set(queries)
+            idx, score = pallas_argmin_l2_prepadded(
+                qp, db.db_pad, db.dbn_pad, tile_n=_ARGMIN_TILE)
+            qn = jnp.sum(queries * queries, axis=1)
+            return idx[:m], jnp.maximum(score[:m] + qn, 0.0)
     else:
         def approx_fn(queries):
             return argmin_l2(queries, db.db_rowsafe, db.db_rowsafe_sqnorm)
@@ -323,15 +402,9 @@ class TpuMatcher(Matcher):
     def build_features(self, job: LevelJob) -> TpuLevelDB:
         spec = job.spec
         to_j = lambda x: None if x is None else jnp.asarray(x, _F32)
-        db = build_features_jax(
-            spec, to_j(job.a_src), to_j(job.a_filt), to_j(job.a_src_coarse),
-            to_j(job.a_filt_coarse), temporal_fine=to_j(job.a_temporal))
-        static_q = build_features_jax(
-            spec, to_j(job.b_src), None, to_j(job.b_src_coarse),
-            to_j(job.b_filt_coarse), temporal_fine=to_j(job.b_temporal))
         hb, wb = job.b_shape
         ha, wa = job.a_shape
-        flat_idx, valid, written = fine_gather_maps(hb, wb, spec.fine_size)
+        flat_idx, valid, written = _gather_maps_device(hb, wb, spec.fine_size)
         off = window_offsets(spec.fine_size)
         # rows-above-only subset of the causal window: known at row start.
         rowsafe = ((off[:, 0] < 0).astype(np.float32)
@@ -341,36 +414,48 @@ class TpuMatcher(Matcher):
         if strategy == "auto":
             strategy = "batched"
 
-        fsl = spec.fine_filt_slice
-        db_rowsafe = db.at[:, fsl].multiply(jnp.asarray(rowsafe)[None, :])
-        db_rowsafe_sqnorm = jnp.sum(db_rowsafe * db_rowsafe, axis=1)
+        sharded = self.params.db_shards > 1 and strategy == "batched"
+        pad_tile = 0
+        if strategy == "batched" and not sharded \
+                and jax.default_backend() == "tpu":
+            na = ha * wa
+            pad_tile = min(_ARGMIN_TILE, max((na + 127) // 128 * 128, 128))
+
+        arrs = _prepare_level_arrays(
+            spec, to_j(job.a_src), to_j(job.a_filt), to_j(job.a_src_coarse),
+            to_j(job.a_filt_coarse), to_j(job.a_temporal), to_j(job.b_src),
+            to_j(job.b_src_coarse), to_j(job.b_filt_coarse),
+            to_j(job.b_temporal), jnp.asarray(rowsafe), pad_tile)
 
         sharded_argmin = db_sharded = dbn_sharded = None
-        if self.params.db_shards > 1 and strategy == "batched":
+        if sharded:
             from image_analogies_tpu.parallel.mesh import make_mesh
             from image_analogies_tpu.parallel.sharded_match import shard_db
 
             mesh = make_mesh(db_shards=self.params.db_shards)
             db_sharded, dbn_sharded = shard_db(
-                db_rowsafe, db_rowsafe_sqnorm, mesh)
+                arrs["db_rowsafe"], arrs["db_rowsafe_sqnorm"], mesh)
             sharded_argmin = _cached_sharded_argmin(
                 mesh, jax.default_backend() != "tpu")
 
+        fsl = spec.fine_filt_slice
         return TpuLevelDB(
-            db=db,
-            db_sqnorm=jnp.sum(db * db, axis=1),
-            db_rowsafe=db_rowsafe,
-            db_rowsafe_sqnorm=db_rowsafe_sqnorm,
-            static_q=static_q,
-            flat_idx=jnp.asarray(flat_idx),
-            valid=jnp.asarray(valid),
-            written=jnp.asarray(written),
+            db=arrs["db"],
+            db_sqnorm=arrs["db_sqnorm"],
+            db_rowsafe=arrs["db_rowsafe"],
+            db_rowsafe_sqnorm=arrs["db_rowsafe_sqnorm"],
+            static_q=arrs["static_q"],
+            flat_idx=flat_idx,
+            valid=valid,
+            written=written,
             rowsafe=jnp.asarray(rowsafe),
-            a_filt_flat=jnp.asarray(job.a_filt, _F32).reshape(-1),
+            a_filt_flat=arrs["a_filt_flat"],
             fine_sqrtw=jnp.asarray(spec.sqrt_weights()[fsl]),
             off=jnp.asarray(off),
             db_sharded=db_sharded,
             dbn_sharded=dbn_sharded,
+            db_pad=arrs["db_pad"],
+            dbn_pad=arrs["dbn_pad"],
             ha=ha,
             wa=wa,
             hb=hb,

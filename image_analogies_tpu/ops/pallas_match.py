@@ -104,8 +104,33 @@ def pallas_argmin_l2(
     dbp = jnp.zeros((npad, fp), comp).at[:n, :f].set(db.astype(comp))
     dbn = jnp.full((1, npad), jnp.inf, _F32).at[0, :n].set(db_sqnorm)
 
+    idx, val = pallas_argmin_l2_prepadded(q, dbp, dbn, tile_n=tile_n,
+                                          interpret=interpret)
+    qn = jnp.sum(queries * queries, axis=1)
+    dist = jnp.maximum(val[:m] + qn, 0.0)
+    return idx[:m], dist
+
+
+@functools.partial(jax.jit, static_argnames=("tile_n", "interpret"))
+def pallas_argmin_l2_prepadded(
+    q: jax.Array,  # (Mp, Fp) already tile-aligned
+    dbp: jax.Array,  # (Npad, Fp) already tile-aligned (zero feature padding)
+    dbn: jax.Array,  # (1, Npad) squared norms, +inf on padding rows
+    *,
+    tile_n: int = 2048,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Padding-free kernel entry for hot loops: callers pre-pad ONCE per
+    level (backends/tpu.py) so the per-row scan doesn't re-copy the DB.
+
+    Returns (idx (Mp,) int32, min_score (Mp,) = dist - ||q||^2)."""
+    mp, fp = q.shape
+    npad = dbp.shape[0]
+    tile_n = min(tile_n, npad)
+    assert npad % tile_n == 0, (npad, tile_n)
+
     grid = npad // tile_n
-    kernel = functools.partial(_argmin_kernel, tile_n=tile_n, n_total=n)
+    kernel = functools.partial(_argmin_kernel, tile_n=tile_n, n_total=npad)
     idx, val = pl.pallas_call(
         kernel,
         grid=(grid,),
@@ -138,10 +163,7 @@ def pallas_argmin_l2(
         ),
         interpret=interpret,
     )(q, dbp, dbn)
-
-    qn = jnp.sum(queries * queries, axis=1)
-    dist = jnp.maximum(val[:m, 0] + qn, 0.0)
-    return idx[:m, 0], dist
+    return idx[:, 0], val[:, 0]
 
 
 def xla_argmin_l2(queries: jax.Array, db: jax.Array,

@@ -114,6 +114,19 @@ def test_exact_strategy_matches_oracle_picks(rng):
     assert agree > 0.9, f"source map agreement {agree}"
 
 
+def test_device_gather_maps_match_numpy():
+    """The device-computed gather maps must equal the NumPy spec twin."""
+    from image_analogies_tpu.backends.tpu import _gather_maps_device
+    from image_analogies_tpu.ops.features import fine_gather_maps
+
+    for (h, w, p) in [(7, 9, 5), (4, 5, 3), (16, 16, 7)]:
+        flat_np, valid_np, written_np = fine_gather_maps(h, w, p)
+        flat_d, valid_d, written_d = _gather_maps_device(h, w, p)
+        np.testing.assert_array_equal(np.asarray(flat_d), flat_np)
+        np.testing.assert_array_equal(np.asarray(valid_d), valid_np)
+        np.testing.assert_array_equal(np.asarray(written_d), written_np)
+
+
 def test_single_level_texture_by_numbers_tpu(rng):
     """BASELINE config 1 shape: single-scale, source_rgb, on the TPU path."""
     r = np.random.default_rng(0)
