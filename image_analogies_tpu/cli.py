@@ -90,21 +90,22 @@ def cmd_run(args) -> int:
     }[mode]
     params = _params_from_args(args, base)
 
+    ap = load_image(args.ap)
     if mode == "texture_synthesis":
-        ap = load_image(args.ap)
         shape = tuple(int(x) for x in args.out_shape.split("x"))
         res = modes.texture_synthesis(ap, shape, params)
+    elif mode == "super_resolution":
+        # A is derived by degrading A'; only A' and B are needed.
+        b = load_image(args.b)
+        res = modes.super_resolution(ap, b, params,
+                                     blur_passes=args.blur_passes)
     else:
         a = load_image(args.a)
-        ap = load_image(args.ap)
         b = load_image(args.b)
         if mode == "filter":
             res = modes.artistic_filter(a, ap, b, params)
-        elif mode == "texture_by_numbers":
-            res = modes.texture_by_numbers(a, ap, b, params)
         else:
-            res = modes.super_resolution(ap, b, params,
-                                         blur_passes=args.blur_passes)
+            res = modes.texture_by_numbers(a, ap, b, params)
     save_image(args.out, res.bp)
     _emit_stats(res)
     print(args.out)
@@ -178,8 +179,11 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
-    if args.cmd == "run" and args.mode != "texture_synthesis":
-        missing = [k for k in ("a", "b") if getattr(args, k) is None]
+    if args.cmd == "run":
+        required = {"filter": ("a", "b"), "texture_by_numbers": ("a", "b"),
+                    "super_resolution": ("b",), "texture_synthesis": ()}
+        missing = [k for k in required[args.mode]
+                   if getattr(args, k) is None]
         if missing:
             build_parser().error(
                 f"--{' --'.join(missing)} required for mode {args.mode}")
