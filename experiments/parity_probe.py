@@ -1,0 +1,82 @@
+"""Parity probe: SSIM(strategy, oracle) on structured inputs.
+
+Measures, for each TPU strategy, how closely it tracks the CPU/cKDTree
+oracle on perlin-like natural-statistics inputs (VERDICT.md round-1 item 1:
+the bench's white-noise inputs made the task ambiguous everywhere and the
+parity number meaningless).  Run on the forced-CPU JAX platform so it probes
+semantics, not chip perf:
+
+    python experiments/parity_probe.py [--size 128] [--levels 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+try:
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+except RuntimeError:
+    pass
+
+import numpy as np
+
+from examples.make_assets import _oil_filter, _perlin_ish
+from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.models.analogy import create_image_analogy
+from image_analogies_tpu.utils.ssim import ssim
+
+
+def make_structured(h: int, seed: int = 7):
+    rng = np.random.default_rng(seed)
+    a = _perlin_ish(h, h, rng)
+    ap = _oil_filter(a)
+    b = _perlin_ish(h, h, rng)
+    return a, ap, b
+
+
+def main() -> int:
+    ap_ = argparse.ArgumentParser()
+    ap_.add_argument("--size", type=int, default=128)
+    ap_.add_argument("--levels", type=int, default=3)
+    ap_.add_argument("--kappa", type=float, default=5.0)
+    ap_.add_argument("--strategies", default="rowwise,batched")
+    ap_.add_argument("--seeds", default="7")
+    args = ap_.parse_args()
+
+    for seed in [int(s) for s in args.seeds.split(",")]:
+        a, ap, b = make_structured(args.size, seed)
+        ideal = _oil_filter(b)
+
+        base = dict(levels=args.levels, kappa=args.kappa)
+        t0 = time.perf_counter()
+        oracle = create_image_analogy(
+            a, ap, b, AnalogyParams(backend="cpu", **base))
+        t_oracle = time.perf_counter() - t0
+        print(f"seed={seed} oracle: {t_oracle:.1f}s "
+              f"ssim_vs_ideal={ssim(oracle.bp_y, ideal):.3f} "
+              f"coh={[round(s['coherence_ratio'], 2) for s in oracle.stats]}")
+
+        for strat in args.strategies.split(","):
+            t0 = time.perf_counter()
+            res = create_image_analogy(
+                a, ap, b,
+                AnalogyParams(backend="tpu", strategy=strat, **base))
+            dt = time.perf_counter() - t0
+            print(f"seed={seed} {strat:>10}: {dt:.1f}s "
+                  f"ssim_vs_oracle={ssim(res.bp_y, oracle.bp_y):.3f} "
+                  f"ssim_vs_ideal={ssim(res.bp_y, ideal):.3f} "
+                  f"coh={[round(s['coherence_ratio'], 2) for s in res.stats]}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

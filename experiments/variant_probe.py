@@ -1,0 +1,70 @@
+"""Probe strategy variants for parity (throwaway experiment harness).
+
+V1 "fulldb": replace the rowsafe-masked DB with the FULL db in the level DB,
+so approx + coherence score against the oracle's metric (full A/A' rows vs
+zero-masked queries) instead of the symmetric masked metric.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+try:
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+except RuntimeError:
+    pass
+
+import numpy as np
+
+from experiments.parity_probe import make_structured
+from examples.make_assets import _oil_filter
+from image_analogies_tpu.backends.tpu import TpuMatcher
+from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.models.analogy import create_image_analogy
+from image_analogies_tpu.utils.ssim import ssim
+
+
+class FullDbMatcher(TpuMatcher):
+    def build_features(self, job):
+        db = super().build_features(job)
+        return dataclasses.replace(
+            db, db_rowsafe=db.db, db_rowsafe_sqnorm=db.db_sqnorm)
+
+
+def main() -> int:
+    ap_ = argparse.ArgumentParser()
+    ap_.add_argument("--size", type=int, default=128)
+    ap_.add_argument("--levels", type=int, default=3)
+    ap_.add_argument("--kappa", type=float, default=5.0)
+    ap_.add_argument("--seed", type=int, default=7)
+    args = ap_.parse_args()
+
+    a, ap, b = make_structured(args.size, args.seed)
+    ideal = _oil_filter(b)
+    base = dict(levels=args.levels, kappa=args.kappa)
+
+    oracle = create_image_analogy(a, ap, b, AnalogyParams(backend="cpu", **base))
+    print(f"oracle ssim_vs_ideal={ssim(oracle.bp_y, ideal):.3f}")
+
+    for strat in ("rowwise", "batched"):
+        p = AnalogyParams(backend="tpu", strategy=strat, **base)
+        t0 = time.perf_counter()
+        res = create_image_analogy(a, ap, b, p, backend=FullDbMatcher(p))
+        dt = time.perf_counter() - t0
+        print(f"fulldb-{strat:>8}: {dt:.1f}s "
+              f"ssim_vs_oracle={ssim(res.bp_y, oracle.bp_y):.3f} "
+              f"ssim_vs_ideal={ssim(res.bp_y, ideal):.3f}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

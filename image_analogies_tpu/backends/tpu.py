@@ -26,7 +26,14 @@ Strategies (see config.AnalogyParams.strategy):
   sharded over the mesh 'db' axis when db_shards > 1), one batched coherence
   gather, then `refine_passes` cheap vectorized passes that restore same-row
   left-propagation of the source map (the dominant coherence mechanism).
-  SSIM-validated against the oracle (SURVEY.md §7 hard part 1).
+  Fastest; a different-but-comparable synthesis vs the oracle.
+- "wavefront": the PARITY fast path (VERDICT.md round-1 item 1).  Per row:
+  batched full-DB Pallas argmin anchors + a sequential coherence/kappa pass
+  (the oracle's exact per-pixel rule), iterated Gauss-Seidel style with
+  queries rebuilt from the current row estimate until the row's source map
+  reaches its fixed point.  The oracle's sequential output IS such a fixed
+  point, and measured SSIM vs the oracle is 1.000 at 96-128² structured
+  inputs (experiments/gs_probe.py) vs ~0.6 for batched/rowwise.
 """
 
 from __future__ import annotations
@@ -143,13 +150,19 @@ def _gather_maps_device(h: int, w: int, p: int):
             jax.device_put(valid), jax.device_put(written))
 
 
-@functools.partial(jax.jit, static_argnames=("spec", "pad_tile"))
+@functools.partial(jax.jit, static_argnames=("spec", "pad_tile", "pad_full"))
 def _prepare_level_arrays(
     spec, a_src, a_filt, a_src_coarse, a_filt_coarse, a_temporal,
     b_src, b_src_coarse, b_filt_coarse, b_temporal, rowsafe, pad_tile,
+    pad_full=False,
 ):
     """All device-side level preparation fused into ONE program: eager
-    per-op dispatch over the PJRT tunnel costs ~1s/level otherwise."""
+    per-op dispatch over the PJRT tunnel costs ~1s/level otherwise.
+
+    ``pad_full`` selects which DB the pre-padded argmin tiles score against:
+    the rowsafe-masked DB (batched strategy's symmetric metric) or the FULL
+    DB (wavefront strategy — the oracle's metric: full A/A' rows vs
+    zero-masked queries)."""
     db = build_features_jax(spec, a_src, a_filt, a_src_coarse, a_filt_coarse,
                             temporal_fine=a_temporal)
     static_q = build_features_jax(spec, b_src, None, b_src_coarse,
@@ -167,12 +180,13 @@ def _prepare_level_arrays(
         "dbn_pad": None,
     }
     if pad_tile:
-        n, f = db_rowsafe.shape
+        src = db if pad_full else db_rowsafe
+        srcn = out["db_sqnorm"] if pad_full else out["db_rowsafe_sqnorm"]
+        n, f = src.shape
         fp = max((f + 127) // 128 * 128, 128)
         npad = (n + pad_tile - 1) // pad_tile * pad_tile
-        out["db_pad"] = jnp.zeros((npad, fp), _F32).at[:n, :f].set(db_rowsafe)
-        out["dbn_pad"] = jnp.full((1, npad), jnp.inf, _F32).at[0, :n].set(
-            out["db_rowsafe_sqnorm"])
+        out["db_pad"] = jnp.zeros((npad, fp), _F32).at[:n, :f].set(src)
+        out["dbn_pad"] = jnp.full((1, npad), jnp.inf, _F32).at[0, :n].set(srcn)
     return out
 
 
@@ -183,6 +197,30 @@ def _exact_qvec(db: TpuLevelDB, q, bp):
     dyn = bp[db.flat_idx[q]] * db.written[q] * db.fine_sqrtw
     return jax.lax.dynamic_update_slice(
         db.static_q[q], dyn, (db.fine_start,))
+
+
+def _rescore_d_app(db: TpuLevelDB, qvec, p_app):
+    """Oracle re-score of a precomputed approx anchor: exact fp32 squared
+    distance of the FULL db row to the causal query (rowwise + wavefront)."""
+    return p_app, jnp.sum((db.db[p_app] - qvec) ** 2)
+
+
+def _resolve_pixel(db: TpuLevelDB, q, bp, s, p_app, d_app_fn, kappa_mult):
+    """The per-pixel decision shared by the exact / rowwise / wavefront
+    strategies: build the causal query vector, get d_app via `d_app_fn(qvec)`
+    (full-DB scores for exact, candidate re-score for rowwise/wavefront),
+    take the best Ashikhmin coherence candidate, apply the kappa rule
+    (Hertzmann §3.2 eq. 2), and write (bp, s) at q.
+
+    Returns (bp, s, use_coh)."""
+    qvec = _exact_qvec(db, q, bp)
+    p_app, d_app = d_app_fn(qvec, p_app)
+    p_coh, d_coh, has_coh = _pixel_coherence(db, qvec, q, s)
+    use_coh = has_coh & (d_coh <= d_app * kappa_mult)
+    p = jnp.where(use_coh, p_coh, p_app).astype(jnp.int32)
+    bp = bp.at[q].set(db.a_filt_flat[p])
+    s = s.at[q].set(p)
+    return bp, s, use_coh
 
 
 def _pixel_coherence(db: TpuLevelDB, qvec, q, s):
@@ -205,20 +243,18 @@ def _pixel_coherence(db: TpuLevelDB, qvec, q, s):
 def _run_exact(db: TpuLevelDB, kappa_mult):
     nb = db.hb * db.wb
 
-    def body(q, state):
-        bp, s, n_coh = state
-        qvec = _exact_qvec(db, q, bp)
+    def d_app_fn(qvec, _):
         scores = db.db_sqnorm - 2.0 * jnp.dot(
             db.db, qvec, preferred_element_type=_F32, precision=_HIGHEST)
         p_app = jnp.argmin(scores)
         qn = jnp.dot(qvec, qvec, preferred_element_type=_F32,
                      precision=_HIGHEST)
-        d_app = jnp.maximum(scores[p_app] + qn, 0.0)
-        p_coh, d_coh, has_coh = _pixel_coherence(db, qvec, q, s)
-        use_coh = has_coh & (d_coh <= d_app * kappa_mult)
-        p = jnp.where(use_coh, p_coh, p_app).astype(jnp.int32)
-        bp = bp.at[q].set(db.a_filt_flat[p])
-        s = s.at[q].set(p)
+        return p_app, jnp.maximum(scores[p_app] + qn, 0.0)
+
+    def body(q, state):
+        bp, s, n_coh = state
+        bp, s, use_coh = _resolve_pixel(db, q, bp, s, None, d_app_fn,
+                                        kappa_mult)
         return bp, s, n_coh + use_coh.astype(jnp.int32)
 
     bp0 = jnp.zeros((nb,), _F32)
@@ -249,17 +285,13 @@ def _run_rowwise(db: TpuLevelDB, kappa_mult):
     def approx_fn(queries):
         return argmin_l2(queries, db.db_rowsafe, db.db_rowsafe_sqnorm)
 
+    def d_app_fn(qvec, p_app):
+        return _rescore_d_app(db, qvec, p_app)
+
     def pixel_body(j, carry):
         bp, s, n_coh, r, p_apps = carry
-        q = r * wb + j
-        qvec = _exact_qvec(db, q, bp)
-        p_app = p_apps[j]
-        d_app = jnp.sum((db.db[p_app] - qvec) ** 2)
-        p_coh, d_coh, has_coh = _pixel_coherence(db, qvec, q, s)
-        use_coh = has_coh & (d_coh <= d_app * kappa_mult)
-        p = jnp.where(use_coh, p_coh, p_app).astype(jnp.int32)
-        bp = bp.at[q].set(db.a_filt_flat[p])
-        s = s.at[q].set(p)
+        bp, s, use_coh = _resolve_pixel(db, r * wb + j, bp, s, p_apps[j],
+                                        d_app_fn, kappa_mult)
         return bp, s, n_coh + use_coh.astype(jnp.int32), r, p_apps
 
     def row_body(r, state):
@@ -315,6 +347,10 @@ def batched_scan_core(db: TpuLevelDB, kappa_mult, approx_fn):
     local fused Pallas kernel, or its mesh-sharded variant (local kernel +
     min/argmin all-reduce over the 'db' axis — parallel/step.py calls this
     core from inside shard_map for the multi-chip video step).
+
+    Returns (bp, s, counts) with counts = [n_coherence_picks (pre-refine,
+    comparable with the CPU oracle's stat), n_refined_picks (picks the
+    left-propagation refinement switched to a same-row candidate)].
     """
     nf = int(db.off.shape[0])
     nrs = db.n_rowsafe
@@ -324,7 +360,7 @@ def batched_scan_core(db: TpuLevelDB, kappa_mult, approx_fn):
     off_j = db.off[:nrs, 1]
 
     def row_body(r, state):
-        bp, s, n_coh = state
+        bp, s, counts = state
         q0 = r * wb
         queries = _row_queries(db, r, bp, db.rowsafe)
         p_app, d_app = approx_fn(queries)
@@ -358,16 +394,21 @@ def batched_scan_core(db: TpuLevelDB, kappa_mult, approx_fn):
 
         bp = jax.lax.dynamic_update_slice(bp, db.a_filt_flat[p], (q0,))
         s = jax.lax.dynamic_update_slice(s, p, (q0,))
-        n_coh = n_coh + (d_pick < jnp.inf).sum(dtype=jnp.int32)
-        return bp, s, n_coh
+        n_coh = use_coh.sum(dtype=jnp.int32)
+        n_ref = (d_pick < jnp.inf).sum(dtype=jnp.int32) - n_coh
+        return bp, s, counts + jnp.stack([n_coh, n_ref])
 
     bp0 = jnp.zeros((hb * wb,), _F32)
     s0 = jnp.zeros((hb * wb,), jnp.int32)
-    return jax.lax.fori_loop(0, hb, row_body, (bp0, s0, jnp.int32(0)))
+    return jax.lax.fori_loop(0, hb, row_body,
+                             (bp0, s0, jnp.zeros((2,), jnp.int32)))
 
 
-@jax.jit
-def _run_batched(db: TpuLevelDB, kappa_mult):
+def make_approx_fn(db: TpuLevelDB):
+    """The strategy's approximate-match fn (queries (M,F)) -> (idx, sqdist):
+    mesh-sharded kernel > pre-padded Pallas kernel > plain dispatch.  Which DB
+    it scores against (rowsafe-masked or full) was decided when the sharded /
+    pre-padded arrays were built in `build_features`."""
     if db.sharded_argmin is not None:
         def approx_fn(queries):
             return db.sharded_argmin(queries, db.db_sharded, db.dbn_sharded)
@@ -381,17 +422,105 @@ def _run_batched(db: TpuLevelDB, kappa_mult):
                 qp, db.db_pad, db.dbn_pad, tile_n=_ARGMIN_TILE)
             qn = jnp.sum(queries * queries, axis=1)
             return idx[:m], jnp.maximum(score[:m] + qn, 0.0)
+    elif db.strategy == "wavefront":
+        def approx_fn(queries):
+            return argmin_l2(queries, db.db, db.db_sqnorm)
     else:
         def approx_fn(queries):
             return argmin_l2(queries, db.db_rowsafe, db.db_rowsafe_sqnorm)
+    return approx_fn
 
-    return batched_scan_core(db, kappa_mult, approx_fn)
+
+@jax.jit
+def _run_batched(db: TpuLevelDB, kappa_mult):
+    return batched_scan_core(db, kappa_mult, make_approx_fn(db))
 
 
+# ------------------------------------------------------------ wavefront scan
+
+
+def wavefront_scan_core(db: TpuLevelDB, kappa_mult, approx_fn, passes: int):
+    """The parity fast path (VERDICT.md round-1 item 1).
+
+    Per scan row: one batched Pallas argmin over the FULL DB supplies the
+    approximate-match anchors for the whole row, then a sequential
+    coherence/kappa pass resolves the row with exact causal features (the
+    oracle's per-pixel rule, Hertzmann §3.2).  Because the anchors were
+    picked from queries whose same-row-left values were still unknown, the
+    row is then re-resolved ``passes`` times with queries REBUILT from the
+    current row estimate (full written causal window) — Gauss-Seidel on the
+    row.  The oracle's sequential output is a fixed point of this iteration:
+    each re-resolve reproduces the oracle's decisions exactly wherever the
+    left-neighbor estimates already match, so the row converges to the
+    oracle's row.  Measured: SSIM vs oracle = 1.000 at 96-128² structured
+    inputs with passes=2 (experiments/gs_probe.py), while rows-above-only
+    batching plateaus at ~0.6.
+
+    Unlike the batched strategy, all scoring uses the oracle's metric: FULL
+    A/A' DB rows against zero-masked queries (the cKDTree metric), not the
+    symmetric rowsafe-masked one.
+    """
+    wb, hb = db.wb, db.hb
+    ones = jnp.ones_like(db.rowsafe)
+
+    def d_app_fn(qvec, p_app):
+        return _rescore_d_app(db, qvec, p_app)
+
+    def seq_pass(r, bp, s, p_apps):
+        """Sequential coherence/kappa re-resolve of row r given the row's
+        approximate-match anchors — per-pixel identical to the oracle."""
+
+        def pixel_body(j, carry):
+            bp, s, n_coh = carry
+            bp, s, use_coh = _resolve_pixel(db, r * wb + j, bp, s, p_apps[j],
+                                            d_app_fn, kappa_mult)
+            return bp, s, n_coh + use_coh.astype(jnp.int32)
+
+        return jax.lax.fori_loop(0, wb, pixel_body, (bp, s, jnp.int32(0)))
+
+    def row_body(r, state):
+        bp, s, n_coh_tot = state
+        queries = _row_queries(db, r, bp, db.rowsafe)
+        p_apps, _ = approx_fn(queries)
+        bp, s, n_coh = seq_pass(r, bp, s, p_apps)
+
+        # GS re-resolves until the row's source map reaches its fixed point
+        # (almost always 1-3 iterations; `passes` caps pathological rows).
+        def gs_cond(carry):
+            _, _, _, k, changed = carry
+            return changed & (k < passes)
+
+        def gs_body(carry):
+            bp, s, _, k, _ = carry
+            s_before = jax.lax.dynamic_slice(s, (r * wb,), (wb,))
+            queries = _row_queries(db, r, bp, ones)
+            p_apps, _ = approx_fn(queries)
+            bp, s, n_coh = seq_pass(r, bp, s, p_apps)
+            s_after = jax.lax.dynamic_slice(s, (r * wb,), (wb,))
+            return bp, s, n_coh, k + 1, jnp.any(s_after != s_before)
+
+        bp, s, n_coh, _, _ = jax.lax.while_loop(
+            gs_cond, gs_body, (bp, s, n_coh, jnp.int32(0), jnp.bool_(True)))
+        # n_coh from the FINAL pass only: directly comparable with the CPU
+        # oracle's coherence_ratio (VERDICT.md round-1 weak item 6).
+        return bp, s, n_coh_tot + n_coh
+
+    bp0 = jnp.zeros((hb * wb,), _F32)
+    s0 = jnp.zeros((hb * wb,), jnp.int32)
+    return jax.lax.fori_loop(0, hb, row_body, (bp0, s0, jnp.int32(0)))
+
+
+@functools.partial(jax.jit, static_argnames=("passes",))
+def _run_wavefront(db: TpuLevelDB, kappa_mult, passes: int = 2):
+    return wavefront_scan_core(db, kappa_mult, make_approx_fn(db), passes)
+
+
+# Strategies with the uniform (db, kappa_mult) -> (bp, s, n_coh) signature;
+# "batched" (counts vector) and "wavefront" (static passes arg) are
+# dispatched explicitly in synthesize_level.
 _RUNNERS = {
     "exact": _run_exact,
     "rowwise": _run_rowwise,
-    "batched": _run_batched,
 }
 
 
@@ -414,9 +543,13 @@ class TpuMatcher(Matcher):
         if strategy == "auto":
             strategy = "batched"
 
-        sharded = self.params.db_shards > 1 and strategy == "batched"
+        # wavefront scores against the FULL DB (the oracle's metric); batched
+        # against the rowsafe-masked DB (its symmetric metric).
+        pad_full = strategy == "wavefront"
+        sharded = (self.params.db_shards > 1
+                   and strategy in ("batched", "wavefront"))
         pad_tile = 0
-        if strategy == "batched" and not sharded \
+        if strategy in ("batched", "wavefront") and not sharded \
                 and jax.default_backend() == "tpu":
             na = ha * wa
             pad_tile = min(_ARGMIN_TILE, max((na + 127) // 128 * 128, 128))
@@ -425,7 +558,7 @@ class TpuMatcher(Matcher):
             spec, to_j(job.a_src), to_j(job.a_filt), to_j(job.a_src_coarse),
             to_j(job.a_filt_coarse), to_j(job.a_temporal), to_j(job.b_src),
             to_j(job.b_src_coarse), to_j(job.b_filt_coarse),
-            to_j(job.b_temporal), jnp.asarray(rowsafe), pad_tile)
+            to_j(job.b_temporal), jnp.asarray(rowsafe), pad_tile, pad_full)
 
         sharded_argmin = db_sharded = dbn_sharded = None
         if sharded:
@@ -433,8 +566,10 @@ class TpuMatcher(Matcher):
             from image_analogies_tpu.parallel.sharded_match import shard_db
 
             mesh = make_mesh(db_shards=self.params.db_shards)
-            db_sharded, dbn_sharded = shard_db(
-                arrs["db_rowsafe"], arrs["db_rowsafe_sqnorm"], mesh)
+            score_db, score_dbn = ((arrs["db"], arrs["db_sqnorm"]) if pad_full
+                                   else (arrs["db_rowsafe"],
+                                         arrs["db_rowsafe_sqnorm"]))
+            db_sharded, dbn_sharded = shard_db(score_db, score_dbn, mesh)
             sharded_argmin = _cached_sharded_argmin(
                 mesh, jax.default_backend() != "tpu")
 
@@ -487,19 +622,34 @@ class TpuMatcher(Matcher):
     def synthesize_level(self, db: TpuLevelDB, job: LevelJob
                          ) -> Tuple[np.ndarray, np.ndarray, Dict[str, Any]]:
         t0 = time.perf_counter()
-        runner = _RUNNERS[db.strategy]
-        bp, s, n_coh = runner(db, jnp.float32(job.kappa_mult))
+        n_ref = None
+        if db.strategy == "wavefront":
+            bp, s, n_coh = _run_wavefront(db, jnp.float32(job.kappa_mult),
+                                          passes=self.params.gs_passes)
+        elif db.strategy == "batched":
+            bp, s, counts = _run_batched(db, jnp.float32(job.kappa_mult))
+            n_coh, n_ref = int(counts[0]), int(counts[1])
+        else:
+            runner = _RUNNERS[db.strategy]
+            bp, s, n_coh = runner(db, jnp.float32(job.kappa_mult))
         bp = np.asarray(bp, np.float32)  # forces device completion
         s = np.asarray(s, np.int32)
         dt = time.perf_counter() - t0
         hb, wb = job.b_shape
+        n = hb * wb
         stats = {
             "level": job.level,
             "db_rows": int(db.db.shape[0]),
-            "pixels": hb * wb,
-            "coherence_ratio": float(n_coh) / max(hb * wb, 1),
+            "pixels": n,
+            "coherence_ratio": float(n_coh) / max(n, 1),
+            "pixels_per_s": n / max(dt, 1e-9),
             "ms": dt * 1e3,
             "backend": "tpu",
             "strategy": db.strategy,
         }
+        if n_ref is not None:
+            # picks the left-propagation refinement switched to a same-row
+            # coherence candidate — reported separately so coherence_ratio
+            # stays comparable with the CPU oracle's.
+            stats["refined_ratio"] = n_ref / max(n, 1)
         return bp.reshape(hb, wb), s.reshape(hb, wb), stats

@@ -62,10 +62,22 @@ class AnalogyParams:
     #               strictly-above rows for approximate AND coherence
     #               candidates, so each scan row resolves fully in parallel
     #               (one fused Pallas argmin + one batched coherence gather
-    #               per row).  SURVEY.md §7 hard part 1's sanctioned lever,
-    #               SSIM-validated against the oracle.
+    #               per row).  SURVEY.md §7 hard part 1's sanctioned lever.
+    #   "wavefront" - the PARITY fast path: per row, batched full-DB Pallas
+    #               argmin anchors + a sequential coherence/kappa pass, then
+    #               `gs_passes` Gauss-Seidel re-resolves with queries rebuilt
+    #               from the current row estimate.  The oracle's sequential
+    #               output is a fixed point of this iteration; measured SSIM
+    #               vs the oracle is 1.000 at 128² on structured inputs
+    #               (experiments/gs_probe.py), vs ~0.6 for batched/rowwise.
     #   "auto"    - batched.
     strategy: str = "auto"
+
+    # Cap on Gauss-Seidel re-resolve passes per row of the "wavefront"
+    # strategy.  Each row iterates only until its source map stops changing
+    # (usually 1-3 passes — experiments/gs_probe.py); the cap bounds
+    # pathological rows that cycle instead of converging.
+    gs_passes: int = 8
 
     # Use the cKDTree index for the CPU approximate match (the reference's ANN
     # toggle); False = brute force (native C++ matcher if built, else NumPy).
@@ -98,8 +110,11 @@ class AnalogyParams:
             raise ValueError(f"unknown color_mode {self.color_mode!r}")
         if self.backend not in ("cpu", "tpu"):
             raise ValueError(f"unknown backend {self.backend!r}")
-        if self.strategy not in ("exact", "rowwise", "batched", "auto"):
+        if self.strategy not in ("exact", "rowwise", "batched", "wavefront",
+                                 "auto"):
             raise ValueError(f"unknown strategy {self.strategy!r}")
+        if self.gs_passes < 0:
+            raise ValueError(f"gs_passes must be >= 0, got {self.gs_passes}")
         if self.db_shards < 1:
             raise ValueError(f"db_shards must be >= 1, got {self.db_shards}")
 

@@ -41,7 +41,7 @@ def multichip_level_step(
     force_xla: bool = False,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Jit+shard_map'd whole-level scan for T frames.  Returns
-    (bp (T, Nb), s (T, Nb), n_coh (T,))."""
+    (bp (T, Nb), s (T, Nb), counts (T, 2) [n_coherence, n_refined])."""
     t_total = frame_static_q.shape[0]
     data_shards = mesh.shape["data"]
     db_shards = mesh.shape["db"]
@@ -80,7 +80,7 @@ def multichip_level_step(
         functools.partial(local_step),
         mesh=mesh,
         in_specs=(P("data", None, None), P("db", None), P("db"), P(), P()),
-        out_specs=(P("data", None), P("data", None), P("data")),
+        out_specs=(P("data", None), P("data", None), P("data", None)),
         check_rep=False,
     )
     return jax.jit(stepped)(frame_static_q, db_shard_src, dbn_shard_src,
