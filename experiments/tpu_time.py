@@ -1,0 +1,45 @@
+"""Timing probe on the REAL chip: warm wall-clock per strategy/size.
+
+    python experiments/tpu_time.py --size 256 --levels 3 --strategies batched,wavefront
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from experiments.parity_probe import make_structured
+from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.models.analogy import create_image_analogy
+
+
+def main() -> int:
+    ap_ = argparse.ArgumentParser()
+    ap_.add_argument("--size", type=int, default=256)
+    ap_.add_argument("--levels", type=int, default=3)
+    ap_.add_argument("--kappa", type=float, default=5.0)
+    ap_.add_argument("--strategies", default="batched,wavefront")
+    args = ap_.parse_args()
+
+    a, ap, b = make_structured(args.size)
+    for strat in args.strategies.split(","):
+        p = AnalogyParams(levels=args.levels, kappa=args.kappa,
+                          backend="tpu", strategy=strat)
+        t0 = time.perf_counter()
+        create_image_analogy(a, ap, b, p)
+        cold = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        res = create_image_analogy(a, ap, b, p)
+        warm = time.perf_counter() - t0
+        lvl = " ".join(f"{s['ms']:.0f}ms" for s in res.stats)
+        print(f"{strat:>10} size={args.size} cold={cold:.1f}s warm={warm:.2f}s"
+              f"  levels: {lvl}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -27,13 +27,17 @@ Strategies (see config.AnalogyParams.strategy):
   gather, then `refine_passes` cheap vectorized passes that restore same-row
   left-propagation of the source map (the dominant coherence mechanism).
   Fastest; a different-but-comparable synthesis vs the oracle.
-- "wavefront": the PARITY fast path (VERDICT.md round-1 item 1).  Per row:
-  batched full-DB Pallas argmin anchors + a sequential coherence/kappa pass
-  (the oracle's exact per-pixel rule), iterated Gauss-Seidel style with
-  queries rebuilt from the current row estimate until the row's source map
-  reaches its fixed point.  The oracle's sequential output IS such a fixed
-  point, and measured SSIM vs the oracle is 1.000 at 96-128² structured
-  inputs (experiments/gs_probe.py) vs ~0.6 for batched/rowwise.
+- "wavefront": the PARITY fast path (VERDICT.md round-1 item 1).  The raster
+  scan is re-scheduled onto anti-diagonals skewed by c = patch_radius + 1:
+  pixel (i, j) runs at time t = j + c*i, so every causal dependency —
+  including edge-CLAMPED window positions — is computed on a strictly
+  earlier diagonal (proof in `wavefront_scan_core`).  Each diagonal's ~W/c
+  pixels therefore resolve in ONE batch (fused Pallas full-DB argmin +
+  batched Ashikhmin coherence + kappa rule with the oracle's exact
+  metric), and the result is the ORACLE'S OUTPUT by construction — same
+  per-pixel rule, same dependency values, identical up to fp tie-breaks —
+  at batched-strategy speed (~4k batched steps at 1024² instead of ~1M
+  sequential pixel steps).
 """
 
 from __future__ import annotations
@@ -60,7 +64,19 @@ from image_analogies_tpu.ops.pallas_match import (
     pallas_argmin_l2_prepadded,
 )
 
-_ARGMIN_TILE = 2048
+# DB rows per VMEM tile of the fused argmin kernel at 128 padded features:
+# 8192 x 128 x 4 B x 2 (double buffering) = 8 MB of the 16 MB scoped VMEM;
+# bigger tiles OOM, smaller ones pay more per-tile latency in the dependent
+# wavefront chain.  Wider features (RGB label modes pad to 256) shrink the
+# row count to keep the same byte budget — see _tile_rows.
+_ARGMIN_TILE = 8192
+
+
+def _tile_rows(f: int) -> int:
+    """Kernel tile rows for feature dim `f`, holding the VMEM tile bytes at
+    _ARGMIN_TILE x 128 x 4 regardless of the padded feature width."""
+    fp = max((f + 127) // 128 * 128, 128)
+    return max(512, _ARGMIN_TILE * 128 // fp)
 
 _F32 = jnp.float32
 _HIGHEST = jax.lax.Precision.HIGHEST
@@ -90,6 +106,7 @@ class TpuLevelDB:
     off: jax.Array  # (nf, 2) int32 window offsets
     db_sharded: Optional[jax.Array]  # (Npad, F) laid out over mesh 'db' axis
     dbn_sharded: Optional[jax.Array]
+    diag: Optional[jax.Array]  # (T, Mmax) anti-diagonal schedule (wavefront)
     # Pre-padded rowsafe DB for the hot loop (tile-aligned rows, 128-aligned
     # features, +inf norms on padding) — pads ONCE per level instead of every
     # scan row inside the fori_loop.
@@ -118,10 +135,31 @@ jax.tree_util.register_dataclass(
 
 
 @functools.lru_cache(maxsize=None)
-def _cached_sharded_argmin(mesh, force_xla: bool):
+def _cached_sharded_argmin(mesh, force_xla: bool, precision):
     from image_analogies_tpu.parallel.sharded_match import make_sharded_argmin
 
-    return make_sharded_argmin(mesh, force_xla=force_xla)
+    return make_sharded_argmin(mesh, force_xla=force_xla,
+                               precision=precision)
+
+
+@functools.lru_cache(maxsize=64)
+def _diag_schedule(h: int, w: int, c: int) -> jax.Array:
+    """Anti-diagonal wavefront schedule, skew c: row t holds the flat indices
+    of every pixel (i, j) with j + c*i == t (-1 padding on short diagonals).
+
+    With c = patch_radius + 1 all of pixel (i, j)'s causal dependencies lie on
+    strictly earlier diagonals (see `wavefront_scan_core`), so each row of
+    this schedule is an independently-resolvable batch."""
+    t_total = c * (h - 1) + w
+    m_max = min(h, (w + c - 1) // c)
+    sched = np.full((t_total, m_max), -1, np.int32)
+    ii = np.arange(h)
+    for t in range(t_total):
+        jj = t - c * ii
+        ok = (jj >= 0) & (jj < w)
+        pix = (ii[ok] * w + jj[ok]).astype(np.int32)
+        sched[t, :pix.size] = pix
+    return jax.device_put(jnp.asarray(sched))
 
 
 @functools.lru_cache(maxsize=64)
@@ -168,12 +206,19 @@ def _prepare_level_arrays(
     static_q = build_features_jax(spec, b_src, None, b_src_coarse,
                                   b_filt_coarse, temporal_fine=b_temporal)
     fsl = spec.fine_filt_slice
-    db_rowsafe = db.at[:, fsl].multiply(rowsafe[None, :])
+    db_sqnorm = jnp.sum(db * db, axis=1)
+    if pad_full:
+        # wavefront never scores against the rowsafe-masked DB; alias the
+        # full DB instead of materializing a second (Na, F) copy in HBM.
+        db_rowsafe, db_rowsafe_sqnorm = db, db_sqnorm
+    else:
+        db_rowsafe = db.at[:, fsl].multiply(rowsafe[None, :])
+        db_rowsafe_sqnorm = jnp.sum(db_rowsafe * db_rowsafe, axis=1)
     out = {
         "db": db,
-        "db_sqnorm": jnp.sum(db * db, axis=1),
+        "db_sqnorm": db_sqnorm,
         "db_rowsafe": db_rowsafe,
-        "db_rowsafe_sqnorm": jnp.sum(db_rowsafe * db_rowsafe, axis=1),
+        "db_rowsafe_sqnorm": db_rowsafe_sqnorm,
         "static_q": static_q,
         "a_filt_flat": a_filt.reshape(-1),
         "db_pad": None,
@@ -201,14 +246,14 @@ def _exact_qvec(db: TpuLevelDB, q, bp):
 
 def _rescore_d_app(db: TpuLevelDB, qvec, p_app):
     """Oracle re-score of a precomputed approx anchor: exact fp32 squared
-    distance of the FULL db row to the causal query (rowwise + wavefront)."""
+    distance of the FULL db row to the causal query (rowwise strategy)."""
     return p_app, jnp.sum((db.db[p_app] - qvec) ** 2)
 
 
 def _resolve_pixel(db: TpuLevelDB, q, bp, s, p_app, d_app_fn, kappa_mult):
-    """The per-pixel decision shared by the exact / rowwise / wavefront
-    strategies: build the causal query vector, get d_app via `d_app_fn(qvec)`
-    (full-DB scores for exact, candidate re-score for rowwise/wavefront),
+    """The per-pixel decision shared by the exact / rowwise strategies:
+    build the causal query vector, get d_app via `d_app_fn(qvec)`
+    (full-DB scores for exact, candidate re-score for rowwise),
     take the best Ashikhmin coherence candidate, apply the kappa rule
     (Hertzmann §3.2 eq. 2), and write (bp, s) at q.
 
@@ -221,6 +266,29 @@ def _resolve_pixel(db: TpuLevelDB, q, bp, s, p_app, d_app_fn, kappa_mult):
     bp = bp.at[q].set(db.a_filt_flat[p])
     s = s.at[q].set(p)
     return bp, s, use_coh
+
+
+def _batched_coherence(db: TpuLevelDB, s, queries, idx_c, ok, n_cand: int,
+                       score_db):
+    """Batched Ashikhmin candidates for M pixels at once (Hertzmann §3.2):
+    for each query m the candidates are {s(r) + (q - r)} over its first
+    ``n_cand`` causal window positions r (idx_c (M, n_cand) flat positions,
+    ``ok`` their base validity), scored in fp32 against ``score_db`` (the
+    rowsafe-masked DB for the batched strategy, the full DB for wavefront).
+
+    Returns (p_coh (M,), d_coh (M,), has_coh (M,))."""
+    s_r = s[idx_c]  # (M, n_cand)
+    ci = s_r // db.wa - db.off[None, :n_cand, 0]
+    cj = s_r % db.wa - db.off[None, :n_cand, 1]
+    ok = ok & (ci >= 0) & (ci < db.ha) & (cj >= 0) & (cj < db.wa)
+    cand = (jnp.clip(ci, 0, db.ha - 1) * db.wa
+            + jnp.clip(cj, 0, db.wa - 1))
+    dc = jnp.sum((score_db[cand] - queries[:, None, :]) ** 2, axis=-1)
+    dc = jnp.where(ok, dc, jnp.inf)
+    k = jnp.argmin(dc, axis=1)
+    d_coh = jnp.take_along_axis(dc, k[:, None], axis=1)[:, 0]
+    p_coh = jnp.take_along_axis(cand, k[:, None], axis=1)[:, 0]
+    return p_coh, d_coh, ok.any(axis=1)
 
 
 def _pixel_coherence(db: TpuLevelDB, qvec, q, s):
@@ -356,9 +424,6 @@ def batched_scan_core(db: TpuLevelDB, kappa_mult, approx_fn):
     nrs = db.n_rowsafe
     wb, hb = db.wb, db.hb
 
-    off_i = db.off[:nrs, 0]
-    off_j = db.off[:nrs, 1]
-
     def row_body(r, state):
         bp, s, counts = state
         q0 = r * wb
@@ -370,20 +435,10 @@ def batched_scan_core(db: TpuLevelDB, kappa_mult, approx_fn):
             db.flat_idx, (q0, 0), (wb, nf))[:, :nrs]
         ok = (jax.lax.dynamic_slice(db.valid, (q0, 0), (wb, nf))[:, :nrs]
               > 0)
-        s_r = s[idx_c]  # (W, nrs)
-        ci = s_r // db.wa - off_i[None, :]
-        cj = s_r % db.wa - off_j[None, :]
-        ok = ok & (ci >= 0) & (ci < db.ha) & (cj >= 0) & (cj < db.wa)
-        cand = (jnp.clip(ci, 0, db.ha - 1) * db.wa
-                + jnp.clip(cj, 0, db.wa - 1))
-        cf = db.db_rowsafe[cand]  # (W, nrs, F)
-        dc = jnp.sum((cf - queries[:, None, :]) ** 2, axis=-1)
-        dc = jnp.where(ok, dc, jnp.inf)
-        k = jnp.argmin(dc, axis=1)
-        d_coh = jnp.take_along_axis(dc, k[:, None], axis=1)[:, 0]
-        p_coh = jnp.take_along_axis(cand, k[:, None], axis=1)[:, 0]
+        p_coh, d_coh, has_coh = _batched_coherence(
+            db, s, queries, idx_c, ok, nrs, db.db_rowsafe)
 
-        use_coh = ok.any(axis=1) & (d_coh <= d_app * kappa_mult)
+        use_coh = has_coh & (d_coh <= d_app * kappa_mult)
         p = jnp.where(use_coh, p_coh, p_app).astype(jnp.int32)
         d_pick = jnp.where(use_coh, d_coh, jnp.inf)
 
@@ -408,7 +463,14 @@ def make_approx_fn(db: TpuLevelDB):
     """The strategy's approximate-match fn (queries (M,F)) -> (idx, sqdist):
     mesh-sharded kernel > pre-padded Pallas kernel > plain dispatch.  Which DB
     it scores against (rowsafe-masked or full) was decided when the sharded /
-    pre-padded arrays were built in `build_features`."""
+    pre-padded arrays were built in `build_features`.
+
+    Kernel precision: the wavefront strategy needs fp32-grade scores so its
+    anchor picks match the oracle's argmin (HIGHEST, 3 bf16 MXU passes); the
+    approximate batched/rowwise strategies keep the fast single-pass DEFAULT
+    — their picks are heuristic anyway and tolerate ~1e-3 score error."""
+    precision = (jax.lax.Precision.HIGHEST if db.strategy == "wavefront"
+                 else jax.lax.Precision.DEFAULT)
     if db.sharded_argmin is not None:
         def approx_fn(queries):
             return db.sharded_argmin(queries, db.db_sharded, db.dbn_sharded)
@@ -419,15 +481,18 @@ def make_approx_fn(db: TpuLevelDB):
             fp = db.db_pad.shape[1]
             qp = jnp.zeros((mp, fp), _F32).at[:m, :f].set(queries)
             idx, score = pallas_argmin_l2_prepadded(
-                qp, db.db_pad, db.dbn_pad, tile_n=_ARGMIN_TILE)
+                qp, db.db_pad, db.dbn_pad, tile_n=_tile_rows(f),
+                precision=precision)
             qn = jnp.sum(queries * queries, axis=1)
             return idx[:m], jnp.maximum(score[:m] + qn, 0.0)
     elif db.strategy == "wavefront":
         def approx_fn(queries):
-            return argmin_l2(queries, db.db, db.db_sqnorm)
+            return argmin_l2(queries, db.db, db.db_sqnorm,
+                             precision=precision)
     else:
         def approx_fn(queries):
-            return argmin_l2(queries, db.db_rowsafe, db.db_rowsafe_sqnorm)
+            return argmin_l2(queries, db.db_rowsafe, db.db_rowsafe_sqnorm,
+                             precision=precision)
     return approx_fn
 
 
@@ -439,88 +504,78 @@ def _run_batched(db: TpuLevelDB, kappa_mult):
 # ------------------------------------------------------------ wavefront scan
 
 
-def wavefront_scan_core(db: TpuLevelDB, kappa_mult, approx_fn, passes: int):
-    """The parity fast path (VERDICT.md round-1 item 1).
+def wavefront_scan_core(db: TpuLevelDB, kappa_mult, approx_fn):
+    """The parity fast path (VERDICT.md round-1 item 1): the oracle's exact
+    algorithm on an anti-diagonal schedule.
 
-    Per scan row: one batched Pallas argmin over the FULL DB supplies the
-    approximate-match anchors for the whole row, then a sequential
-    coherence/kappa pass resolves the row with exact causal features (the
-    oracle's per-pixel rule, Hertzmann §3.2).  Because the anchors were
-    picked from queries whose same-row-left values were still unknown, the
-    row is then re-resolved ``passes`` times with queries REBUILT from the
-    current row estimate (full written causal window) — Gauss-Seidel on the
-    row.  The oracle's sequential output is a fixed point of this iteration:
-    each re-resolve reproduces the oracle's decisions exactly wherever the
-    left-neighbor estimates already match, so the row converges to the
-    oracle's row.  Measured: SSIM vs oracle = 1.000 at 96-128² structured
-    inputs with passes=2 (experiments/gs_probe.py), while rows-above-only
-    batching plateaus at ~0.6.
+    The raster scan's loop-carried dependency is bounded: pixel (i, j)'s
+    causal feature window and coherence candidates read only pixels
+    (i', j') with i' < i, j' <= j + r  or  i' == i, j' < j  (r = patch
+    radius) — including every edge-CLAMPED window position, whose clamp
+    target also satisfies the bound.  Skewing time as t(i, j) = j + (r+1)*i
+    makes every dependency strictly earlier:
 
-    Unlike the batched strategy, all scoring uses the oracle's metric: FULL
-    A/A' DB rows against zero-masked queries (the cKDTree metric), not the
-    symmetric rowsafe-masked one.
+        same row   (i, j-d):    t' = t - d            < t
+        rows above (i-k, j+d):  t' = t + d - (r+1)*k  <= t - (r+1-d) < t
+                                                         (d <= r, k >= 1)
+
+    so all pixels of one diagonal are independent given previous diagonals
+    and resolve in ONE batch: fused Pallas full-DB argmin anchors, exact
+    fp32 re-score, batched Ashikhmin coherence over the full causal window,
+    kappa rule (Hertzmann §3.2 eq. 2).  Every per-pixel decision sees the
+    same dependency values as the oracle's raster scan, so the output IS the
+    oracle's up to fp tie-breaks — no Gauss-Seidel iteration, no sequential
+    inner loop, ~(W + (r+1)H) batched steps per level.
+
+    All scoring uses the oracle's metric: FULL A/A' DB rows against
+    zero-masked causal queries (the cKDTree metric), not the batched
+    strategy's symmetric rowsafe-masked one.
     """
-    wb, hb = db.wb, db.hb
-    ones = jnp.ones_like(db.rowsafe)
+    nb = db.hb * db.wb
+    t_total = int(db.diag.shape[0])
 
-    def d_app_fn(qvec, p_app):
-        return _rescore_d_app(db, qvec, p_app)
+    def step(t, state):
+        bp, s, n_coh = state
+        pix = db.diag[t]  # (M,) flat indices, -1 on short diagonals
+        lane_ok = pix >= 0
+        pixc = jnp.maximum(pix, 0)
+        idx = db.flat_idx[pixc]  # (M, nf)
+        dyn = bp[idx] * db.written[pixc] * db.fine_sqrtw[None, :]
+        queries = jax.lax.dynamic_update_slice(
+            db.static_q[pixc], dyn, (0, db.fine_start))
+        p_app, _ = approx_fn(queries)
+        d_app = jnp.sum((db.db[p_app] - queries) ** 2, axis=1)
 
-    def seq_pass(r, bp, s, p_apps):
-        """Sequential coherence/kappa re-resolve of row r given the row's
-        approximate-match anchors — per-pixel identical to the oracle."""
+        # batched Ashikhmin coherence over the full causal window, scored
+        # against the FULL DB (the oracle's metric)
+        nf = int(db.off.shape[0])
+        p_coh, d_coh, has_coh = _batched_coherence(
+            db, s, queries, idx, db.valid[pixc] > 0, nf, db.db)
 
-        def pixel_body(j, carry):
-            bp, s, n_coh = carry
-            bp, s, use_coh = _resolve_pixel(db, r * wb + j, bp, s, p_apps[j],
-                                            d_app_fn, kappa_mult)
-            return bp, s, n_coh + use_coh.astype(jnp.int32)
+        use_coh = has_coh & (d_coh <= d_app * kappa_mult)
+        p = jnp.where(use_coh, p_coh, p_app).astype(jnp.int32)
+        # write only live lanes: -1 padding -> index nb, dropped by scatter
+        wpix = jnp.where(lane_ok, pix, nb)
+        bp = bp.at[wpix].set(db.a_filt_flat[p], mode="drop")
+        s = s.at[wpix].set(p, mode="drop")
+        return bp, s, n_coh + (use_coh & lane_ok).sum(dtype=jnp.int32)
 
-        return jax.lax.fori_loop(0, wb, pixel_body, (bp, s, jnp.int32(0)))
-
-    def row_body(r, state):
-        bp, s, n_coh_tot = state
-        queries = _row_queries(db, r, bp, db.rowsafe)
-        p_apps, _ = approx_fn(queries)
-        bp, s, n_coh = seq_pass(r, bp, s, p_apps)
-
-        # GS re-resolves until the row's source map reaches its fixed point
-        # (almost always 1-3 iterations; `passes` caps pathological rows).
-        def gs_cond(carry):
-            _, _, _, k, changed = carry
-            return changed & (k < passes)
-
-        def gs_body(carry):
-            bp, s, _, k, _ = carry
-            s_before = jax.lax.dynamic_slice(s, (r * wb,), (wb,))
-            queries = _row_queries(db, r, bp, ones)
-            p_apps, _ = approx_fn(queries)
-            bp, s, n_coh = seq_pass(r, bp, s, p_apps)
-            s_after = jax.lax.dynamic_slice(s, (r * wb,), (wb,))
-            return bp, s, n_coh, k + 1, jnp.any(s_after != s_before)
-
-        bp, s, n_coh, _, _ = jax.lax.while_loop(
-            gs_cond, gs_body, (bp, s, n_coh, jnp.int32(0), jnp.bool_(True)))
-        # n_coh from the FINAL pass only: directly comparable with the CPU
-        # oracle's coherence_ratio (VERDICT.md round-1 weak item 6).
-        return bp, s, n_coh_tot + n_coh
-
-    bp0 = jnp.zeros((hb * wb,), _F32)
-    s0 = jnp.zeros((hb * wb,), jnp.int32)
-    return jax.lax.fori_loop(0, hb, row_body, (bp0, s0, jnp.int32(0)))
+    bp0 = jnp.zeros((nb,), _F32)
+    s0 = jnp.zeros((nb,), jnp.int32)
+    return jax.lax.fori_loop(0, t_total, step, (bp0, s0, jnp.int32(0)))
 
 
-@functools.partial(jax.jit, static_argnames=("passes",))
-def _run_wavefront(db: TpuLevelDB, kappa_mult, passes: int = 2):
-    return wavefront_scan_core(db, kappa_mult, make_approx_fn(db), passes)
+@jax.jit
+def _run_wavefront(db: TpuLevelDB, kappa_mult):
+    return wavefront_scan_core(db, kappa_mult, make_approx_fn(db))
 
 
 # Strategies with the uniform (db, kappa_mult) -> (bp, s, n_coh) signature;
-# "batched" (counts vector) and "wavefront" (static passes arg) are
-# dispatched explicitly in synthesize_level.
+# "batched" (counts vector) is dispatched explicitly in synthesize_level.
 _RUNNERS = {
     "exact": _run_exact,
     "rowwise": _run_rowwise,
+    "wavefront": _run_wavefront,
 }
 
 
@@ -541,7 +596,7 @@ class TpuMatcher(Matcher):
 
         strategy = self.params.strategy
         if strategy == "auto":
-            strategy = "batched"
+            strategy = "wavefront"
 
         # wavefront scores against the FULL DB (the oracle's metric); batched
         # against the rowsafe-masked DB (its symmetric metric).
@@ -552,7 +607,8 @@ class TpuMatcher(Matcher):
         if strategy in ("batched", "wavefront") and not sharded \
                 and jax.default_backend() == "tpu":
             na = ha * wa
-            pad_tile = min(_ARGMIN_TILE, max((na + 127) // 128 * 128, 128))
+            pad_tile = min(_tile_rows(spec.total),
+                           max((na + 127) // 128 * 128, 128))
 
         arrs = _prepare_level_arrays(
             spec, to_j(job.a_src), to_j(job.a_filt), to_j(job.a_src_coarse),
@@ -571,7 +627,13 @@ class TpuMatcher(Matcher):
                                          arrs["db_rowsafe_sqnorm"]))
             db_sharded, dbn_sharded = shard_db(score_db, score_dbn, mesh)
             sharded_argmin = _cached_sharded_argmin(
-                mesh, jax.default_backend() != "tpu")
+                mesh, jax.default_backend() != "tpu",
+                jax.lax.Precision.HIGHEST if pad_full
+                else jax.lax.Precision.DEFAULT)
+
+        diag = None
+        if strategy == "wavefront":
+            diag = _diag_schedule(hb, wb, spec.fine_size // 2 + 1)
 
         fsl = spec.fine_filt_slice
         return TpuLevelDB(
@@ -589,6 +651,7 @@ class TpuMatcher(Matcher):
             off=jnp.asarray(off),
             db_sharded=db_sharded,
             dbn_sharded=dbn_sharded,
+            diag=diag,
             db_pad=arrs["db_pad"],
             dbn_pad=arrs["dbn_pad"],
             ha=ha,
@@ -623,10 +686,7 @@ class TpuMatcher(Matcher):
                          ) -> Tuple[np.ndarray, np.ndarray, Dict[str, Any]]:
         t0 = time.perf_counter()
         n_ref = None
-        if db.strategy == "wavefront":
-            bp, s, n_coh = _run_wavefront(db, jnp.float32(job.kappa_mult),
-                                          passes=self.params.gs_passes)
-        elif db.strategy == "batched":
+        if db.strategy == "batched":
             bp, s, counts = _run_batched(db, jnp.float32(job.kappa_mult))
             n_coh, n_ref = int(counts[0]), int(counts[1])
         else:

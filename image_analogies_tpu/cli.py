@@ -42,9 +42,6 @@ def _add_engine_flags(p: argparse.ArgumentParser) -> None:
                    choices=("exact", "rowwise", "batched", "wavefront",
                             "auto"),
                    default=None)
-    p.add_argument("--gs-passes", type=int, default=None,
-                   help="wavefront strategy: cap on Gauss-Seidel re-resolve "
-                        "passes per row (iterates to fixed point)")
     p.add_argument("--db-shards", type=int, default=None)
     p.add_argument("--no-ann", action="store_true",
                    help="disable the cKDTree index (CPU backend brute force)")
@@ -60,7 +57,7 @@ def _add_engine_flags(p: argparse.ArgumentParser) -> None:
 
 def _params_from_args(args, base: AnalogyParams) -> AnalogyParams:
     kw = {}
-    for name in ("levels", "kappa", "backend", "strategy", "gs_passes",
+    for name in ("levels", "kappa", "backend", "strategy",
                  "db_shards", "checkpoint_dir", "resume_from_level",
                  "log_path", "profile_dir"):
         v = getattr(args, name)

@@ -63,21 +63,15 @@ class AnalogyParams:
     #               candidates, so each scan row resolves fully in parallel
     #               (one fused Pallas argmin + one batched coherence gather
     #               per row).  SURVEY.md §7 hard part 1's sanctioned lever.
-    #   "wavefront" - the PARITY fast path: per row, batched full-DB Pallas
-    #               argmin anchors + a sequential coherence/kappa pass, then
-    #               `gs_passes` Gauss-Seidel re-resolves with queries rebuilt
-    #               from the current row estimate.  The oracle's sequential
-    #               output is a fixed point of this iteration; measured SSIM
-    #               vs the oracle is 1.000 at 128² on structured inputs
-    #               (experiments/gs_probe.py), vs ~0.6 for batched/rowwise.
-    #   "auto"    - batched.
+    #   "wavefront" - the PARITY fast path: the raster scan re-scheduled onto
+    #               anti-diagonals skewed by patch_radius+1, so every causal
+    #               dependency lands on an earlier diagonal and each
+    #               diagonal's pixels resolve in one batch with the oracle's
+    #               exact per-pixel rule (backends/tpu.py
+    #               wavefront_scan_core).  Output equals the CPU/cKDTree
+    #               oracle's up to fp tie-breaks, at batched-like speed.
+    #   "auto"    - wavefront.
     strategy: str = "auto"
-
-    # Cap on Gauss-Seidel re-resolve passes per row of the "wavefront"
-    # strategy.  Each row iterates only until its source map stops changing
-    # (usually 1-3 passes — experiments/gs_probe.py); the cap bounds
-    # pathological rows that cycle instead of converging.
-    gs_passes: int = 8
 
     # Use the cKDTree index for the CPU approximate match (the reference's ANN
     # toggle); False = brute force (native C++ matcher if built, else NumPy).
@@ -113,8 +107,6 @@ class AnalogyParams:
         if self.strategy not in ("exact", "rowwise", "batched", "wavefront",
                                  "auto"):
             raise ValueError(f"unknown strategy {self.strategy!r}")
-        if self.gs_passes < 0:
-            raise ValueError(f"gs_passes must be >= 0, got {self.gs_passes}")
         if self.db_shards < 1:
             raise ValueError(f"db_shards must be >= 1, got {self.db_shards}")
 

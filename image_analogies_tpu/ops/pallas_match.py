@@ -36,7 +36,8 @@ def _round_up(x: int, m: int) -> int:
 
 
 def _argmin_kernel(q_ref, db_ref, dbn_ref, idx_out, val_out,
-                   best_val, best_idx, *, tile_n: int, n_total: int):
+                   best_val, best_idx, *, tile_n: int, n_total: int,
+                   precision):
     """One grid step: score one DB tile against all queries, fold into the
     running (min, argmin) scratch; write outputs on the last tile."""
     t = pl.program_id(0)
@@ -46,11 +47,19 @@ def _argmin_kernel(q_ref, db_ref, dbn_ref, idx_out, val_out,
         best_val[:] = jnp.full_like(best_val, jnp.inf)
         best_idx[:] = jnp.zeros_like(best_idx)
 
-    # scores[m, n] = dbn[n] - 2 * q[m] . db[n]   (M, TILE_N), fp32 on the MXU
+    # scores[m, n] = dbn[n] - 2 * q[m] . db[n]   (M, TILE_N) on the MXU.
+    # Precision matters for fp32 inputs: the TPU MXU multiplies in bf16
+    # passes, and the DEFAULT single pass gives ~1e-3 score error — enough to
+    # flip argmin picks vs an exact fp32 re-score.  The wavefront (oracle
+    # parity) strategy therefore runs this kernel at HIGHEST (3 bf16 passes,
+    # fp32-grade scores, ~2x wall-clock); the approximate batched strategy
+    # keeps the fast DEFAULT pass.  bf16 inputs are unaffected either way:
+    # their single pass IS the operands' full precision.
     scores = dbn_ref[:] - 2.0 * jax.lax.dot_general(
         q_ref[:], db_ref[:],
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=_F32,
+        precision=precision,
     )
     # mask DB padding rows (global index >= n_total)
     col = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
@@ -71,7 +80,8 @@ def _argmin_kernel(q_ref, db_ref, dbn_ref, idx_out, val_out,
         val_out[:] = best_val[:]
 
 
-@functools.partial(jax.jit, static_argnames=("tile_n", "interpret", "bf16"))
+@functools.partial(jax.jit, static_argnames=("tile_n", "interpret", "bf16",
+                                             "precision"))
 def pallas_argmin_l2(
     queries: jax.Array,  # (M, F) fp32
     db: jax.Array,  # (N, F) fp32 or bf16
@@ -80,6 +90,7 @@ def pallas_argmin_l2(
     tile_n: int = 512,
     interpret: bool = False,
     bf16: bool = False,
+    precision=jax.lax.Precision.DEFAULT,
 ) -> Tuple[jax.Array, jax.Array]:
     """Fused argmin kernel.  Returns (idx (M,) int32, sqdist (M,) fp32).
 
@@ -105,13 +116,15 @@ def pallas_argmin_l2(
     dbn = jnp.full((1, npad), jnp.inf, _F32).at[0, :n].set(db_sqnorm)
 
     idx, val = pallas_argmin_l2_prepadded(q, dbp, dbn, tile_n=tile_n,
-                                          interpret=interpret)
+                                          interpret=interpret,
+                                          precision=precision)
     qn = jnp.sum(queries * queries, axis=1)
     dist = jnp.maximum(val[:m] + qn, 0.0)
     return idx[:m], dist
 
 
-@functools.partial(jax.jit, static_argnames=("tile_n", "interpret"))
+@functools.partial(jax.jit, static_argnames=("tile_n", "interpret",
+                                             "precision"))
 def pallas_argmin_l2_prepadded(
     q: jax.Array,  # (Mp, Fp) already tile-aligned
     dbp: jax.Array,  # (Npad, Fp) already tile-aligned (zero feature padding)
@@ -119,6 +132,7 @@ def pallas_argmin_l2_prepadded(
     *,
     tile_n: int = 2048,
     interpret: bool = False,
+    precision=jax.lax.Precision.DEFAULT,
 ) -> Tuple[jax.Array, jax.Array]:
     """Padding-free kernel entry for hot loops: callers pre-pad ONCE per
     level (backends/tpu.py) so the per-row scan doesn't re-copy the DB.
@@ -130,7 +144,8 @@ def pallas_argmin_l2_prepadded(
     assert npad % tile_n == 0, (npad, tile_n)
 
     grid = npad // tile_n
-    kernel = functools.partial(_argmin_kernel, tile_n=tile_n, n_total=npad)
+    kernel = functools.partial(_argmin_kernel, tile_n=tile_n, n_total=npad,
+                               precision=precision)
     idx, val = pl.pallas_call(
         kernel,
         grid=(grid,),
@@ -179,8 +194,12 @@ def xla_argmin_l2(queries: jax.Array, db: jax.Array,
     return idx, jnp.maximum(d + qn, 0.0)
 
 
-def argmin_l2(queries, db, db_sqnorm, *, force_xla: bool = False):
-    """Dispatch: Pallas on TPU, XLA elsewhere."""
+def argmin_l2(queries, db, db_sqnorm, *, force_xla: bool = False,
+              precision=jax.lax.Precision.DEFAULT):
+    """Dispatch: Pallas on TPU, XLA elsewhere.  ``precision`` governs the
+    Pallas kernel's MXU passes (parity callers pass HIGHEST); the XLA
+    fallback always scores at HIGHEST — it exists for CPU platforms where
+    fp32 is native and exactness is the point."""
     if force_xla or jax.default_backend() != "tpu":
         return xla_argmin_l2(queries, db, db_sqnorm)
-    return pallas_argmin_l2(queries, db, db_sqnorm)
+    return pallas_argmin_l2(queries, db, db_sqnorm, precision=precision)

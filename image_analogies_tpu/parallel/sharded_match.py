@@ -41,16 +41,20 @@ def shard_db(db: jax.Array, db_sqnorm: jax.Array, mesh: Mesh,
 
 
 def make_sharded_argmin(mesh: Mesh, axis: str = "db",
-                        force_xla: bool = False) -> Callable:
+                        force_xla: bool = False,
+                        precision=jax.lax.Precision.DEFAULT) -> Callable:
     """Returns argmin_fn(queries (M,F), db_sharded, dbn_sharded) -> (idx, d).
 
     Queries are replicated over `axis`; the DB stays sharded.  The returned
     global index refers to the PADDED row space (callers built it via
     `shard_db`, real rows come first so indices < n are unaffected).
+    ``precision`` reaches the per-shard Pallas kernel: the wavefront parity
+    path passes HIGHEST so sharded picks equal the oracle's argmin.
     """
 
     def local(q, db_shard, dbn_shard):
-        idx, d = argmin_l2(q, db_shard, dbn_shard, force_xla=force_xla)
+        idx, d = argmin_l2(q, db_shard, dbn_shard, force_xla=force_xla,
+                           precision=precision)
         shard = jax.lax.axis_index(axis)
         gidx = idx + shard * db_shard.shape[0]
         # min+argmin all-reduce: per-shard winners are (M,) scalars -> the
