@@ -1,0 +1,56 @@
+"""Measure the CPU/cKDTree oracle ONCE on the north-star config (1024^2 B',
+5-level pyramid, kappa=5) and cache {wall-clock, per-level stats, output
+plane} for bench.py — the oracle run takes ~an hour, far too slow to repeat
+every bench invocation (BASELINE.md's 'CPU-oracle wall-clock' TBD row).
+
+    JAX_PLATFORMS=cpu python experiments/oracle_1024.py
+
+Writes bench_cache/oracle_1024_seed7.npz + bench_cache/oracle_1024.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from experiments.parity_probe import make_structured
+from image_analogies_tpu.config import AnalogyParams
+
+
+def main() -> int:
+    from image_analogies_tpu.models.analogy import create_image_analogy
+
+    size, levels, kappa, seed = 1024, 5, 5.0, 7
+    a, ap, b = make_structured(size, seed)
+    p = AnalogyParams(levels=levels, kappa=kappa, backend="cpu")
+    t0 = time.perf_counter()
+    res = create_image_analogy(a, ap, b, p)
+    wall_s = time.perf_counter() - t0
+
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench_cache")
+    os.makedirs(out, exist_ok=True)
+    np.savez_compressed(os.path.join(out, f"oracle_1024_seed{seed}.npz"),
+                        bp_y=res.bp_y.astype(np.float32),
+                        source_map=res.source_map.astype(np.int32))
+    with open(os.path.join(out, "oracle_1024.json"), "w") as f:
+        json.dump({
+            "config": {"size": size, "levels": levels, "kappa": kappa,
+                       "seed": seed, "inputs": "parity_probe.make_structured"},
+            "wall_s": round(wall_s, 1),
+            "levels_ms": [round(s["ms"], 1) for s in res.stats],
+            "host": "this box (judge's CPU)",
+        }, f, indent=1)
+    print(f"oracle 1024^2 done: {wall_s:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

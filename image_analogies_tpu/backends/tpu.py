@@ -20,7 +20,7 @@ Strategies (see config.AnalogyParams.strategy):
   kept for parity validation.
 - "rowwise": batched approximate search per scan row + sequential exact
   coherence/kappa pass.
-- "batched" (default): the causal window is restricted to strictly-above rows
+- "batched": the causal window is restricted to strictly-above rows
   for queries, DB masking AND coherence candidates, so a whole scan row
   resolves in parallel: one fused Pallas distance+argmin (HBM-resident DB,
   sharded over the mesh 'db' axis when db_shards > 1), one batched coherence
@@ -37,7 +37,7 @@ Strategies (see config.AnalogyParams.strategy):
   metric), and the result is the ORACLE'S OUTPUT by construction — same
   per-pixel rule, same dependency values, identical up to fp tie-breaks —
   at batched-strategy speed (~4k batched steps at 1024² instead of ~1M
-  sequential pixel steps).
+  sequential pixel steps).  This is what strategy="auto" resolves to.
 """
 
 from __future__ import annotations
@@ -60,6 +60,7 @@ from image_analogies_tpu.ops.features import (
     window_offsets,
 )
 from image_analogies_tpu.ops.pallas_match import (
+    _round_up,
     argmin_l2,
     pallas_argmin_l2_prepadded,
 )
@@ -75,7 +76,7 @@ _ARGMIN_TILE = 8192
 def _tile_rows(f: int) -> int:
     """Kernel tile rows for feature dim `f`, holding the VMEM tile bytes at
     _ARGMIN_TILE x 128 x 4 regardless of the padded feature width."""
-    fp = max((f + 127) // 128 * 128, 128)
+    fp = max(_round_up(f, 128), 128)
     return max(512, _ARGMIN_TILE * 128 // fp)
 
 _F32 = jnp.float32

@@ -67,7 +67,9 @@ def _prep_planes(a, ap, b, params):
             raise ValueError(
                 f"A ({a_nc}ch) and B ({b_nc}ch) must have matching channels")
         if params.remap_luminance and a_src.ndim == 2:
-            a_src = color.remap_luminance(a_src, b_src)
+            # the SAME affine transform must hit both planes (remap_pair's
+            # invariant) or an affine filter A -> A' would be cancelled
+            a_src, a_filt = color.remap_pair(a_src, a_filt, b_src)
     return a_src, b_src, a_filt, ap, b_yiq
 
 
@@ -106,6 +108,7 @@ def create_image_analogy(
     bp_pyr: List[Optional[np.ndarray]] = [None] * levels
     s_pyr: List[Optional[np.ndarray]] = [None] * levels
     stats: List[Dict[str, Any]] = []
+    digest = ckpt.run_digest(params, a_src.shape[:2], b_src.shape[:2])
 
     prof = contextlib.nullcontext()
     if params.profile_dir:
@@ -117,7 +120,8 @@ def create_image_analogy(
         for level in range(levels - 1, -1, -1):  # coarsest -> finest
             if (params.checkpoint_dir and params.resume_from_level is not None
                     and level > params.resume_from_level):
-                loaded = ckpt.load_level(params.checkpoint_dir, level)
+                loaded = ckpt.load_level(params.checkpoint_dir, level,
+                                         digest=digest)
                 if loaded is not None:
                     bp_pyr[level], s_pyr[level] = loaded
                     ialog.emit({"event": "resume_level", "level": level},
@@ -151,7 +155,8 @@ def create_image_analogy(
             stats.append(st)
             ialog.emit(st, params.log_path)
             if params.checkpoint_dir:
-                ckpt.save_level(params.checkpoint_dir, level, bp, s)
+                ckpt.save_level(params.checkpoint_dir, level, bp, s,
+                                digest=digest)
 
     bp_y = bp_pyr[0]
     s_map = s_pyr[0]

@@ -67,13 +67,30 @@ def super_resolution(sharp_a: np.ndarray, low_b: np.ndarray,
 
 def texture_synthesis(texture: np.ndarray, out_shape,
                       params: Optional[AnalogyParams] = None,
+                      seed: Optional[int] = None, seed_weight: float = 0.1,
                       **overrides) -> AnalogyResult:
     """Synthesize an out_shape patch of more `texture` (src_weight = 0: only
-    the causal B' windows drive matching — Ashikhmin-style synthesis)."""
+    the causal B' windows drive matching — Ashikhmin-style synthesis).
+
+    With ``seed`` set, repeated syntheses DIFFER: A and B become noise
+    planes resampled from the exemplar's values with a small feature weight
+    (``src_weight = seed_weight``), randomizing the early approximate picks
+    while coherence still dominates the texture structure.  (Noise in B
+    alone would be inert — with A all-zero it shifts every DB row's distance
+    equally.)  ``seed=None`` keeps the fully deterministic degenerate
+    analogy.  An explicit ``src_weight`` override wins over ``seed_weight``."""
     params = (params or PRESETS["texture_synthesis"]).replace(**overrides)
-    if params.src_weight != 0.0:
-        params = params.replace(src_weight=0.0)
     tex = color.as_float(texture)
-    a = np.zeros(tex.shape[:2], np.float32)
-    b = np.zeros(tuple(out_shape), np.float32)
+    if seed is None:
+        if params.src_weight != 0.0:
+            params = params.replace(src_weight=0.0)
+        a = np.zeros(tex.shape[:2], np.float32)
+        b = np.zeros(tuple(out_shape), np.float32)
+    else:
+        rng = np.random.default_rng(seed)
+        vals = (tex if tex.ndim == 2 else color.luminance(tex)).reshape(-1)
+        a = rng.choice(vals, size=tex.shape[:2]).astype(np.float32)
+        b = rng.choice(vals, size=tuple(out_shape)).astype(np.float32)
+        if "src_weight" not in overrides:
+            params = params.replace(src_weight=seed_weight)
     return create_image_analogy(a, tex, b, params)

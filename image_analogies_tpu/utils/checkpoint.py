@@ -8,6 +8,7 @@ every already-finished (coarser) level instead of recomputing it.
 
 from __future__ import annotations
 
+import hashlib
 import os
 from typing import Optional, Tuple
 
@@ -18,20 +19,40 @@ def level_path(ckpt_dir: str, level: int) -> str:
     return os.path.join(ckpt_dir, f"level_{level:02d}.npz")
 
 
+def run_digest(params, a_shape, b_shape) -> str:
+    """Fingerprint of (engine params, input shapes): a checkpoint written
+    under a different run configuration must not be silently resumed — the
+    bp/s planes would be wrong-shaped or semantically stale."""
+    payload = repr((sorted(
+        (k, v) for k, v in vars(params).items()
+        # aux knobs that don't change the synthesis are excluded so e.g.
+        # enabling logging doesn't invalidate checkpoints
+        if k not in ("checkpoint_dir", "resume_from_level", "profile_dir",
+                     "log_path")),
+        tuple(a_shape), tuple(b_shape)))
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
 def save_level(ckpt_dir: str, level: int, bp: np.ndarray,
-               s: np.ndarray) -> str:
+               s: np.ndarray, digest: str = "") -> str:
     os.makedirs(ckpt_dir, exist_ok=True)
     path = level_path(ckpt_dir, level)
     tmp = path + ".tmp.npz"
-    np.savez(tmp, level=level, bp=bp, s=s)
+    np.savez(tmp, level=level, bp=bp, s=s, digest=digest)
     os.replace(tmp, path)
     return path
 
 
-def load_level(ckpt_dir: str, level: int
+def load_level(ckpt_dir: str, level: int, digest: str = ""
                ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Returns (bp, s) or None when missing OR stale: a checkpoint whose
+    recorded digest disagrees with the current run's is skipped (the level
+    recomputes) instead of resuming with wrong planes."""
     path = level_path(ckpt_dir, level)
     if not os.path.exists(path):
         return None
     with np.load(path) as z:
+        stored = str(z["digest"]) if "digest" in z.files else ""
+        if digest and stored != digest:
+            return None
         return z["bp"].astype(np.float32), z["s"].astype(np.int32)

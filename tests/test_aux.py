@@ -39,6 +39,31 @@ def test_resume_reuses_coarse_levels(tmp_path, rng):
     assert any(r.get("event") == "resume_level" for r in recs)
 
 
+def test_stale_checkpoint_not_resumed(tmp_path, rng):
+    """A checkpoint from a different run config (ADVICE round-1: shape or
+    params mismatch) must be recomputed, not silently resumed."""
+    a, ap, b = make_pair(16, 16, seed=5)
+    ckdir = str(tmp_path / "ck")
+    p = AnalogyParams(levels=2, backend="cpu", checkpoint_dir=ckdir)
+    create_image_analogy(a, ap, b, p)
+    # same dir, different kappa: digest differs -> loader returns None
+    p2 = p.replace(kappa=0.5, resume_from_level=0,
+                   log_path=str(tmp_path / "log.jsonl"))
+    r2 = create_image_analogy(a, ap, b, p2)
+    recs = [json.loads(l) for l in open(str(tmp_path / "log.jsonl"))]
+    assert not any(r.get("event") == "resume_level" for r in recs)
+    # and the run still completes correctly
+    assert r2.bp_y.shape == (16, 16)
+    # a LEGACY .npz (written before the digest field existed) must still
+    # load when the caller requests no digest, and be skipped when one is
+    # requested
+    legacy = ckpt.level_path(ckdir, 7)
+    np.savez(legacy, level=7, bp=np.zeros((4, 4), np.float32),
+             s=np.zeros((4, 4), np.int32))
+    assert ckpt.load_level(ckdir, 7) is not None
+    assert ckpt.load_level(ckdir, 7, digest="abc") is None
+
+
 def test_structured_log_records(tmp_path, rng):
     a, ap, b = make_pair(12, 12, seed=5)
     log = str(tmp_path / "log.jsonl")

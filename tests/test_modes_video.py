@@ -53,6 +53,36 @@ def test_texture_synthesis_ignores_src(rng):
     assert np.isin(res.bp.ravel(), tex.ravel()).all()
 
 
+def test_texture_synthesis_seed_varies_output(rng):
+    """ADVICE round-1: a seed must yield varied textures from one exemplar;
+    the same seed must reproduce, and pixels still come from the exemplar."""
+    tex = rng.uniform(0, 1, (16, 16)).astype(np.float32)
+    p = PRESETS["texture_synthesis"].replace(levels=1)
+    r1 = modes.texture_synthesis(tex, (12, 12), p, seed=1)
+    r1b = modes.texture_synthesis(tex, (12, 12), p, seed=1)
+    r2 = modes.texture_synthesis(tex, (12, 12), p, seed=2)
+    np.testing.assert_array_equal(r1.bp, r1b.bp)
+    assert (r1.bp != r2.bp).any()
+    assert np.isin(r1.bp.ravel(), tex.ravel()).all()
+
+
+def test_source_rgb_remap_preserves_pair_relation(rng):
+    """ADVICE round-1: in source_rgb mode with grayscale planes and
+    remap_luminance=True, A and A' must receive the SAME affine transform
+    (an affine filter A -> A' is preserved)."""
+    from image_analogies_tpu.models.analogy import _prep_planes
+    from image_analogies_tpu.config import AnalogyParams
+
+    a = rng.uniform(0.2, 0.6, (12, 12)).astype(np.float32)
+    ap = (0.5 * a + 0.2).astype(np.float32)  # affine filter
+    b = rng.uniform(0, 1, (12, 12)).astype(np.float32)
+    p = AnalogyParams(color_mode="source_rgb", remap_luminance=True)
+    a_src, b_src, a_filt, _, _ = _prep_planes(a, ap, b, p)
+    # the affine relation A' = 0.5 A + const must survive the remap
+    resid = a_filt - 0.5 * a_src
+    assert np.std(resid) < 1e-5, np.std(resid)
+
+
 def test_video_two_phase_and_sequential(small):
     a, ap, _ = small
     r = np.random.default_rng(0)

@@ -1,0 +1,121 @@
+"""Direct Pallas argmin-kernel tests via interpret mode (SURVEY.md §4.3,
+round-1 VERDICT item 4 / ADVICE medium).
+
+`pallas_argmin_l2` only dispatches on real TPUs, so without these tests the
+kernel's masking/tie-break/scratch logic would be exercised by nothing in CI.
+``interpret=True`` runs the same kernel body through the Pallas interpreter
+on CPU; `xla_argmin_l2` (plain jnp, HIGHEST precision) is the reference.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from image_analogies_tpu.ops.pallas_match import (
+    pallas_argmin_l2,
+    pallas_argmin_l2_prepadded,
+    xla_argmin_l2,
+)
+
+HIGHEST = jax.lax.Precision.HIGHEST
+
+
+def _mk(m, f, n, seed):
+    rng = np.random.default_rng(seed)
+    q = rng.standard_normal((m, f)).astype(np.float32)
+    db = rng.standard_normal((n, f)).astype(np.float32)
+    return jnp.asarray(q), jnp.asarray(db), jnp.sum(jnp.asarray(db) ** 2, 1)
+
+
+@pytest.mark.parametrize("m,f,n,tile", [
+    (7, 68, 500, 512),     # N < tile (single partial tile)
+    (8, 68, 512, 512),     # exact tile fit
+    (13, 68, 1300, 512),   # N not a multiple of tile, M odd
+    (4, 136, 700, 256),    # F > 128 (RGB label features, padded to 256)
+    (1, 20, 3, 512),       # degenerate tiny shapes
+    (32, 68, 2048, 256),   # multi-tile grid (8 tiles)
+])
+def test_kernel_matches_xla(m, f, n, tile):
+    q, db, dbn = _mk(m, f, n, seed=n + m)
+    ref_i, ref_d = xla_argmin_l2(q, db, dbn)
+    idx, d = pallas_argmin_l2(q, db, dbn, tile_n=tile, interpret=True,
+                              precision=HIGHEST)
+    np.testing.assert_array_equal(np.asarray(idx), np.asarray(ref_i))
+    np.testing.assert_allclose(np.asarray(d), np.asarray(ref_d),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_padding_rows_never_win():
+    # all real DB rows are FAR from the queries; if +inf masking of the
+    # padded rows (zeros — which would be closest) regressed, they would win
+    q, db, dbn = _mk(5, 68, 700, seed=0)
+    db = db + 100.0
+    dbn = jnp.sum(db * db, axis=1)
+    idx, d = pallas_argmin_l2(q, db, dbn, tile_n=512, interpret=True,
+                              precision=HIGHEST)
+    assert int(jnp.max(idx)) < 700
+    assert float(jnp.min(d)) > 1000.0
+
+
+@pytest.mark.parametrize("dup_pair", [(3, 250), (0, 699), (511, 512)])
+def test_duplicate_row_tiebreak_lowest_index(dup_pair):
+    # a duplicated best row must resolve to the LOWEST index, including when
+    # the duplicates land in different grid tiles (511 vs 512 at tile 512)
+    lo, hi = dup_pair
+    q, db, dbn = _mk(4, 68, 700, seed=9)
+    best = q[0] * 1.0  # row equal to query 0 -> distance 0, the global min
+    db = db.at[lo].set(best).at[hi].set(best)
+    dbn = jnp.sum(db * db, axis=1)
+    idx, d = pallas_argmin_l2(q, db, dbn, tile_n=512, interpret=True,
+                              precision=HIGHEST)
+    assert int(idx[0]) == lo
+    np.testing.assert_allclose(float(d[0]), 0.0, atol=1e-4)
+
+
+def test_prepadded_matches_plain():
+    m, f, n, tile = 6, 68, 900, 512
+    q, db, dbn = _mk(m, f, n, seed=4)
+    ref_i, ref_d = pallas_argmin_l2(q, db, dbn, tile_n=tile, interpret=True,
+                                    precision=HIGHEST)
+    # pad exactly the way backends/tpu.py does per level
+    fp = max((f + 127) // 128 * 128, 128)
+    mp = (m + 7) // 8 * 8
+    npad = (n + tile - 1) // tile * tile
+    qp = jnp.zeros((mp, fp), jnp.float32).at[:m, :f].set(q)
+    dbp = jnp.zeros((npad, fp), jnp.float32).at[:n, :f].set(db)
+    dbnp = jnp.full((1, npad), jnp.inf, jnp.float32).at[0, :n].set(dbn)
+    idx, score = pallas_argmin_l2_prepadded(qp, dbp, dbnp, tile_n=tile,
+                                            interpret=True,
+                                            precision=HIGHEST)
+    qn = jnp.sum(q * q, axis=1)
+    np.testing.assert_array_equal(np.asarray(idx[:m]), np.asarray(ref_i))
+    np.testing.assert_allclose(np.asarray(score[:m] + qn), np.asarray(ref_d),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_bf16_mode_winner_within_tolerance():
+    # bf16 mode trades exact picks for bandwidth; its contract (used by the
+    # approximate batched strategy only) is that the winner's TRUE distance
+    # is within bf16 noise of the true minimum
+    m, f, n = 9, 68, 1500
+    q, db, dbn = _mk(m, f, n, seed=11)
+    ref_i, ref_d = xla_argmin_l2(q, db, dbn)
+    idx, _ = pallas_argmin_l2(q, db, dbn, tile_n=512, interpret=True,
+                              bf16=True)
+    true_d = jnp.sum((db[idx] - q) ** 2, axis=1)
+    # |d_pick - d_min| bounded by the bf16 quantization of the dot products
+    scale = jnp.abs(ref_d) + jnp.sum(jnp.abs(db[idx] * q), axis=1)
+    assert np.all(np.asarray(true_d) <= np.asarray(ref_d + 0.03 * scale))
+
+
+def test_default_precision_is_argmin_grade_on_cpu():
+    # on the interpreter there are no bf16 MXU passes: DEFAULT == HIGHEST.
+    # This locks the kernel's plumbing of the precision static arg.
+    q, db, dbn = _mk(5, 68, 600, seed=2)
+    i1, _ = pallas_argmin_l2(q, db, dbn, tile_n=512, interpret=True,
+                             precision=jax.lax.Precision.DEFAULT)
+    i2, _ = pallas_argmin_l2(q, db, dbn, tile_n=512, interpret=True,
+                             precision=HIGHEST)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
