@@ -604,8 +604,12 @@ class TpuMatcher(Matcher):
         pad_full = strategy == "wavefront"
         sharded = (self.params.db_shards > 1
                    and strategy in ("batched", "wavefront"))
+        # data_shards > 1 means the multi-frame mesh step (parallel/step.py)
+        # supplies its own sharded approx_fn — don't build the single-chip
+        # prepadded DB copy it would never read.
         pad_tile = 0
         if strategy in ("batched", "wavefront") and not sharded \
+                and self.params.data_shards == 1 \
                 and jax.default_backend() == "tpu":
             na = ha * wa
             pad_tile = min(_tile_rows(spec.total),

@@ -43,6 +43,9 @@ def _add_engine_flags(p: argparse.ArgumentParser) -> None:
                             "auto"),
                    default=None)
     p.add_argument("--db-shards", type=int, default=None)
+    p.add_argument("--data-shards", type=int, default=None,
+                   help="video mode: shard frames over this many mesh "
+                        "devices (two_phase scheme, data x db mesh)")
     p.add_argument("--no-ann", action="store_true",
                    help="disable the cKDTree index (CPU backend brute force)")
     p.add_argument("--no-remap", action="store_true",
@@ -58,7 +61,8 @@ def _add_engine_flags(p: argparse.ArgumentParser) -> None:
 def _params_from_args(args, base: AnalogyParams) -> AnalogyParams:
     kw = {}
     for name in ("levels", "kappa", "backend", "strategy",
-                 "db_shards", "checkpoint_dir", "resume_from_level",
+                 "db_shards", "data_shards",
+                 "checkpoint_dir", "resume_from_level",
                  "log_path", "profile_dir"):
         v = getattr(args, name)
         if v is not None:

@@ -78,8 +78,11 @@ class AnalogyParams:
     use_ann: bool = True
 
     # Parallelism (SURVEY.md §5.7-5.8): shard the A/A' patch DB over `db_shards`
-    # mesh devices; video mode shards frames over the `data` axis.
+    # mesh devices; video mode shards frames over `data_shards` devices of the
+    # (data, db) mesh (BASELINE.json:12) — `models/video.py` dispatches the
+    # two_phase scheme through `parallel/step.py` when data_shards > 1.
     db_shards: int = 1
+    data_shards: int = 1
 
     # Video mode: weight of the temporal-coherence feature term (previous
     # frame's B' window appended to the feature vector, BASELINE.json:12).
@@ -109,6 +112,9 @@ class AnalogyParams:
             raise ValueError(f"unknown strategy {self.strategy!r}")
         if self.db_shards < 1:
             raise ValueError(f"db_shards must be >= 1, got {self.db_shards}")
+        if self.data_shards < 1:
+            raise ValueError(
+                f"data_shards must be >= 1, got {self.data_shards}")
 
     def replace(self, **kw) -> "AnalogyParams":
         return dataclasses.replace(self, **kw)

@@ -11,25 +11,60 @@ Two execution schemes:
 - ``scheme="sequential"``: frame t consumes frame t-1's actual output.
   Highest temporal fidelity, strictly serial.
 - ``scheme="two_phase"`` (default): phase 1 synthesizes ALL frames
-  independently (embarrassingly parallel — this is the axis that shards over
-  the mesh 'data' axis); phase 2 re-synthesizes every frame with the temporal
-  term fed by phase 1's neighbor output.  Both phases are data-parallel over
-  frames, trading one extra pass for a pod-width speedup (a Jacobi iteration
-  of the sequential recurrence).
+  independently (embarrassingly parallel); phase 2 re-synthesizes every frame
+  with the temporal term fed by phase 1's neighbor output.  Both phases are
+  data-parallel over frames (a Jacobi iteration of the sequential
+  recurrence).
 
-The per-frame engine is the full pluggable-backend pipeline, so video mode
-composes with db-sharding: a (data, db) mesh shards frames x patch-DB.
+**Multi-chip execution** (the production path for BASELINE.json:12): with
+``params.data_shards > 1`` the two_phase scheme dispatches each pyramid
+level of ALL frames through ONE `shard_map` program on a ('data','db') mesh
+(`parallel/step.py`): frames shard over 'data' and vmap within a chip, the
+patch DB shards over 'db' with the min+argmin all-reduce.  Semantics note:
+the sharded path computes the luminance remap (Hertzmann §3.4) against the
+clip's FIRST frame and reuses it for every frame of both phases — one
+consistent A mapping per clip (less flicker) — whereas the serial path
+remaps per frame; with
+``remap_luminance=False`` the two paths produce identical frames (locked by
+tests/test_video_sharded.py).
 """
 
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from image_analogies_tpu.config import AnalogyParams
-from image_analogies_tpu.models.analogy import AnalogyResult, create_image_analogy
+from image_analogies_tpu.models.analogy import (
+    AnalogyResult,
+    _prep_planes,
+    create_image_analogy,
+)
+from image_analogies_tpu.ops import color
+from image_analogies_tpu.utils import logging as ialog
+
+
+_static_q_fn = None
+
+
+def _static_q_jit(spec, b_src, b_src_coarse, b_filt_coarse, b_temporal):
+    """Jitted query-side feature build (one fused program per frame instead
+    of eager per-op PJRT dispatch — same reasoning as tpu.py's
+    `_prepare_level_arrays`)."""
+    global _static_q_fn
+    if _static_q_fn is None:
+        import jax
+
+        from image_analogies_tpu.ops.features import build_features_jax
+
+        _static_q_fn = jax.jit(
+            lambda spec, b, bc, bfc, bt: build_features_jax(
+                spec, b, None, bc, bfc, temporal_fine=bt),
+            static_argnums=0)
+    return _static_q_fn(spec, b_src, b_src_coarse, b_filt_coarse, b_temporal)
 
 
 @dataclass
@@ -37,6 +72,146 @@ class VideoResult:
     frames: List[np.ndarray]  # synthesized B' frames
     frames_y: List[np.ndarray]  # synthesized luminance planes
     stats: List[Dict[str, Any]] = field(default_factory=list)
+
+
+def _sharded_phase(a, ap, frames, params: AnalogyParams, mesh,
+                   temporal_prevs: Optional[Sequence[np.ndarray]],
+                   stats: List[Dict[str, Any]], tag: str,
+                   remap_anchor: np.ndarray, frame_offset: int = 0
+                   ) -> List[AnalogyResult]:
+    """Synthesize a batch of frames level-lockstep on the ('data','db') mesh.
+
+    All frames advance one pyramid level per `multichip_level_step` call; the
+    A/A' DB is built once per level — its luminance remap is computed against
+    ``remap_anchor`` (the CLIP's first frame, for both phases — see module
+    docstring) — and only the per-frame query-side features differ.
+    ``frame_offset`` maps batch indices back to clip frame numbers in stats.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from image_analogies_tpu.backends.base import LevelJob
+    from image_analogies_tpu.backends.tpu import TpuMatcher
+    from image_analogies_tpu.ops.features import build_features_jax, \
+        spec_for_level
+    from image_analogies_tpu.ops.pyramid import build_pyramid_np, \
+        num_feasible_levels
+    from image_analogies_tpu.parallel.sharded_match import shard_db
+    from image_analogies_tpu.parallel.step import multichip_level_step
+
+    t_real = len(frames)
+    data_shards = mesh.shape["data"]
+    # pad the frame batch to the mesh width by repeating the last frame;
+    # padded outputs are dropped
+    t_pad = (t_real + data_shards - 1) // data_shards * data_shards
+    idx = list(range(t_real)) + [t_real - 1] * (t_pad - t_real)
+
+    a_src, _, a_filt, ap_rgb, _ = _prep_planes(a, ap, remap_anchor, params)
+    preps = [_prep_planes(a, ap, frames[i], params) for i in idx]
+    b_srcs = [p[1] for p in preps]
+    b_yiqs = [p[4] for p in preps]
+
+    min_shape = (min(a_src.shape[0], min(b.shape[0] for b in b_srcs)),
+                 min(a_src.shape[1], min(b.shape[1] for b in b_srcs)))
+    levels = num_feasible_levels(min_shape, params.levels, params.patch_size)
+    src_channels = 1 if a_src.ndim == 2 else a_src.shape[-1]
+    temporal = params.temporal_weight > 0 and temporal_prevs is not None
+
+    a_src_pyr = build_pyramid_np(a_src, levels)
+    a_filt_pyr = build_pyramid_np(a_filt, levels)
+    b_src_pyrs = [build_pyramid_np(b, levels) for b in b_srcs]
+    b_temp_pyrs = None
+    if temporal:
+        prevs = [np.asarray(temporal_prevs[i], np.float32) for i in idx]
+        b_temp_pyrs = [build_pyramid_np(p, levels) for p in prevs]
+
+    matcher = TpuMatcher(params.replace(db_shards=1))
+    force_xla = jax.default_backend() != "tpu"
+    strategy = params.strategy
+    if strategy == "auto":
+        strategy = "wavefront"
+
+    bp_pyrs = [[None] * levels for _ in range(t_pad)]
+    s_pyrs = [[None] * levels for _ in range(t_pad)]
+
+    for level in range(levels - 1, -1, -1):
+        spec = spec_for_level(params, level, levels, src_channels,
+                              temporal=temporal)
+        coarse = level + 1 < levels
+
+        def job_for(i):
+            return LevelJob(
+                level=level,
+                spec=spec,
+                kappa_mult=params.kappa_factor(level) ** 2,
+                a_src=a_src_pyr[level],
+                a_filt=a_filt_pyr[level],
+                b_src=b_src_pyrs[i][level],
+                a_src_coarse=a_src_pyr[level + 1] if coarse else None,
+                a_filt_coarse=a_filt_pyr[level + 1] if coarse else None,
+                b_src_coarse=b_src_pyrs[i][level + 1] if coarse else None,
+                b_filt_coarse=bp_pyrs[i][level + 1] if coarse else None,
+                a_temporal=a_filt_pyr[level] if temporal else None,
+                b_temporal=b_temp_pyrs[i][level] if temporal else None,
+            )
+
+        job0 = job_for(0)
+        db0 = matcher.build_features(job0)
+        # the multichip step provides its own approx_fn; drop the
+        # single-chip prepadded arrays so they aren't shipped to the mesh
+        template = dataclasses.replace(db0, db_pad=None, dbn_pad=None)
+
+        to_j = lambda x: None if x is None else jnp.asarray(x, jnp.float32)
+        static_qs = [db0.static_q]
+        for i in range(1, t_pad):
+            j = job_for(i)
+            static_qs.append(_static_q_jit(
+                spec, to_j(j.b_src), to_j(j.b_src_coarse),
+                to_j(j.b_filt_coarse), to_j(j.b_temporal)))
+        frame_static_q = jnp.stack(static_qs)
+
+        score_db, score_dbn = (
+            (template.db, template.db_sqnorm) if strategy == "wavefront"
+            else (template.db_rowsafe, template.db_rowsafe_sqnorm))
+        dbp, dbnp = shard_db(score_db, score_dbn, mesh)
+
+        bp, s, n_coh = multichip_level_step(
+            mesh, frame_static_q, dbp, dbnp, template,
+            job0.kappa_mult, force_xla=force_xla)
+        bp = np.asarray(bp, np.float32)
+        s = np.asarray(s, np.int32)
+        hb, wb = job0.b_shape
+        for i in range(t_pad):
+            bp_pyrs[i][level] = bp[i].reshape(hb, wb)
+            s_pyrs[i][level] = s[i].reshape(hb, wb)
+        for i in range(t_real):
+            rec = {
+                "level": level, "frame": frame_offset + i, "phase": tag,
+                "db_rows": int(template.db.shape[0]), "pixels": hb * wb,
+                "coherence_ratio": float(n_coh[i]) / max(hb * wb, 1),
+                "backend": "tpu", "strategy": strategy,
+                "mesh": dict(mesh.shape),
+            }
+            stats.append(rec)
+            ialog.emit(rec, params.log_path)
+
+    results = []
+    for i in range(t_real):
+        bp_y = bp_pyrs[i][0]
+        s_map = s_pyrs[i][0]
+        if params.color_mode == "source_rgb":
+            ap_flat = (ap_rgb.reshape(-1, ap_rgb.shape[-1])
+                       if ap_rgb.ndim == 3 else ap_rgb.reshape(-1))
+            out = ap_flat[s_map.reshape(-1)].reshape(
+                bp_y.shape + (() if ap_rgb.ndim == 2
+                              else (ap_rgb.shape[-1],)))
+        elif b_yiqs[i] is not None:
+            out = color.yiq2rgb(np.stack(
+                [bp_y, b_yiqs[i][..., 1], b_yiqs[i][..., 2]], axis=-1))
+        else:
+            out = np.clip(bp_y, 0.0, 1.0)
+        results.append(AnalogyResult(bp=out, bp_y=bp_y, source_map=s_map))
+    return results
 
 
 def video_analogy(
@@ -54,6 +229,49 @@ def video_analogy(
         return VideoResult(frames=[], frames_y=[])
 
     stats: List[Dict[str, Any]] = []
+
+    if params.data_shards > 1:
+        if scheme != "two_phase":
+            raise ValueError(
+                "frame sharding (data_shards > 1) requires the data-parallel "
+                "two_phase scheme; the sequential recurrence cannot shard")
+        if backend is not None:
+            raise ValueError("data_shards > 1 uses the mesh TPU path; a "
+                             "custom backend cannot be injected")
+        if params.strategy in ("exact", "rowwise"):
+            raise ValueError(
+                f"strategy {params.strategy!r} has no mesh scan core; frame "
+                "sharding supports 'wavefront' (oracle parity), 'batched', "
+                "or 'auto'")
+        if params.checkpoint_dir:
+            raise ValueError(
+                "checkpoint_dir is not supported with data_shards > 1 yet; "
+                "per-frame checkpointing only exists on the serial path")
+        import contextlib
+
+        from image_analogies_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(db_shards=params.db_shards,
+                         data_shards=params.data_shards)
+        prof = contextlib.nullcontext()
+        if params.profile_dir:
+            import jax
+
+            prof = jax.profiler.trace(params.profile_dir)
+        with prof:
+            phase1 = _sharded_phase(a, ap, frames, params, mesh, None,
+                                    stats, "phase1", remap_anchor=frames[0])
+            if len(frames) == 1:
+                outs = phase1
+            else:
+                prevs = [phase1[t - 1].bp_y for t in range(1, len(frames))]
+                phase2 = _sharded_phase(a, ap, frames[1:], params, mesh,
+                                        prevs, stats, "phase2",
+                                        remap_anchor=frames[0],
+                                        frame_offset=1)
+                outs = [phase1[0]] + phase2
+        return VideoResult(frames=[r.bp for r in outs],
+                           frames_y=[r.bp_y for r in outs], stats=stats)
 
     def synth(b, prev_y, tag, idx):
         res = create_image_analogy(a, ap, b, params, backend=backend,

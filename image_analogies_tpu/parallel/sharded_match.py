@@ -19,8 +19,8 @@ from typing import Callable, Tuple
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
 
+from image_analogies_tpu.parallel.mesh import shard_map
 from image_analogies_tpu.ops.pallas_match import argmin_l2
 
 
@@ -40,6 +40,28 @@ def shard_db(db: jax.Array, db_sqnorm: jax.Array, mesh: Mesh,
     return (jax.device_put(dbp, spec_db), jax.device_put(dbnp, spec_n))
 
 
+def local_argmin_allreduce(queries, db_shard, dbn_shard, axis: str,
+                           force_xla: bool = False,
+                           precision=jax.lax.Precision.DEFAULT):
+    """Per-shard fused argmin + the min+argmin all-reduce, for use INSIDE a
+    shard_map whose mesh has axis ``axis`` carrying the DB rows.
+
+    Per-shard winners are (M,) scalars, so the all_gather is D x M tiny;
+    ties resolve to the lowest shard, matching the single-chip lowest-index
+    tie-break (the returned index is in the PADDED global row space).  This
+    is the ONE copy of the tie-break invariant both the standalone sharded
+    matcher and the multi-frame video step rely on for oracle parity."""
+    idx, d = argmin_l2(queries, db_shard, dbn_shard, force_xla=force_xla,
+                       precision=precision)
+    gidx = idx + jax.lax.axis_index(axis) * db_shard.shape[0]
+    alld = jax.lax.all_gather(d, axis)  # (D, M)
+    alli = jax.lax.all_gather(gidx, axis)  # (D, M)
+    k = jnp.argmin(alld, axis=0)
+    d = jnp.take_along_axis(alld, k[None], axis=0)[0]
+    i = jnp.take_along_axis(alli, k[None], axis=0)[0]
+    return i.astype(jnp.int32), d
+
+
 def make_sharded_argmin(mesh: Mesh, axis: str = "db",
                         force_xla: bool = False,
                         precision=jax.lax.Precision.DEFAULT) -> Callable:
@@ -53,19 +75,9 @@ def make_sharded_argmin(mesh: Mesh, axis: str = "db",
     """
 
     def local(q, db_shard, dbn_shard):
-        idx, d = argmin_l2(q, db_shard, dbn_shard, force_xla=force_xla,
-                           precision=precision)
-        shard = jax.lax.axis_index(axis)
-        gidx = idx + shard * db_shard.shape[0]
-        # min+argmin all-reduce: per-shard winners are (M,) scalars -> the
-        # gather is D x M tiny; ties resolve to the lowest shard, matching
-        # the single-chip lowest-index tie-break.
-        alld = jax.lax.all_gather(d, axis)  # (D, M)
-        alli = jax.lax.all_gather(gidx, axis)  # (D, M)
-        k = jnp.argmin(alld, axis=0)
-        d = jnp.take_along_axis(alld, k[None], axis=0)[0]
-        i = jnp.take_along_axis(alli, k[None], axis=0)[0]
-        return i.astype(jnp.int32), d
+        return local_argmin_allreduce(q, db_shard, dbn_shard, axis,
+                                      force_xla=force_xla,
+                                      precision=precision)
 
     return shard_map(
         local, mesh=mesh,

@@ -1,0 +1,100 @@
+"""Production multi-chip video path (round-1 VERDICT items 3 + 7).
+
+`video_analogy(..., data_shards>1)` must dispatch frames through the
+('data','db') mesh step (`parallel/step.py`) and produce the SAME frames as
+the serial two_phase path (with `remap_luminance=False`; the sharded path
+remaps against the first frame by design — see models/video.py docstring),
+without re-jitting the shard_map per call.
+
+Runs on the 8-device virtual CPU mesh from conftest.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from tests.conftest import make_pair
+from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.models.video import video_analogy
+
+
+def _frames(a, n=4):
+    rng = np.random.default_rng(1)
+    return [np.clip(np.roll(a, t, axis=1)
+                    + 0.01 * rng.standard_normal(a.shape), 0, 1)
+            .astype(np.float32) for t in range(n)]
+
+
+@pytest.mark.parametrize("strategy", ["batched", "wavefront"])
+def test_sharded_video_matches_serial(strategy):
+    a, ap, _ = make_pair(20, 20, seed=2)
+    frames = _frames(a, 4)
+    base = dict(levels=2, kappa=2.0, backend="tpu", strategy=strategy,
+                temporal_weight=1.0, remap_luminance=False)
+    serial = video_analogy(a, ap, frames, AnalogyParams(**base))
+    sharded = video_analogy(
+        a, ap, frames, AnalogyParams(data_shards=2, db_shards=2, **base))
+    assert len(sharded.frames) == len(serial.frames)
+    for t, (fs, fr) in enumerate(zip(sharded.frames_y, serial.frames_y)):
+        np.testing.assert_allclose(fs, fr, atol=1e-5,
+                                   err_msg=f"frame {t} diverged")
+    # the sharded run went through the mesh step for every level x phase
+    mesh_recs = [s for s in sharded.stats if "mesh" in s]
+    assert mesh_recs and all(s["mesh"] == {"data": 2, "db": 2}
+                             for s in mesh_recs)
+
+
+def test_sharded_video_pads_odd_frame_count():
+    # 3 frames over data_shards=2: batch pads to 4, outputs drop the pad
+    a, ap, _ = make_pair(18, 18, seed=3)
+    frames = _frames(a, 3)
+    base = dict(levels=1, kappa=2.0, backend="tpu", strategy="batched",
+                temporal_weight=1.0, remap_luminance=False)
+    serial = video_analogy(a, ap, frames, AnalogyParams(**base))
+    sharded = video_analogy(
+        a, ap, frames, AnalogyParams(data_shards=2, db_shards=1, **base))
+    assert len(sharded.frames) == 3
+    for fs, fr in zip(sharded.frames_y, serial.frames_y):
+        np.testing.assert_allclose(fs, fr, atol=1e-5)
+
+
+def test_sharded_video_does_not_retrace():
+    """Two identical-shape calls must reuse the cached shard_map'd jit
+    (round-1 VERDICT weak item 2: per-call jax.jit re-tracing)."""
+    from image_analogies_tpu.parallel.mesh import make_mesh
+    from image_analogies_tpu.parallel.step import _cached_multichip_step
+
+    a, ap, _ = make_pair(16, 16, seed=4)
+    frames = _frames(a, 2)
+    p = AnalogyParams(levels=1, kappa=2.0, backend="tpu", strategy="batched",
+                      temporal_weight=1.0, remap_luminance=False,
+                      data_shards=2, db_shards=2)
+    video_analogy(a, ap, frames, p)
+    mesh = make_mesh(db_shards=2, data_shards=2)
+    step = _cached_multichip_step(mesh, "batched", True,
+                                  jax.lax.Precision.DEFAULT)
+    before = step._cache_size()
+    assert before > 0  # the run above used this cached jit
+    video_analogy(a, ap, frames, p)
+    assert step._cache_size() == before  # no new traces for equal shapes
+
+
+def test_sharded_video_remap_smoke():
+    # remap on: semantics differ from serial by design (first-frame remap);
+    # assert the path runs and produces sane output
+    a, ap, _ = make_pair(16, 16, seed=5)
+    frames = _frames(a, 2)
+    res = video_analogy(a, ap, frames, AnalogyParams(
+        levels=1, backend="tpu", strategy="wavefront", temporal_weight=1.0,
+        data_shards=2, db_shards=2))
+    assert len(res.frames) == 2
+    assert all(np.isfinite(f).all() for f in res.frames_y)
+
+
+def test_sequential_scheme_rejects_data_shards():
+    a, ap, _ = make_pair(16, 16, seed=6)
+    with pytest.raises(ValueError, match="two_phase"):
+        video_analogy(a, ap, _frames(a, 2),
+                      AnalogyParams(data_shards=2, temporal_weight=1.0),
+                      scheme="sequential")
