@@ -1,36 +1,71 @@
 """Benchmark harness — prints ONE JSON line for the driver.
 
-Measures the classic A:A'::B:B' filter config (BASELINE.json config 2 shape:
-256x256, 3-level pyramid, kappa=5) end-to-end on the TPU backend (batched
-strategy, Pallas fused argmin) and on the reference-equivalent NumPy/cKDTree
-CPU oracle, on this machine.
+Substantiates every clause of the north star (BASELINE.json:5): wall-clock
+for 1024^2 B' / 5-level pyramid, speedup >= 50x over the NumPy/cKDTree CPU
+oracle, AT SSIM PARITY — measured on the `wavefront` strategy, whose
+anti-diagonal schedule reproduces the oracle's algorithm exactly
+(backends/tpu.py), so the speedup and the parity are finally proven on the
+SAME strategy (round-1 VERDICT item 1).
 
-    metric      : config + hardware
-    value       : TPU wall-clock (warm, compile excluded), seconds
-    vs_baseline : CPU-oracle wall-clock / TPU wall-clock  (the ">= 50x the
-                  NumPy/cKDTree path" axis of BASELINE.json:5; >1 = faster)
+Inputs are structured perlin-like fields (natural-image statistics), not
+white noise: on noise the synthesis task is ambiguous everywhere and any
+quality metric is meaningless (round-1 VERDICT item 6).
+
+Two configs run:
+
+- north star: 1024^2 B', 5 levels, kappa=5.  The CPU oracle takes 1840.6 s
+  here, so it was measured ONCE (experiments/oracle_1024.py) and its
+  wall-clock + output plane are cached in bench_cache/ — SSIM is computed
+  live against the cached oracle output.
+- oil filter (BASELINE config 2): 256^2, 3 levels, kappa=5.  The oracle runs
+  LIVE (~3 min) so every bench invocation re-validates an end-to-end
+  oracle-vs-TPU number with nothing cached.
+
+Output fields: value/vs_baseline describe the north-star config;
+`ssim_vs_oracle` + `value_match` are its parity evidence; `configs` carries
+both configs' full numbers.
+
+On parity statistics: `value_match` (fraction of output pixels bit-equal to
+the oracle's) is the honest parity metric at scale.  `source_map_mismatch`
+overcounts: posterized flat regions contain thousands of IDENTICAL A'
+patches, the oracle's cKDTree breaks those exact ties in traversal order
+(not lowest-index), and ~99% of "mismatched" picks copy an identical A'
+value anyway (measured at 1024^2: 37.8% pick mismatch but 99.65% bit-equal
+output, MAE 9e-4, SSIM 0.989).
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
 
-def make_inputs(h: int, seed: int = 0):
+
+def make_structured(h: int, seed: int = 7):
+    """Perlin-ish A, oil-filtered A', perlin-ish B (same generator as
+    examples/make_assets.py and the cached oracle run)."""
+    from examples.make_assets import _oil_filter, _perlin_ish
+
     rng = np.random.default_rng(seed)
-    yy, xx = np.meshgrid(np.linspace(0, 1, h), np.linspace(0, 1, h),
-                         indexing="ij")
-    base = 0.5 * yy + 0.5 * xx
-    a = (base + 0.08 * rng.standard_normal((h, h))).clip(0, 1).astype(
-        np.float32)
-    ap = (np.round(a * 6) / 6).astype(np.float32)
-    b = (0.35 * yy ** 2 + 0.65 * xx
-         + 0.08 * rng.standard_normal((h, h))).clip(0, 1).astype(np.float32)
+    a = _perlin_ish(h, h, rng)
+    ap = _oil_filter(a)
+    b = _perlin_ish(h, h, rng)
     return a, ap, b
+
+
+def _run_tpu(a, ap, b, params):
+    from image_analogies_tpu.models.analogy import create_image_analogy
+
+    create_image_analogy(a, ap, b, params)  # compile warm-up
+    t0 = time.perf_counter()
+    res = create_image_analogy(a, ap, b, params)
+    return res, time.perf_counter() - t0
 
 
 def main() -> int:
@@ -38,35 +73,73 @@ def main() -> int:
 
     from image_analogies_tpu.config import AnalogyParams
     from image_analogies_tpu.models.analogy import create_image_analogy
-
-    size = 256
-    levels = 3
-    kappa = 5.0
-    a, ap, b = make_inputs(size)
-
-    p_tpu = AnalogyParams(levels=levels, kappa=kappa, backend="tpu",
-                          strategy="batched")
-    # warm-up: compile every level's scan once
-    create_image_analogy(a, ap, b, p_tpu)
-    t0 = time.perf_counter()
-    res_tpu = create_image_analogy(a, ap, b, p_tpu)
-    tpu_s = time.perf_counter() - t0
-
-    p_cpu = AnalogyParams(levels=levels, kappa=kappa, backend="cpu")
-    t0 = time.perf_counter()
-    create_image_analogy(a, ap, b, p_cpu)
-    cpu_s = time.perf_counter() - t0
+    from image_analogies_tpu.utils.ssim import ssim
 
     dev = jax.devices()[0].device_kind
+    configs = {}
+
+    # ---- config 2 (oil filter, 256^2, 3 levels): LIVE oracle ----
+    a, ap, b = make_structured(256)
+    p = AnalogyParams(levels=3, kappa=5.0, backend="tpu",
+                      strategy="wavefront")
+    res_tpu, tpu_s = _run_tpu(a, ap, b, p)
+    t0 = time.perf_counter()
+    res_cpu = create_image_analogy(a, ap, b, p.replace(backend="cpu"))
+    cpu_s = time.perf_counter() - t0
+    diff = np.abs(res_tpu.bp_y - res_cpu.bp_y)
+    configs["oil_256"] = {
+        "tpu_s": round(tpu_s, 3),
+        "cpu_oracle_s": round(cpu_s, 1),
+        "speedup": round(cpu_s / tpu_s, 1),
+        "ssim_vs_oracle": round(ssim(res_tpu.bp_y, res_cpu.bp_y), 4),
+        "value_match": round(float((diff < 1e-6).mean()), 4),
+        "output_mae": round(float(diff.mean()), 6),
+        "source_map_mismatch": round(float(
+            (res_tpu.source_map != res_cpu.source_map).mean()), 6),
+        "oracle": "live",
+    }
+
+    # ---- north star (1024^2, 5 levels): cached oracle ----
+    cache = os.path.join(_HERE, "bench_cache")
+    with open(os.path.join(cache, "oracle_1024.json")) as f:
+        ocfg = json.load(f)
+    oz = np.load(os.path.join(
+        cache, f"oracle_1024_seed{ocfg['config']['seed']}.npz"))
+    a, ap, b = make_structured(ocfg["config"]["size"],
+                               ocfg["config"]["seed"])
+    p = AnalogyParams(levels=ocfg["config"]["levels"],
+                      kappa=ocfg["config"]["kappa"], backend="tpu",
+                      strategy="wavefront")
+    res_ns, ns_s = _run_tpu(a, ap, b, p)
+    oracle_s = float(ocfg["wall_s"])
+    ns_ssim = ssim(res_ns.bp_y, oz["bp_y"])
+    ns_diff = np.abs(res_ns.bp_y - oz["bp_y"])
+    ns_match = float((ns_diff < 1e-6).mean())
+    configs["north_star_1024"] = {
+        "tpu_s": round(ns_s, 3),
+        "cpu_oracle_s": oracle_s,
+        "speedup": round(oracle_s / ns_s, 1),
+        "ssim_vs_oracle": round(ns_ssim, 4),
+        "value_match": round(ns_match, 4),
+        "output_mae": round(float(ns_diff.mean()), 6),
+        "source_map_mismatch": round(float(
+            (res_ns.source_map != oz["source_map"]).mean()), 6),
+        "oracle": "cached (experiments/oracle_1024.py)",
+    }
+
     print(json.dumps({
-        "metric": f"{size}x{size} B' synthesis wall-clock, {levels}-level "
-                  f"pyramid, kappa={kappa} (oil-filter config) on {dev}",
-        "value": round(tpu_s, 3),
+        "metric": "1024x1024 B' synthesis wall-clock, 5-level pyramid, "
+                  "kappa=5 (north-star config), wavefront oracle-parity "
+                  f"strategy on {dev}",
+        "value": round(ns_s, 3),
         "unit": "s",
-        "vs_baseline": round(cpu_s / tpu_s, 2),
+        "vs_baseline": round(oracle_s / ns_s, 1),
+        "ssim_vs_oracle": round(ns_ssim, 4),
+        "value_match": round(ns_match, 4),
+        "configs": configs,
     }))
-    print(f"# cpu_oracle={cpu_s:.2f}s tpu={tpu_s:.2f}s "
-          f"levels={[s['ms'] for s in res_tpu.stats]}", file=sys.stderr)
+    print(f"# parity strategy=wavefront; configs={json.dumps(configs)}",
+          file=sys.stderr)
     return 0
 
 

@@ -29,18 +29,11 @@ except RuntimeError:
 
 import numpy as np
 
-from examples.make_assets import _oil_filter, _perlin_ish
+from examples.make_assets import _oil_filter
+from bench import make_structured  # canonical generator (bench_cache inputs)
 from image_analogies_tpu.config import AnalogyParams
 from image_analogies_tpu.models.analogy import create_image_analogy
 from image_analogies_tpu.utils.ssim import ssim
-
-
-def make_structured(h: int, seed: int = 7):
-    rng = np.random.default_rng(seed)
-    a = _perlin_ish(h, h, rng)
-    ap = _oil_filter(a)
-    b = _perlin_ish(h, h, rng)
-    return a, ap, b
 
 
 def main() -> int:

@@ -88,6 +88,11 @@ def create_image_analogy(
     ``params.temporal_weight > 0`` its windows join the feature vector and
     are matched against A' windows on the DB side (BASELINE.json:12).
     """
+    if params.data_shards > 1:
+        raise ValueError(
+            "data_shards shards VIDEO frames over the mesh; use "
+            "models.video.video_analogy (single images shard the patch DB "
+            "via db_shards instead)")
     backend = backend or get_backend(params)
     a_src, b_src, a_filt, ap_rgb, b_yiq = _prep_planes(a, ap, b, params)
 

@@ -107,9 +107,28 @@ def _sharded_phase(a, ap, frames, params: AnalogyParams, mesh,
     idx = list(range(t_real)) + [t_real - 1] * (t_pad - t_real)
 
     a_src, _, a_filt, ap_rgb, _ = _prep_planes(a, ap, remap_anchor, params)
-    preps = [_prep_planes(a, ap, frames[i], params) for i in idx]
-    b_srcs = [p[1] for p in preps]
-    b_yiqs = [p[4] for p in preps]
+    a_nc = 1 if a_src.ndim == 2 else a_src.shape[-1]
+
+    def b_planes(frame):
+        """B-side of _prep_planes only — the A-side (luminance + anchor
+        remap) is shared by the whole batch, no need to recompute per
+        frame."""
+        b = color.as_float(np.asarray(frame))
+        b_yiq = (color.rgb2yiq(b)
+                 if b.ndim == 3 and b.shape[-1] == 3 else None)
+        if params.color_mode == "yiq_transfer":
+            b_src = b_yiq[..., 0] if b_yiq is not None else color.luminance(b)
+        else:
+            b_src = b
+            b_nc = 1 if b_src.ndim == 2 else b_src.shape[-1]
+            if a_nc != b_nc:
+                raise ValueError(f"A ({a_nc}ch) and B ({b_nc}ch) must have "
+                                 "matching channels")
+        return b_src, b_yiq
+
+    preps = [b_planes(f) for f in frames]  # once per REAL frame
+    b_srcs = [preps[i][0] for i in idx]
+    b_yiqs = [preps[i][1] for i in idx]
 
     min_shape = (min(a_src.shape[0], min(b.shape[0] for b in b_srcs)),
                  min(a_src.shape[1], min(b.shape[1] for b in b_srcs)))
@@ -238,6 +257,10 @@ def video_analogy(
         if backend is not None:
             raise ValueError("data_shards > 1 uses the mesh TPU path; a "
                              "custom backend cannot be injected")
+        if params.backend != "tpu":
+            raise ValueError(
+                f"data_shards > 1 requires backend='tpu' (the mesh path); "
+                f"got backend={params.backend!r}")
         if params.strategy in ("exact", "rowwise"):
             raise ValueError(
                 f"strategy {params.strategy!r} has no mesh scan core; frame "
