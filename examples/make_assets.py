@@ -50,6 +50,20 @@ def _perlin_ish(h, w, rng, octaves=4):
     return (out / max(out.max(), 1e-9)).astype(np.float32)
 
 
+def make_structured(h, seed: int = 7):
+    """Canonical structured A/A'/B triple (perlin A, oil-filtered A', perlin
+    B) used by bench.py, the cached 1024^2 oracle, and the experiments — ONE
+    generator so cached oracle outputs can never silently diverge from the
+    inputs being scored (bench.py also hashes the inputs)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    a = _perlin_ish(h, h, rng)
+    ap = _oil_filter(a)
+    b = _perlin_ish(h, h, rng)
+    return a, ap, b
+
+
 def _oil_filter(img):
     """The 'A -> A'' training filter: smoothing + posterization (an
     oil-paint look, same family as the reference's example filters)."""

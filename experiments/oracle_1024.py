@@ -20,7 +20,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
-from experiments.parity_probe import make_structured
+from examples.make_assets import make_structured
 from image_analogies_tpu.config import AnalogyParams
 
 
@@ -40,10 +40,13 @@ def main() -> int:
     np.savez_compressed(os.path.join(out, f"oracle_1024_seed{seed}.npz"),
                         bp_y=res.bp_y.astype(np.float32),
                         source_map=res.source_map.astype(np.int32))
+    from bench import input_digest
+
     with open(os.path.join(out, "oracle_1024.json"), "w") as f:
         json.dump({
             "config": {"size": size, "levels": levels, "kappa": kappa,
-                       "seed": seed, "inputs": "parity_probe.make_structured"},
+                       "seed": seed, "inputs": "make_assets.make_structured"},
+            "input_digest": input_digest(a, ap, b),
             "wall_s": round(wall_s, 1),
             "levels_ms": [round(s["ms"], 1) for s in res.stats],
             "host": "this box (judge's CPU)",

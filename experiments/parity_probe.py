@@ -29,8 +29,7 @@ except RuntimeError:
 
 import numpy as np
 
-from examples.make_assets import _oil_filter
-from bench import make_structured  # canonical generator (bench_cache inputs)
+from examples.make_assets import _oil_filter, make_structured
 from image_analogies_tpu.config import AnalogyParams
 from image_analogies_tpu.models.analogy import create_image_analogy
 from image_analogies_tpu.utils.ssim import ssim

@@ -150,6 +150,8 @@ PRESETS = {
         levels=3, patch_size=5, kappa=2.0, remap_luminance=False,
         src_weight=0.0, color_mode="source_rgb",
     ),
+    # video is the multi-chip flagship (frames shard over the mesh):
+    # backend defaults to tpu so --data-shards works without extra flags
     "video": AnalogyParams(levels=3, patch_size=5, kappa=5.0,
-                           temporal_weight=1.0),
+                           temporal_weight=1.0, backend="tpu"),
 }

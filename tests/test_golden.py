@@ -1,0 +1,81 @@
+"""Golden SSIM regression over the five BASELINE.json eval configs
+(round-1 VERDICT item 5).
+
+Each config runs end-to-end on the TPU backend (wavefront parity strategy)
+from the committed miniature assets and must (a) reproduce its committed
+golden PNG within SSIM tolerance — an output regression fails loudly and the
+gallery diff shows what changed — and (b) track the CPU oracle's output,
+locking cross-backend quality at every config, not just the oil filter.
+
+Regenerate the gallery after an INTENTIONAL output change with:
+    JAX_PLATFORMS=cpu python examples/make_golden.py
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from image_analogies_tpu.utils.imageio import load_image
+from image_analogies_tpu.utils.ssim import ssim
+
+GOLDEN_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples", "golden")
+
+# (config name, golden output keys, SSIM floor vs committed golden,
+#  SSIM floor vs the CPU oracle).  Golden floors allow 8-bit PNG
+# quantization; oracle floors allow residual exact-tie divergence.
+CONFIGS = [
+    ("tbn", ["out"], 0.98, 0.90),
+    ("oil", ["out"], 0.98, 0.98),
+    ("superres", ["out"], 0.98, 0.98),
+    ("npr", ["out"], 0.98, 0.98),
+    ("video", ["f0", "f1", "f2"], 0.98, 0.95),
+]
+
+
+@pytest.fixture(scope="module")
+def assets():
+    from examples.make_golden import make_assets_small
+
+    return make_assets_small()
+
+
+@pytest.fixture(scope="module")
+def configs(assets):
+    from examples.make_golden import golden_configs
+
+    return dict(golden_configs(assets))
+
+
+@pytest.mark.golden
+@pytest.mark.parametrize("name,keys,g_floor,o_floor", CONFIGS)
+def test_golden_config(name, keys, g_floor, o_floor, configs):
+    tpu = configs[name]("tpu")
+    cpu = configs[name]("cpu")
+    for key in keys:
+        golden = load_image(
+            os.path.join(GOLDEN_DIR, f"golden_{name}_{key}.png"))
+        got = np.clip(np.asarray(tpu[key], np.float32), 0, 1)
+        s_golden = ssim(got, golden)
+        assert s_golden >= g_floor, (
+            f"{name}/{key}: SSIM vs committed golden {s_golden:.4f} < "
+            f"{g_floor} — output changed; if intentional, regenerate with "
+            f"examples/make_golden.py")
+        s_oracle = ssim(np.asarray(tpu[key], np.float32),
+                        np.asarray(cpu[key], np.float32))
+        assert s_oracle >= o_floor, (
+            f"{name}/{key}: SSIM vs CPU oracle {s_oracle:.4f} < {o_floor}")
+
+
+@pytest.mark.golden
+def test_golden_inputs_committed(assets):
+    # the gallery must contain every input the configs consume, pinned
+    for name in assets:
+        path = os.path.join(GOLDEN_DIR, f"in_{name}.png")
+        assert os.path.exists(path), f"missing committed input {path}"
+        committed = load_image(path)
+        fresh = np.clip(np.asarray(assets[name], np.float32), 0, 1)
+        assert committed.shape == fresh.shape
+        np.testing.assert_allclose(committed, fresh, atol=1.5 / 255,
+                                   err_msg=f"asset generator drifted: {name}")
