@@ -105,8 +105,9 @@ class TpuLevelDB:
     a_filt_flat: jax.Array  # (Na,)
     fine_sqrtw: jax.Array  # (nf,)
     off: jax.Array  # (nf, 2) int32 window offsets
-    db_sharded: Optional[jax.Array]  # (Npad, F) laid out over mesh 'db' axis
+    db_sharded: Optional[jax.Array]  # (Npad, Fp) laid out over mesh 'db' axis
     dbn_sharded: Optional[jax.Array]
+    afilt_sharded: Optional[jax.Array]  # (Npad,) A' values, sharded alongside
     diag: Optional[jax.Array]  # (T, Mmax) anti-diagonal schedule (wavefront)
     # Pre-padded rowsafe DB for the hot loop (tile-aligned rows, 128-aligned
     # features, +inf norms on padding) — pads ONCE per level instead of every
@@ -120,10 +121,9 @@ class TpuLevelDB:
     fine_start: int = field(metadata=dict(static=True))
     n_rowsafe: int = field(metadata=dict(static=True))
     strategy: str = field(metadata=dict(static=True))
-    # shard_map'd argmin fn (cached per mesh, so its identity is stable
-    # across levels and does not defeat the jit cache)
-    sharded_argmin: Optional[Callable] = field(
-        default=None, metadata=dict(static=True))
+    # mesh for the sharded whole-level step (db_shards > 1); hashable, so a
+    # valid static field — synthesize_level dispatches to parallel/step.py
+    mesh: Any = field(default=None, metadata=dict(static=True))
 
 
 jax.tree_util.register_dataclass(
@@ -133,14 +133,6 @@ jax.tree_util.register_dataclass(
     meta_fields=[f.name for f in fields(TpuLevelDB)
                  if f.metadata.get("static")],
 )
-
-
-@functools.lru_cache(maxsize=None)
-def _cached_sharded_argmin(mesh, force_xla: bool, precision):
-    from image_analogies_tpu.parallel.sharded_match import make_sharded_argmin
-
-    return make_sharded_argmin(mesh, force_xla=force_xla,
-                               precision=precision)
 
 
 @functools.lru_cache(maxsize=64)
@@ -236,6 +228,30 @@ def _prepare_level_arrays(
     return out
 
 
+def slim_for_mesh(db: TpuLevelDB, keep_sharded: bool = False) -> TpuLevelDB:
+    """Replace the per-chip copies of DB-sized arrays with 1-row
+    placeholders — the ONE definition of which fields the sharded-memory
+    story slims.  The mesh step (parallel/step.py) reads DB rows and A'
+    values ONLY through the sharded inputs and psum lookups, so shipping the
+    full arrays replicated would defeat the story.  Query-side (Nb-sized)
+    arrays stay: they shard over 'data' (video) or are genuinely per-chip
+    state (single image).
+
+    ``keep_sharded=True`` retains the sharded arrays + mesh (build_features
+    uses this for the steady-state LevelDB); the default also drops them —
+    the shard_map template must not re-ship what the step receives as
+    sharded inputs."""
+    import dataclasses
+
+    z2 = jnp.zeros((1, db.static_q.shape[1]), _F32)
+    z1 = jnp.zeros((1,), _F32)
+    kw = {} if keep_sharded else dict(db_sharded=None, dbn_sharded=None,
+                                      afilt_sharded=None, mesh=None)
+    return dataclasses.replace(
+        db, db=z2, db_sqnorm=z1, db_rowsafe=z2, db_rowsafe_sqnorm=z1,
+        a_filt_flat=z1, db_pad=None, dbn_pad=None, **kw)
+
+
 # --------------------------------------------------------------- exact scan
 
 
@@ -270,12 +286,14 @@ def _resolve_pixel(db: TpuLevelDB, q, bp, s, p_app, d_app_fn, kappa_mult):
 
 
 def _batched_coherence(db: TpuLevelDB, s, queries, idx_c, ok, n_cand: int,
-                       score_db):
+                       row_fn):
     """Batched Ashikhmin candidates for M pixels at once (Hertzmann §3.2):
     for each query m the candidates are {s(r) + (q - r)} over its first
     ``n_cand`` causal window positions r (idx_c (M, n_cand) flat positions,
-    ``ok`` their base validity), scored in fp32 against ``score_db`` (the
-    rowsafe-masked DB for the batched strategy, the full DB for wavefront).
+    ``ok`` their base validity), scored in fp32 against ``row_fn(cand)`` —
+    a gather of the scoring DB's rows (the rowsafe-masked DB for the batched
+    strategy, the full DB for wavefront; a psum-gather of the SHARDED DB on
+    the mesh — see parallel/step.py).
 
     Returns (p_coh (M,), d_coh (M,), has_coh (M,))."""
     s_r = s[idx_c]  # (M, n_cand)
@@ -284,7 +302,8 @@ def _batched_coherence(db: TpuLevelDB, s, queries, idx_c, ok, n_cand: int,
     ok = ok & (ci >= 0) & (ci < db.ha) & (cj >= 0) & (cj < db.wa)
     cand = (jnp.clip(ci, 0, db.ha - 1) * db.wa
             + jnp.clip(cj, 0, db.wa - 1))
-    dc = jnp.sum((score_db[cand] - queries[:, None, :]) ** 2, axis=-1)
+    cf = row_fn(cand)  # (M, n_cand, F)
+    dc = jnp.sum((cf - queries[:, None, :]) ** 2, axis=-1)
     dc = jnp.where(ok, dc, jnp.inf)
     k = jnp.argmin(dc, axis=1)
     d_coh = jnp.take_along_axis(dc, k[:, None], axis=1)[:, 0]
@@ -379,7 +398,8 @@ def _run_rowwise(db: TpuLevelDB, kappa_mult):
 # -------------------------------------------------------------- batched scan
 
 
-def _left_refine(db: TpuLevelDB, queries, p, d_pick, d_app, kappa_mult):
+def _left_refine(db: TpuLevelDB, queries, p, d_pick, d_app, kappa_mult,
+                 row_fn):
     """One vectorized left-propagation pass over a resolved row.
 
     Adds the same-row coherence candidates {s(j-d) + (0, d)} (d = 1..radius)
@@ -399,7 +419,7 @@ def _left_refine(db: TpuLevelDB, queries, p, d_pick, d_app, kappa_mult):
         sj = pj % db.wa + d
         ok = (jcol >= d) & (sj < db.wa)
         cand = si * db.wa + jnp.minimum(sj, db.wa - 1)
-        cf = db.db_rowsafe[cand]
+        cf = row_fn(cand)
         dc = jnp.sum((cf - queries) ** 2, axis=1)
         dc = jnp.where(ok, dc, jnp.inf)
         passes = dc <= d_app * kappa_mult
@@ -409,13 +429,17 @@ def _left_refine(db: TpuLevelDB, queries, p, d_pick, d_app, kappa_mult):
     return best_p.astype(jnp.int32), best_d
 
 
-def batched_scan_core(db: TpuLevelDB, kappa_mult, approx_fn):
+def batched_scan_core(db: TpuLevelDB, kappa_mult, approx_fn,
+                      row_fn=None, afilt_fn=None):
     """The batched level scan given an approximate-match function.
 
     `approx_fn(queries (W,F)) -> (idx, sqdist)` is the pluggable piece: the
     local fused Pallas kernel, or its mesh-sharded variant (local kernel +
     min/argmin all-reduce over the 'db' axis — parallel/step.py calls this
-    core from inside shard_map for the multi-chip video step).
+    core from inside shard_map for the multi-chip video step).  `row_fn` /
+    `afilt_fn` gather scoring-DB rows / A' values by global index — direct
+    gathers by default, psum-gathers of the SHARDED arrays on the mesh so no
+    chip ever holds the whole DB (parallel/step.py).
 
     Returns (bp, s, counts) with counts = [n_coherence_picks (pre-refine,
     comparable with the CPU oracle's stat), n_refined_picks (picks the
@@ -424,6 +448,10 @@ def batched_scan_core(db: TpuLevelDB, kappa_mult, approx_fn):
     nf = int(db.off.shape[0])
     nrs = db.n_rowsafe
     wb, hb = db.wb, db.hb
+    if row_fn is None:
+        row_fn = lambda i: db.db_rowsafe[i]
+    if afilt_fn is None:
+        afilt_fn = lambda i: db.a_filt_flat[i]
 
     def row_body(r, state):
         bp, s, counts = state
@@ -437,7 +465,7 @@ def batched_scan_core(db: TpuLevelDB, kappa_mult, approx_fn):
         ok = (jax.lax.dynamic_slice(db.valid, (q0, 0), (wb, nf))[:, :nrs]
               > 0)
         p_coh, d_coh, has_coh = _batched_coherence(
-            db, s, queries, idx_c, ok, nrs, db.db_rowsafe)
+            db, s, queries, idx_c, ok, nrs, row_fn)
 
         use_coh = has_coh & (d_coh <= d_app * kappa_mult)
         p = jnp.where(use_coh, p_coh, p_app).astype(jnp.int32)
@@ -446,9 +474,9 @@ def batched_scan_core(db: TpuLevelDB, kappa_mult, approx_fn):
         # restore same-row left-propagation with cheap vectorized passes
         for _ in range(_REFINE_PASSES):
             p, d_pick = _left_refine(db, queries, p, d_pick, d_app,
-                                     kappa_mult)
+                                     kappa_mult, row_fn)
 
-        bp = jax.lax.dynamic_update_slice(bp, db.a_filt_flat[p], (q0,))
+        bp = jax.lax.dynamic_update_slice(bp, afilt_fn(p), (q0,))
         s = jax.lax.dynamic_update_slice(s, p, (q0,))
         n_coh = use_coh.sum(dtype=jnp.int32)
         n_ref = (d_pick < jnp.inf).sum(dtype=jnp.int32) - n_coh
@@ -462,9 +490,11 @@ def batched_scan_core(db: TpuLevelDB, kappa_mult, approx_fn):
 
 def make_approx_fn(db: TpuLevelDB):
     """The strategy's approximate-match fn (queries (M,F)) -> (idx, sqdist):
-    mesh-sharded kernel > pre-padded Pallas kernel > plain dispatch.  Which DB
-    it scores against (rowsafe-masked or full) was decided when the sharded /
-    pre-padded arrays were built in `build_features`.
+    pre-padded Pallas kernel > plain dispatch (the mesh-sharded case never
+    reaches here — synthesize_level routes db.mesh through parallel/step.py,
+    whose shard_map supplies its own all-reduced approx_fn).  Which DB it
+    scores against (rowsafe-masked or full) was decided when the pre-padded
+    arrays were built in `build_features`.
 
     Kernel precision: the wavefront strategy needs fp32-grade scores so its
     anchor picks match the oracle's argmin (HIGHEST, 3 bf16 MXU passes); the
@@ -472,10 +502,7 @@ def make_approx_fn(db: TpuLevelDB):
     — their picks are heuristic anyway and tolerate ~1e-3 score error."""
     precision = (jax.lax.Precision.HIGHEST if db.strategy == "wavefront"
                  else jax.lax.Precision.DEFAULT)
-    if db.sharded_argmin is not None:
-        def approx_fn(queries):
-            return db.sharded_argmin(queries, db.db_sharded, db.dbn_sharded)
-    elif db.db_pad is not None:
+    if db.db_pad is not None:
         def approx_fn(queries):
             m, f = queries.shape
             mp = (m + 7) // 8 * 8
@@ -505,7 +532,8 @@ def _run_batched(db: TpuLevelDB, kappa_mult):
 # ------------------------------------------------------------ wavefront scan
 
 
-def wavefront_scan_core(db: TpuLevelDB, kappa_mult, approx_fn):
+def wavefront_scan_core(db: TpuLevelDB, kappa_mult, approx_fn,
+                        row_fn=None, afilt_fn=None):
     """The parity fast path (VERDICT.md round-1 item 1): the oracle's exact
     algorithm on an anti-diagonal schedule.
 
@@ -534,6 +562,10 @@ def wavefront_scan_core(db: TpuLevelDB, kappa_mult, approx_fn):
     """
     nb = db.hb * db.wb
     t_total = int(db.diag.shape[0])
+    if row_fn is None:
+        row_fn = lambda i: db.db[i]
+    if afilt_fn is None:
+        afilt_fn = lambda i: db.a_filt_flat[i]
 
     def step(t, state):
         bp, s, n_coh = state
@@ -545,19 +577,19 @@ def wavefront_scan_core(db: TpuLevelDB, kappa_mult, approx_fn):
         queries = jax.lax.dynamic_update_slice(
             db.static_q[pixc], dyn, (0, db.fine_start))
         p_app, _ = approx_fn(queries)
-        d_app = jnp.sum((db.db[p_app] - queries) ** 2, axis=1)
+        d_app = jnp.sum((row_fn(p_app) - queries) ** 2, axis=1)
 
         # batched Ashikhmin coherence over the full causal window, scored
         # against the FULL DB (the oracle's metric)
         nf = int(db.off.shape[0])
         p_coh, d_coh, has_coh = _batched_coherence(
-            db, s, queries, idx, db.valid[pixc] > 0, nf, db.db)
+            db, s, queries, idx, db.valid[pixc] > 0, nf, row_fn)
 
         use_coh = has_coh & (d_coh <= d_app * kappa_mult)
         p = jnp.where(use_coh, p_coh, p_app).astype(jnp.int32)
         # write only live lanes: -1 padding -> index nb, dropped by scatter
         wpix = jnp.where(lane_ok, pix, nb)
-        bp = bp.at[wpix].set(db.a_filt_flat[p], mode="drop")
+        bp = bp.at[wpix].set(afilt_fn(p), mode="drop")
         s = s.at[wpix].set(p, mode="drop")
         return bp, s, n_coh + (use_coh & lane_ok).sum(dtype=jnp.int32)
 
@@ -621,27 +653,27 @@ class TpuMatcher(Matcher):
             to_j(job.b_src_coarse), to_j(job.b_filt_coarse),
             to_j(job.b_temporal), jnp.asarray(rowsafe), pad_tile, pad_full)
 
-        sharded_argmin = db_sharded = dbn_sharded = None
+        mesh = db_sharded = dbn_sharded = afilt_sharded = None
         if sharded:
             from image_analogies_tpu.parallel.mesh import make_mesh
-            from image_analogies_tpu.parallel.sharded_match import shard_db
+            from image_analogies_tpu.parallel.sharded_match import \
+                shard_level_db
 
             mesh = make_mesh(db_shards=self.params.db_shards)
             score_db, score_dbn = ((arrs["db"], arrs["db_sqnorm"]) if pad_full
                                    else (arrs["db_rowsafe"],
                                          arrs["db_rowsafe_sqnorm"]))
-            db_sharded, dbn_sharded = shard_db(score_db, score_dbn, mesh)
-            sharded_argmin = _cached_sharded_argmin(
-                mesh, jax.default_backend() != "tpu",
-                jax.lax.Precision.HIGHEST if pad_full
-                else jax.lax.Precision.DEFAULT)
+            tile = (_tile_rows(spec.total)
+                    if jax.default_backend() == "tpu" else 1)
+            db_sharded, dbn_sharded, afilt_sharded = shard_level_db(
+                score_db, score_dbn, arrs["a_filt_flat"], mesh, tile)
 
         diag = None
         if strategy == "wavefront":
             diag = _diag_schedule(hb, wb, spec.fine_size // 2 + 1)
 
         fsl = spec.fine_filt_slice
-        return TpuLevelDB(
+        out = TpuLevelDB(
             db=arrs["db"],
             db_sqnorm=arrs["db_sqnorm"],
             db_rowsafe=arrs["db_rowsafe"],
@@ -656,6 +688,7 @@ class TpuMatcher(Matcher):
             off=jnp.asarray(off),
             db_sharded=db_sharded,
             dbn_sharded=dbn_sharded,
+            afilt_sharded=afilt_sharded,
             diag=diag,
             db_pad=arrs["db_pad"],
             dbn_pad=arrs["dbn_pad"],
@@ -666,8 +699,14 @@ class TpuMatcher(Matcher):
             fine_start=fsl.start,
             n_rowsafe=(spec.fine_size // 2) * spec.fine_size,
             strategy=strategy,
-            sharded_argmin=sharded_argmin,
+            mesh=mesh,
         )
+        if sharded:
+            # steady-state memory is sharded: the full per-chip DB copies
+            # become 1-row placeholders (ONE slimming definition); the scan
+            # reads rows only through the sharded arrays + psum lookups
+            out = slim_for_mesh(out, keep_sharded=True)
+        return out
 
     # ------------------------------------------------------------- protocol
 
@@ -691,7 +730,15 @@ class TpuMatcher(Matcher):
                          ) -> Tuple[np.ndarray, np.ndarray, Dict[str, Any]]:
         t0 = time.perf_counter()
         n_ref = None
-        if db.strategy == "batched":
+        if db.mesh is not None:
+            from image_analogies_tpu.parallel.step import multichip_level_step
+
+            bp, s, n_coh = multichip_level_step(
+                db.mesh, db.static_q[None], db.db_sharded, db.dbn_sharded,
+                db.afilt_sharded, slim_for_mesh(db), job.kappa_mult,
+                force_xla=jax.default_backend() != "tpu")
+            bp, s, n_coh = bp[0], s[0], n_coh[0]
+        elif db.strategy == "batched":
             bp, s, counts = _run_batched(db, jnp.float32(job.kappa_mult))
             n_coh, n_ref = int(counts[0]), int(counts[1])
         else:
@@ -704,7 +751,7 @@ class TpuMatcher(Matcher):
         n = hb * wb
         stats = {
             "level": job.level,
-            "db_rows": int(db.db.shape[0]),
+            "db_rows": db.ha * db.wa,
             "pixels": n,
             "coherence_ratio": float(n_coh) / max(n, 1),
             "pixels_per_s": n / max(dt, 1e-9),

@@ -31,7 +31,6 @@ tests/test_video_sharded.py).
 
 from __future__ import annotations
 
-import dataclasses
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -91,12 +90,16 @@ def _sharded_phase(a, ap, frames, params: AnalogyParams, mesh,
     import jax.numpy as jnp
 
     from image_analogies_tpu.backends.base import LevelJob
-    from image_analogies_tpu.backends.tpu import TpuMatcher
+    from image_analogies_tpu.backends.tpu import (
+        TpuMatcher,
+        _tile_rows,
+        slim_for_mesh,
+    )
     from image_analogies_tpu.ops.features import build_features_jax, \
         spec_for_level
     from image_analogies_tpu.ops.pyramid import build_pyramid_np, \
         num_feasible_levels
-    from image_analogies_tpu.parallel.sharded_match import shard_db
+    from image_analogies_tpu.parallel.sharded_match import shard_level_db
     from image_analogies_tpu.parallel.step import multichip_level_step
 
     t_real = len(frames)
@@ -176,9 +179,10 @@ def _sharded_phase(a, ap, frames, params: AnalogyParams, mesh,
 
         job0 = job_for(0)
         db0 = matcher.build_features(job0)
-        # the multichip step provides its own approx_fn; drop the
-        # single-chip prepadded arrays so they aren't shipped to the mesh
-        template = dataclasses.replace(db0, db_pad=None, dbn_pad=None)
+        # the mesh step reads DB rows/A' values ONLY through the sharded
+        # inputs and psum lookups; the template ships placeholders instead of
+        # replicated full-DB copies (the honest sharded-memory story)
+        template = slim_for_mesh(db0)
 
         to_j = lambda x: None if x is None else jnp.asarray(x, jnp.float32)
         static_qs = [db0.static_q]
@@ -190,12 +194,15 @@ def _sharded_phase(a, ap, frames, params: AnalogyParams, mesh,
         frame_static_q = jnp.stack(static_qs)
 
         score_db, score_dbn = (
-            (template.db, template.db_sqnorm) if strategy == "wavefront"
-            else (template.db_rowsafe, template.db_rowsafe_sqnorm))
-        dbp, dbnp = shard_db(score_db, score_dbn, mesh)
+            (db0.db, db0.db_sqnorm) if strategy == "wavefront"
+            else (db0.db_rowsafe, db0.db_rowsafe_sqnorm))
+        tile = _tile_rows(spec.total) if not force_xla else 1
+        dbp, dbnp, afp = shard_level_db(score_db, score_dbn,
+                                        db0.a_filt_flat, mesh, tile)
+        del db0  # free the full per-chip DB copies before the scan
 
         bp, s, n_coh = multichip_level_step(
-            mesh, frame_static_q, dbp, dbnp, template,
+            mesh, frame_static_q, dbp, dbnp, afp, template,
             job0.kappa_mult, force_xla=force_xla)
         bp = np.asarray(bp, np.float32)
         s = np.asarray(s, np.int32)
@@ -206,7 +213,7 @@ def _sharded_phase(a, ap, frames, params: AnalogyParams, mesh,
         for i in range(t_real):
             rec = {
                 "level": level, "frame": frame_offset + i, "phase": tag,
-                "db_rows": int(template.db.shape[0]), "pixels": hb * wb,
+                "db_rows": template.ha * template.wa, "pixels": hb * wb,
                 "coherence_ratio": float(n_coh[i]) / max(hb * wb, 1),
                 "backend": "tpu", "strategy": strategy,
                 "mesh": dict(mesh.shape),

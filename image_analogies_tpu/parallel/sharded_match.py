@@ -21,28 +21,18 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from image_analogies_tpu.parallel.mesh import shard_map
-from image_analogies_tpu.ops.pallas_match import argmin_l2
-
-
-def shard_db(db: jax.Array, db_sqnorm: jax.Array, mesh: Mesh,
-             axis: str = "db") -> Tuple[jax.Array, jax.Array]:
-    """Pad DB rows to a multiple of the axis size and lay them out sharded.
-
-    Padding rows get +inf sqnorm so they can never win the argmin.
-    """
-    shards = mesh.shape[axis]
-    n, f = db.shape
-    npad = (n + shards - 1) // shards * shards
-    dbp = jnp.zeros((npad, f), db.dtype).at[:n].set(db)
-    dbnp = jnp.full((npad,), jnp.inf, jnp.float32).at[:n].set(db_sqnorm)
-    spec_db = NamedSharding(mesh, P(axis, None))
-    spec_n = NamedSharding(mesh, P(axis))
-    return (jax.device_put(dbp, spec_db), jax.device_put(dbnp, spec_n))
+from image_analogies_tpu.ops.pallas_match import (
+    _round_up,
+    argmin_l2,
+    pallas_argmin_l2_prepadded,
+    xla_argmin_l2,
+)
 
 
 def local_argmin_allreduce(queries, db_shard, dbn_shard, axis: str,
                            force_xla: bool = False,
-                           precision=jax.lax.Precision.DEFAULT):
+                           precision=jax.lax.Precision.DEFAULT,
+                           prepadded: bool = False, tile_n: int = 2048):
     """Per-shard fused argmin + the min+argmin all-reduce, for use INSIDE a
     shard_map whose mesh has axis ``axis`` carrying the DB rows.
 
@@ -50,9 +40,30 @@ def local_argmin_allreduce(queries, db_shard, dbn_shard, axis: str,
     ties resolve to the lowest shard, matching the single-chip lowest-index
     tie-break (the returned index is in the PADDED global row space).  This
     is the ONE copy of the tie-break invariant both the standalone sharded
-    matcher and the multi-frame video step rely on for oracle parity."""
-    idx, d = argmin_l2(queries, db_shard, dbn_shard, force_xla=force_xla,
-                       precision=precision)
+    matcher and the multi-frame video step rely on for oracle parity.
+
+    With ``prepadded=True`` the shard came from `shard_level_db` (rows
+    tile-aligned, features 128-aligned, +inf norm padding): queries are
+    lane-padded once per call and the Pallas kernel's prepadded entry runs
+    with no per-step DB copies."""
+    if prepadded:
+        m, f = queries.shape
+        fp = db_shard.shape[1]
+        qf = jnp.zeros((m, fp), jnp.float32).at[:, :f].set(queries)
+        if force_xla or jax.default_backend() != "tpu":
+            idx, d = xla_argmin_l2(qf, db_shard, dbn_shard)
+        else:
+            mp = _round_up(max(m, 8), 8)
+            qp = jnp.zeros((mp, fp), jnp.float32).at[:m].set(qf)
+            idx, score = pallas_argmin_l2_prepadded(
+                qp, db_shard, dbn_shard[None, :],
+                tile_n=min(tile_n, db_shard.shape[0]), precision=precision)
+            qn = jnp.sum(queries * queries, axis=1)
+            idx = idx[:m]
+            d = jnp.maximum(score[:m] + qn, 0.0)
+    else:
+        idx, d = argmin_l2(queries, db_shard, dbn_shard, force_xla=force_xla,
+                           precision=precision)
     gidx = idx + jax.lax.axis_index(axis) * db_shard.shape[0]
     alld = jax.lax.all_gather(d, axis)  # (D, M)
     alli = jax.lax.all_gather(gidx, axis)  # (D, M)
@@ -62,22 +73,53 @@ def local_argmin_allreduce(queries, db_shard, dbn_shard, axis: str,
     return i.astype(jnp.int32), d
 
 
+def shard_level_db(score_db: jax.Array, score_dbn: jax.Array,
+                   a_filt_flat: jax.Array, mesh: Mesh, tile: int = 1,
+                   axis: str = "db"):
+    """Tile- and lane-aligned sharded layout of a level's scoring DB.
+
+    Per-shard row count R is a multiple of ``tile`` so each shard's Pallas
+    argmin can use the prepadded kernel entry with ZERO per-step copy work
+    (round-1 ADVICE item 5: the sharded path re-padded the DB every scan
+    row); features pad to the 128-lane MXU boundary; padding rows carry +inf
+    norms and can never win.  The A' value plane shards alongside so the
+    scan's output writes also read only sharded state.
+
+    Returns (dbp (S*R, Fp), dbnp (S*R,), afiltp (S*R,)) laid out over
+    ``axis``.  Global row index == padded array index; real rows come first.
+    """
+    shards = mesh.shape[axis]
+    n, f = score_db.shape
+    fp = max(_round_up(f, 128), 128)
+    r = _round_up(-(-n // shards), max(tile, 1))
+    npad = shards * r
+    dbp = jnp.zeros((npad, fp), score_db.dtype).at[:n, :f].set(score_db)
+    dbnp = jnp.full((npad,), jnp.inf, jnp.float32).at[:n].set(score_dbn)
+    afp = jnp.zeros((npad,), jnp.float32).at[:n].set(a_filt_flat)
+    spec_db = NamedSharding(mesh, P(axis, None))
+    spec_n = NamedSharding(mesh, P(axis))
+    return (jax.device_put(dbp, spec_db), jax.device_put(dbnp, spec_n),
+            jax.device_put(afp, spec_n))
+
+
 def make_sharded_argmin(mesh: Mesh, axis: str = "db",
                         force_xla: bool = False,
                         precision=jax.lax.Precision.DEFAULT) -> Callable:
-    """Returns argmin_fn(queries (M,F), db_sharded, dbn_sharded) -> (idx, d).
+    """Returns argmin_fn(queries (M,F), db_sharded, dbn_sharded) -> (idx, d):
+    the standalone sharded k-NN entry (SURVEY.md §2.3 T2) over a
+    `shard_level_db` layout.
 
     Queries are replicated over `axis`; the DB stays sharded.  The returned
-    global index refers to the PADDED row space (callers built it via
-    `shard_db`, real rows come first so indices < n are unaffected).
-    ``precision`` reaches the per-shard Pallas kernel: the wavefront parity
-    path passes HIGHEST so sharded picks equal the oracle's argmin.
+    global index refers to the PADDED row space (real rows come first so
+    indices < n are unaffected).  ``precision`` reaches the per-shard Pallas
+    kernel: the wavefront parity path passes HIGHEST so sharded picks equal
+    the oracle's argmin.
     """
 
     def local(q, db_shard, dbn_shard):
         return local_argmin_allreduce(q, db_shard, dbn_shard, axis,
                                       force_xla=force_xla,
-                                      precision=precision)
+                                      precision=precision, prepadded=True)
 
     return shard_map(
         local, mesh=mesh,

@@ -8,11 +8,15 @@ B frames:
   B-frames sharded over chips) and are `jax.vmap`'d within a chip, so local
   frames batch through one traced program instead of a Python-unrolled loop;
 - the A/A' patch DB shards row-wise over the ``db`` axis; each chip computes
-  a local fused argmin and the global winner is resolved with the min+argmin
-  all-reduce (all_gather of per-shard (dist, index) pairs over 'db');
-- coherence gathers read a replicated copy of the scoring DB — the argmin
-  matmul, which dominates compute and HBM traffic, is what shards (see
-  README's "sharded-memory story" for the bound).
+  a local fused argmin (prepadded Pallas entry — the shards are tile- and
+  lane-aligned by `shard_level_db`, so no per-step copy work) and the global
+  winner is resolved with the min+argmin all-reduce (all_gather of per-shard
+  (dist, index) pairs over 'db');
+- coherence gathers and the A'-value reads ALSO run against the sharded
+  arrays: a row lookup gathers each chip's local hits and psum-combines them
+  over 'db', so NO chip ever materializes the whole DB — exemplar memory
+  truly scales with pod size (BASELINE.json:5).  The per-step psum payload
+  is M x window x F (a few MB), riding ICI.
 
 The shard_map'd step is built ONCE per (mesh, strategy, force_xla) and kept
 in a module-level jit whose identity is stable, so repeated level calls with
@@ -34,6 +38,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from image_analogies_tpu.backends.tpu import (
     TpuLevelDB,
+    _tile_rows,
     batched_scan_core,
     wavefront_scan_core,
 )
@@ -47,19 +52,44 @@ def _cached_multichip_step(mesh: Mesh, strategy: str, force_xla: bool,
     """Build the shard_map'd multi-frame level step once per
     (mesh, strategy, force_xla, precision); jit caching then keys on shapes."""
 
-    def local_step(static_q_loc, db_loc, dbn_loc, tmpl: TpuLevelDB, km):
+    def local_step(static_q_loc, db_loc, dbn_loc, af_loc, tmpl: TpuLevelDB,
+                   km):
+        rows = db_loc.shape[0]
+        f = tmpl.static_q.shape[1]
+
         def approx_fn(queries):
-            return local_argmin_allreduce(queries, db_loc, dbn_loc, "db",
-                                          force_xla=force_xla,
-                                          precision=precision)
+            # shards come from shard_level_db (lane-padded); the allreduce
+            # helper picks the prepadded Pallas entry when rows align
+            return local_argmin_allreduce(
+                queries, db_loc, dbn_loc, "db", force_xla=force_xla,
+                precision=precision, prepadded=True, tile_n=_tile_rows(f))
+
+        def _local(idx):
+            """(local offset, in-shard mask) for global row indices."""
+            loc = idx - jax.lax.axis_index("db") * rows
+            inb = (loc >= 0) & (loc < rows)
+            return jnp.clip(loc, 0, rows - 1), inb
+
+        def row_fn(idx):
+            # psum-gather: each chip contributes its local hits; no chip
+            # holds the whole DB (the honest sharded-memory story)
+            loc, inb = _local(idx)
+            vals = jnp.where(inb[..., None], db_loc[loc], 0.0)
+            return jax.lax.psum(vals, "db")[..., :f]
+
+        def afilt_fn(idx):
+            loc, inb = _local(idx)
+            return jax.lax.psum(jnp.where(inb, af_loc[loc], 0.0), "db")
 
         def one_frame(static_q):
             dbt = TpuLevelDB(
                 **{**{f: getattr(tmpl, f) for f in tmpl.__dataclass_fields__},
                    "static_q": static_q})
             if strategy == "wavefront":
-                return wavefront_scan_core(dbt, km, approx_fn)
-            bp, s, counts = batched_scan_core(dbt, km, approx_fn)
+                return wavefront_scan_core(dbt, km, approx_fn, row_fn,
+                                           afilt_fn)
+            bp, s, counts = batched_scan_core(dbt, km, approx_fn, row_fn,
+                                              afilt_fn)
             return bp, s, counts[0]
 
         # local frames batch through vmap (pallas_call and the collectives
@@ -69,7 +99,8 @@ def _cached_multichip_step(mesh: Mesh, strategy: str, force_xla: bool,
     stepped = shard_map(
         local_step,
         mesh=mesh,
-        in_specs=(P("data", None, None), P("db", None), P("db"), P(), P()),
+        in_specs=(P("data", None, None), P("db", None), P("db"), P("db"),
+                  P(), P()),
         out_specs=(P("data", None), P("data", None), P("data")),
         check_rep=False,
     )
@@ -79,8 +110,9 @@ def _cached_multichip_step(mesh: Mesh, strategy: str, force_xla: bool,
 def multichip_level_step(
     mesh: Mesh,
     frame_static_q: jax.Array,  # (T, Nb, F) per-frame query-side features
-    db_shard_src: jax.Array,  # (Npad, F) scoring DB, to shard on 'db'
+    db_shard_src: jax.Array,  # (Npad, Fp) scoring DB, sharded on 'db'
     dbn_shard_src: jax.Array,  # (Npad,) (+inf on padding rows)
+    afilt_shard_src: jax.Array,  # (Npad,) A' values, sharded alongside
     template: TpuLevelDB,  # single-frame LevelDB carrying shared arrays/meta
     kappa_mult: float,
     force_xla: bool = False,
@@ -89,8 +121,10 @@ def multichip_level_step(
     (bp (T, Nb), s (T, Nb), n_coherence (T,)).
 
     The scoring DB must match the template's strategy (rowsafe-masked for
-    batched, full for wavefront) and be padded to a multiple of the db-axis
-    size (`parallel.sharded_match.shard_db` layout)."""
+    batched, full for wavefront) and come from
+    `parallel.sharded_match.shard_level_db` (tile/lane-aligned layout).
+    Slim the template with `backends.tpu.slim_for_mesh` first — the step
+    reads DB rows and A' values only through the sharded inputs."""
     t_total = frame_static_q.shape[0]
     data_shards = mesh.shape["data"]
     db_shards = mesh.shape["db"]
@@ -99,11 +133,11 @@ def multichip_level_step(
                          f"data={data_shards}")
     if db_shard_src.shape[0] % db_shards:
         raise ValueError("DB rows must be padded to a multiple of db shards "
-                         "(use parallel.sharded_match.shard_db)")
+                         "(use parallel.sharded_match.shard_level_db)")
     precision = (jax.lax.Precision.HIGHEST
                  if template.strategy == "wavefront"
                  else jax.lax.Precision.DEFAULT)
     step = _cached_multichip_step(mesh, template.strategy, force_xla,
                                   precision)
-    return step(frame_static_q, db_shard_src, dbn_shard_src, template,
-                jnp.float32(kappa_mult))
+    return step(frame_static_q, db_shard_src, dbn_shard_src,
+                afilt_shard_src, template, jnp.float32(kappa_mult))
