@@ -62,7 +62,7 @@ from image_analogies_tpu.ops.features import (
 from image_analogies_tpu.ops.pallas_match import (
     _round_up,
     argmin_l2,
-    pallas_argmin_l2_prepadded,
+    prepadded_argmin_queries,
 )
 
 # DB rows per VMEM tile of the fused argmin kernel at 128 padded features:
@@ -121,6 +121,9 @@ class TpuLevelDB:
     fine_start: int = field(metadata=dict(static=True))
     n_rowsafe: int = field(metadata=dict(static=True))
     strategy: str = field(metadata=dict(static=True))
+    # batched strategy's left-propagation refinement passes (config knob)
+    refine_passes: int = field(default=_REFINE_PASSES,
+                               metadata=dict(static=True))
     # mesh for the sharded whole-level step (db_shards > 1); hashable, so a
     # valid static field — synthesize_level dispatches to parallel/step.py
     mesh: Any = field(default=None, metadata=dict(static=True))
@@ -472,7 +475,7 @@ def batched_scan_core(db: TpuLevelDB, kappa_mult, approx_fn,
         d_pick = jnp.where(use_coh, d_coh, jnp.inf)
 
         # restore same-row left-propagation with cheap vectorized passes
-        for _ in range(_REFINE_PASSES):
+        for _ in range(db.refine_passes):
             p, d_pick = _left_refine(db, queries, p, d_pick, d_app,
                                      kappa_mult, row_fn)
 
@@ -504,15 +507,9 @@ def make_approx_fn(db: TpuLevelDB):
                  else jax.lax.Precision.DEFAULT)
     if db.db_pad is not None:
         def approx_fn(queries):
-            m, f = queries.shape
-            mp = (m + 7) // 8 * 8
-            fp = db.db_pad.shape[1]
-            qp = jnp.zeros((mp, fp), _F32).at[:m, :f].set(queries)
-            idx, score = pallas_argmin_l2_prepadded(
-                qp, db.db_pad, db.dbn_pad, tile_n=_tile_rows(f),
-                precision=precision)
-            qn = jnp.sum(queries * queries, axis=1)
-            return idx[:m], jnp.maximum(score[:m] + qn, 0.0)
+            return prepadded_argmin_queries(
+                queries, db.db_pad, db.dbn_pad,
+                tile_n=_tile_rows(queries.shape[1]), precision=precision)
     elif db.strategy == "wavefront":
         def approx_fn(queries):
             return argmin_l2(queries, db.db, db.db_sqnorm,
@@ -699,6 +696,7 @@ class TpuMatcher(Matcher):
             fine_start=fsl.start,
             n_rowsafe=(spec.fine_size // 2) * spec.fine_size,
             strategy=strategy,
+            refine_passes=self.params.refine_passes,
             mesh=mesh,
         )
         if sharded:
@@ -714,6 +712,11 @@ class TpuMatcher(Matcher):
                    bp_flat: np.ndarray, s_flat: np.ndarray
                    ) -> Tuple[int, float, bool]:
         """Single-pixel reference path (unit-test seam, not the fast path)."""
+        if db.mesh is not None:
+            raise ValueError(
+                "best_match reads the per-chip DB arrays, which are 1-row "
+                "placeholders when db_shards > 1; use synthesize_level "
+                "(the mesh step) or build with db_shards=1")
         bp = jnp.asarray(bp_flat, _F32)
         s = jnp.asarray(s_flat, jnp.int32)
         qvec = _exact_qvec(db, q, bp)

@@ -46,6 +46,9 @@ def _add_engine_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--data-shards", type=int, default=None,
                    help="video mode: shard frames over this many mesh "
                         "devices (two_phase scheme, data x db mesh)")
+    p.add_argument("--refine-passes", type=int, default=None,
+                   help="batched strategy: left-propagation refinement "
+                        "passes per scan row")
     p.add_argument("--no-ann", action="store_true",
                    help="disable the cKDTree index (CPU backend brute force)")
     p.add_argument("--no-remap", action="store_true",
@@ -61,7 +64,7 @@ def _add_engine_flags(p: argparse.ArgumentParser) -> None:
 def _params_from_args(args, base: AnalogyParams) -> AnalogyParams:
     kw = {}
     for name in ("levels", "kappa", "backend", "strategy",
-                 "db_shards", "data_shards",
+                 "db_shards", "data_shards", "refine_passes",
                  "checkpoint_dir", "resume_from_level",
                  "log_path", "profile_dir"):
         v = getattr(args, name)
@@ -98,7 +101,7 @@ def cmd_run(args) -> int:
     ap = load_image(args.ap)
     if mode == "texture_synthesis":
         shape = tuple(int(x) for x in args.out_shape.split("x"))
-        res = modes.texture_synthesis(ap, shape, params)
+        res = modes.texture_synthesis(ap, shape, params, seed=args.seed)
     elif mode == "super_resolution":
         # A is derived by degrading A'; only A' and B are needed.
         b = load_image(args.b)
@@ -138,6 +141,35 @@ def cmd_video(args) -> int:
     return 0
 
 
+def cmd_sweep(args) -> int:
+    """Kappa sweep (BASELINE config 3: 'super-res, 7x7 patches, kappa
+    sweeps'): run one mode across a list of kappa values, write each output,
+    and report SSIM against a reference image when given."""
+    ap_img = load_image(args.ap)
+    b = load_image(args.b)
+    a = load_image(args.a) if args.a else None
+    ref = load_image(args.ref) if args.ref else None
+    base = {
+        "filter": PRESETS["oil_filter"],
+        "super_resolution": PRESETS["super_resolution"],
+    }[args.mode]
+    os.makedirs(args.out_dir, exist_ok=True)
+    for k in (float(x) for x in args.kappas.split(",")):
+        params = _params_from_args(args, base).replace(kappa=k)
+        if args.mode == "super_resolution":
+            res = modes.super_resolution(ap_img, b, params,
+                                         blur_passes=args.blur_passes)
+        else:
+            res = modes.artistic_filter(a, ap_img, b, params)
+        out = os.path.join(args.out_dir, f"kappa_{k:g}.png")
+        save_image(out, res.bp)
+        rec = {"kappa": k, "out": out}
+        if ref is not None:
+            rec["ssim_vs_ref"] = round(ssim(np.clip(res.bp, 0, 1), ref), 4)
+        print(json.dumps(rec))
+    return 0
+
+
 def cmd_eval(args) -> int:
     x = load_image(args.a)
     y = load_image(args.b)
@@ -161,6 +193,9 @@ def build_parser() -> argparse.ArgumentParser:
                      help="HxW for texture_synthesis")
     run.add_argument("--blur-passes", type=int, default=2,
                      help="degradation strength for super_resolution")
+    run.add_argument("--seed", type=int, default=None,
+                     help="texture_synthesis: noise seed for varied outputs "
+                          "(omit for the deterministic degenerate analogy)")
     _add_engine_flags(run)
     run.set_defaults(fn=cmd_run)
 
@@ -175,6 +210,21 @@ def build_parser() -> argparse.ArgumentParser:
     _add_engine_flags(vid)
     vid.set_defaults(fn=cmd_video)
 
+    sw = sub.add_parser("sweep", help="kappa sweep over one mode")
+    sw.add_argument("--mode", choices=("filter", "super_resolution"),
+                    default="super_resolution")
+    sw.add_argument("--a", help="unfiltered source (filter mode)")
+    sw.add_argument("--ap", required=True)
+    sw.add_argument("--b", required=True)
+    sw.add_argument("--kappas", default="0,0.5,1,2,5,10",
+                    help="comma-separated kappa values")
+    sw.add_argument("--out-dir", required=True)
+    sw.add_argument("--ref", default=None,
+                    help="reference image for per-kappa SSIM")
+    sw.add_argument("--blur-passes", type=int, default=2)
+    _add_engine_flags(sw)
+    sw.set_defaults(fn=cmd_sweep)
+
     ev = sub.add_parser("eval", help="SSIM between two images")
     ev.add_argument("--a", required=True)
     ev.add_argument("--b", required=True)
@@ -184,11 +234,11 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
-    if args.cmd == "run":
+    if args.cmd in ("run", "sweep"):
         required = {"filter": ("a", "b"), "texture_by_numbers": ("a", "b"),
                     "super_resolution": ("b",), "texture_synthesis": ()}
         missing = [k for k in required[args.mode]
-                   if getattr(args, k) is None]
+                   if getattr(args, k, None) is None]
         if missing:
             build_parser().error(
                 f"--{' --'.join(missing)} required for mode {args.mode}")

@@ -73,6 +73,12 @@ class AnalogyParams:
     #   "auto"    - wavefront.
     strategy: str = "auto"
 
+    # Batched strategy: vectorized left-propagation refinement passes per
+    # scan row (each pass lets coherent source-map runs extend patch_radius
+    # pixels further left-to-right).  More passes -> closer to sequential
+    # coherence, slightly slower rows.
+    refine_passes: int = 3
+
     # Use the cKDTree index for the CPU approximate match (the reference's ANN
     # toggle); False = brute force (native C++ matcher if built, else NumPy).
     use_ann: bool = True
@@ -110,6 +116,9 @@ class AnalogyParams:
         if self.strategy not in ("exact", "rowwise", "batched", "wavefront",
                                  "auto"):
             raise ValueError(f"unknown strategy {self.strategy!r}")
+        if self.refine_passes < 0:
+            raise ValueError(
+                f"refine_passes must be >= 0, got {self.refine_passes}")
         if self.db_shards < 1:
             raise ValueError(f"db_shards must be >= 1, got {self.db_shards}")
         if self.data_shards < 1:

@@ -181,6 +181,25 @@ def pallas_argmin_l2_prepadded(
     return idx[:, 0], val[:, 0]
 
 
+def prepadded_argmin_queries(queries, dbp, dbn, *, tile_n: int,
+                             precision=jax.lax.Precision.DEFAULT):
+    """The one padding/score-recovery contract for `pallas_argmin_l2_prepadded`
+    callers holding RAW (M, F) queries against an already tile/lane-aligned
+    DB: lane-pad + 8-row-align the queries, run the kernel, and recover the
+    true squared distance d = max(score + ||q||^2, 0).
+
+    ``dbn`` is the (1, Npad) norm row (+inf on padding rows).  Returns
+    (idx (M,), d (M,))."""
+    m, f = queries.shape
+    fp = dbp.shape[1]
+    mp = _round_up(max(m, 8), 8)
+    qp = jnp.zeros((mp, fp), _F32).at[:m, :f].set(queries)
+    idx, score = pallas_argmin_l2_prepadded(
+        qp, dbp, dbn, tile_n=min(tile_n, dbp.shape[0]), precision=precision)
+    qn = jnp.sum(queries * queries, axis=1)
+    return idx[:m], jnp.maximum(score[:m] + qn, 0.0)
+
+
 def xla_argmin_l2(queries: jax.Array, db: jax.Array,
                   db_sqnorm: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """XLA reference/fallback (materializes (M,N) — fine for small DBs and

@@ -24,7 +24,7 @@ from image_analogies_tpu.parallel.mesh import shard_map
 from image_analogies_tpu.ops.pallas_match import (
     _round_up,
     argmin_l2,
-    pallas_argmin_l2_prepadded,
+    prepadded_argmin_queries,
     xla_argmin_l2,
 )
 
@@ -42,25 +42,24 @@ def local_argmin_allreduce(queries, db_shard, dbn_shard, axis: str,
     is the ONE copy of the tie-break invariant both the standalone sharded
     matcher and the multi-frame video step rely on for oracle parity.
 
-    With ``prepadded=True`` the shard came from `shard_level_db` (rows
-    tile-aligned, features 128-aligned, +inf norm padding): queries are
-    lane-padded once per call and the Pallas kernel's prepadded entry runs
-    with no per-step DB copies."""
+    With ``prepadded=True`` the shard came from `shard_level_db` (features
+    128-lane-aligned, +inf norm padding): queries are lane-padded once per
+    call, and when the shard's rows are tile-aligned the Pallas kernel's
+    prepadded entry runs with no per-step copy work (unaligned rows fall
+    back to the self-padding kernel entry — correct, just one extra copy)."""
     if prepadded:
         m, f = queries.shape
-        fp = db_shard.shape[1]
-        qf = jnp.zeros((m, fp), jnp.float32).at[:, :f].set(queries)
+        rows, fp = db_shard.shape
         if force_xla or jax.default_backend() != "tpu":
+            qf = jnp.zeros((m, fp), jnp.float32).at[:, :f].set(queries)
             idx, d = xla_argmin_l2(qf, db_shard, dbn_shard)
-        else:
-            mp = _round_up(max(m, 8), 8)
-            qp = jnp.zeros((mp, fp), jnp.float32).at[:m].set(qf)
-            idx, score = pallas_argmin_l2_prepadded(
-                qp, db_shard, dbn_shard[None, :],
-                tile_n=min(tile_n, db_shard.shape[0]), precision=precision)
-            qn = jnp.sum(queries * queries, axis=1)
-            idx = idx[:m]
-            d = jnp.maximum(score[:m] + qn, 0.0)
+        elif rows % min(tile_n, rows) == 0:
+            idx, d = prepadded_argmin_queries(
+                queries, db_shard, dbn_shard[None, :], tile_n=tile_n,
+                precision=precision)
+        else:  # rows not tile-aligned: per-call row padding, same math
+            qf = jnp.zeros((m, fp), jnp.float32).at[:, :f].set(queries)
+            idx, d = argmin_l2(qf, db_shard, dbn_shard, precision=precision)
     else:
         idx, d = argmin_l2(queries, db_shard, dbn_shard, force_xla=force_xla,
                            precision=precision)
@@ -91,7 +90,11 @@ def shard_level_db(score_db: jax.Array, score_dbn: jax.Array,
     shards = mesh.shape[axis]
     n, f = score_db.shape
     fp = max(_round_up(f, 128), 128)
-    r = _round_up(-(-n // shards), max(tile, 1))
+    # cap the tile at the (128-aligned) per-shard need: tiny coarse-pyramid
+    # levels must not balloon to a full 8192-row tile of padding per shard
+    per_shard = -(-n // shards)
+    tile = min(max(tile, 1), max(_round_up(per_shard, 128), 128))
+    r = _round_up(per_shard, tile)
     npad = shards * r
     dbp = jnp.zeros((npad, fp), score_db.dtype).at[:n, :f].set(score_db)
     dbnp = jnp.full((npad,), jnp.inf, jnp.float32).at[:n].set(score_dbn)

@@ -72,10 +72,50 @@ def test_engine_flags_map_to_params(assets):
     parser = build_parser()
     args = parser.parse_args(
         ["run", "--ap", "x", "--out", "y", "--no-ann", "--no-remap",
-         "--kappa", "7", "--db-shards", "4", "--strategy", "batched"])
+         "--kappa", "7", "--db-shards", "4", "--strategy", "batched",
+         "--refine-passes", "5"])
     from image_analogies_tpu.cli import _params_from_args
     from image_analogies_tpu.config import PRESETS
 
     p = _params_from_args(args, PRESETS["oil_filter"])
     assert p.kappa == 7 and not p.use_ann and not p.remap_luminance
     assert p.db_shards == 4 and p.strategy == "batched"
+    assert p.refine_passes == 5
+
+
+def test_sweep_cli(assets, capsys):
+    paths, tmp = assets
+    outdir = str(tmp / "sweep")
+    rc = main(["sweep", "--mode", "filter", "--a", paths["a"], "--ap",
+               paths["ap"], "--b", paths["b"], "--kappas", "0,5",
+               "--out-dir", outdir, "--ref", paths["b"],
+               "--levels", "1", "--backend", "cpu"])
+    assert rc == 0
+    recs = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert [r["kappa"] for r in recs] == [0.0, 5.0]
+    for r in recs:
+        assert os.path.exists(r["out"]) and 0.0 <= r["ssim_vs_ref"] <= 1.0
+
+
+def test_seeded_texture_cli(assets):
+    paths, tmp = assets
+    o1, o2 = str(tmp / "t1.png"), str(tmp / "t2.png")
+    for out, seed in ((o1, "3"), (o2, "4")):
+        rc = main(["run", "--mode", "texture_synthesis", "--ap", paths["ap"],
+                   "--out", out, "--out-shape", "12x12", "--levels", "1",
+                   "--backend", "cpu", "--seed", seed])
+        assert rc == 0
+    assert (load_image(o1) != load_image(o2)).any()
+
+
+def test_refine_passes_reaches_batched_scan(assets):
+    # refine_passes is a static TpuLevelDB field: 0 passes must still run
+    a, ap, b = make_pair(14, 14, seed=2)
+    from image_analogies_tpu.config import AnalogyParams
+    from image_analogies_tpu.models.analogy import create_image_analogy
+
+    r0 = create_image_analogy(a, ap, b, AnalogyParams(
+        levels=1, backend="tpu", strategy="batched", refine_passes=0))
+    r3 = create_image_analogy(a, ap, b, AnalogyParams(
+        levels=1, backend="tpu", strategy="batched", refine_passes=3))
+    assert r0.bp_y.shape == r3.bp_y.shape == (14, 14)
