@@ -55,6 +55,9 @@ def _add_engine_flags(p: argparse.ArgumentParser) -> None:
                    help="disable luminance remapping")
     p.add_argument("--no-gaussian", action="store_true",
                    help="unweighted (flat) neighborhood distances")
+    p.add_argument("--level-retries", type=int, default=None,
+                   help="retry a level on transient device faults this many "
+                        "times (level-granular recovery, SURVEY.md 5.3)")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--resume-from-level", type=int, default=None)
     p.add_argument("--log-path", default=None)
@@ -65,7 +68,7 @@ def _params_from_args(args, base: AnalogyParams) -> AnalogyParams:
     kw = {}
     for name in ("levels", "kappa", "backend", "strategy",
                  "db_shards", "data_shards", "refine_passes",
-                 "checkpoint_dir", "resume_from_level",
+                 "level_retries", "checkpoint_dir", "resume_from_level",
                  "log_path", "profile_dir"):
         v = getattr(args, name)
         if v is not None:

@@ -95,6 +95,10 @@ class AnalogyParams:
     temporal_weight: float = 0.0
 
     # Aux subsystems (SURVEY.md §5)
+    # §5.3 failure recovery: retry a level this many times on transient
+    # device/runtime faults (level granularity — combine with checkpoint_dir
+    # so a process restart after exhausted retries loses at most one level).
+    level_retries: int = 0
     checkpoint_dir: Optional[str] = None  # per-level checkpoints if set
     resume_from_level: Optional[int] = None  # level index (finest=0) to resume at
     profile_dir: Optional[str] = None  # jax.profiler trace dir if set
@@ -116,6 +120,9 @@ class AnalogyParams:
         if self.strategy not in ("exact", "rowwise", "batched", "wavefront",
                                  "auto"):
             raise ValueError(f"unknown strategy {self.strategy!r}")
+        if self.level_retries < 0:
+            raise ValueError(
+                f"level_retries must be >= 0, got {self.level_retries}")
         if self.refine_passes < 0:
             raise ValueError(
                 f"refine_passes must be >= 0, got {self.refine_passes}")

@@ -22,6 +22,7 @@ from image_analogies_tpu.ops import color
 from image_analogies_tpu.ops.features import spec_for_level
 from image_analogies_tpu.ops.pyramid import build_pyramid_np, num_feasible_levels
 from image_analogies_tpu.utils import checkpoint as ckpt
+from image_analogies_tpu.utils import failure
 from image_analogies_tpu.utils import logging as ialog
 
 
@@ -153,8 +154,15 @@ def create_image_analogy(
                 b_temporal=(b_temporal_pyr[level] if temporal else None),
             )
             t0 = time.perf_counter()
-            db = backend.build_features(job)
-            bp, s, st = backend.synthesize_level(db, job)
+
+            def _level():
+                db = backend.build_features(job)
+                return backend.synthesize_level(db, job)
+
+            # §5.3: transient device faults retry at level granularity
+            bp, s, st = failure.run_with_retry(
+                _level, retries=params.level_retries,
+                context={"level": level}, log_path=params.log_path)
             st["total_ms"] = (time.perf_counter() - t0) * 1e3
             bp_pyr[level], s_pyr[level] = bp, s
             stats.append(st)

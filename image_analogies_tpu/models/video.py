@@ -43,6 +43,7 @@ from image_analogies_tpu.models.analogy import (
     create_image_analogy,
 )
 from image_analogies_tpu.ops import color
+from image_analogies_tpu.utils import failure
 from image_analogies_tpu.utils import logging as ialog
 
 
@@ -178,32 +179,42 @@ def _sharded_phase(a, ap, frames, params: AnalogyParams, mesh,
             )
 
         job0 = job_for(0)
-        db0 = matcher.build_features(job0)
-        # the mesh step reads DB rows/A' values ONLY through the sharded
-        # inputs and psum lookups; the template ships placeholders instead of
-        # replicated full-DB copies (the honest sharded-memory story)
-        template = slim_for_mesh(db0)
 
-        to_j = lambda x: None if x is None else jnp.asarray(x, jnp.float32)
-        static_qs = [db0.static_q]
-        for i in range(1, t_pad):
-            j = job_for(i)
-            static_qs.append(_static_q_jit(
-                spec, to_j(j.b_src), to_j(j.b_src_coarse),
-                to_j(j.b_filt_coarse), to_j(j.b_temporal)))
-        frame_static_q = jnp.stack(static_qs)
+        def _level():
+            """The whole level's DEVICE work — features, sharded layout, and
+            the mesh scan — so a transient-fault retry re-materializes every
+            device buffer from host-side pyramids (stale captured buffers
+            would just fail again after a real device reset)."""
+            db0 = matcher.build_features(job0)
+            # the mesh step reads DB rows/A' values ONLY through the sharded
+            # inputs and psum lookups; the template ships placeholders
+            # instead of replicated full-DB copies
+            template = slim_for_mesh(db0)
 
-        score_db, score_dbn = (
-            (db0.db, db0.db_sqnorm) if strategy == "wavefront"
-            else (db0.db_rowsafe, db0.db_rowsafe_sqnorm))
-        tile = _tile_rows(spec.total) if not force_xla else 1
-        dbp, dbnp, afp = shard_level_db(score_db, score_dbn,
-                                        db0.a_filt_flat, mesh, tile)
-        del db0  # free the full per-chip DB copies before the scan
+            to_j = lambda x: None if x is None else jnp.asarray(x,
+                                                                jnp.float32)
+            static_qs = [db0.static_q]
+            for i in range(1, t_pad):
+                j = job_for(i)
+                static_qs.append(_static_q_jit(
+                    spec, to_j(j.b_src), to_j(j.b_src_coarse),
+                    to_j(j.b_filt_coarse), to_j(j.b_temporal)))
+            frame_static_q = jnp.stack(static_qs)
 
-        bp, s, n_coh = multichip_level_step(
-            mesh, frame_static_q, dbp, dbnp, afp, template,
-            job0.kappa_mult, force_xla=force_xla)
+            score_db, score_dbn = (
+                (db0.db, db0.db_sqnorm) if strategy == "wavefront"
+                else (db0.db_rowsafe, db0.db_rowsafe_sqnorm))
+            tile = _tile_rows(spec.total) if not force_xla else 1
+            dbp, dbnp, afp = shard_level_db(score_db, score_dbn,
+                                            db0.a_filt_flat, mesh, tile)
+            return multichip_level_step(
+                mesh, frame_static_q, dbp, dbnp, afp, template,
+                job0.kappa_mult, force_xla=force_xla)
+
+        bp, s, n_coh = failure.run_with_retry(
+            _level, retries=params.level_retries,
+            context={"level": level, "phase": tag},
+            log_path=params.log_path)
         bp = np.asarray(bp, np.float32)
         s = np.asarray(s, np.int32)
         hb, wb = job0.b_shape
@@ -213,7 +224,8 @@ def _sharded_phase(a, ap, frames, params: AnalogyParams, mesh,
         for i in range(t_real):
             rec = {
                 "level": level, "frame": frame_offset + i, "phase": tag,
-                "db_rows": template.ha * template.wa, "pixels": hb * wb,
+                "db_rows": job0.a_shape[0] * job0.a_shape[1],
+                "pixels": hb * wb,
                 "coherence_ratio": float(n_coh[i]) / max(hb * wb, 1),
                 "backend": "tpu", "strategy": strategy,
                 "mesh": dict(mesh.shape),

@@ -1,0 +1,96 @@
+"""Failure detection + level-granular recovery (SURVEY.md §5.3).
+
+The reference has nothing here; the natural recovery unit in this framework
+is the pyramid LEVEL: all cross-level state is exactly {B' plane, source
+map} (Hertzmann §3), already checkpointable (utils/checkpoint.py).  The
+driver therefore wraps each level's device work in `run_with_retry`:
+
+- transient device/runtime faults (PJRT resets, preemption-style errors,
+  OOM after fragmentation) surface in JAX as `JaxRuntimeError` /
+  `XlaRuntimeError`; the wrapper detects them, emits a structured
+  `level_retry` record, clears JAX's live-array caches so retries
+  re-materialize inputs, and re-runs the level;
+- programming errors (TypeError, ValueError, shape mismatches ...) are NOT
+  retried — retrying those only hides bugs;
+- with `checkpoint_dir` set, completed coarser levels resume from disk, so
+  a process-level restart after exhausted retries loses at most one level.
+
+`inject_failures` is the fault-injection hook (SURVEY.md §5.3's test story):
+it makes the NEXT `n` wrapped calls raise a synthetic transient error, so
+recovery paths are exercised deterministically in CI without real faults.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional
+
+from image_analogies_tpu.utils import logging as ialog
+
+# Synthetic-fault state (fault injection for tests/drills).
+_INJECT = {"n": 0}
+
+
+class InjectedFailure(RuntimeError):
+    """Synthetic transient fault raised by `inject_failures`."""
+
+
+def inject_failures(n: int) -> None:
+    """Arm the fault injector: the next `n` `run_with_retry` bodies raise
+    `InjectedFailure` before running their real work."""
+    _INJECT["n"] = int(n)
+
+
+def _is_transient(exc: BaseException) -> bool:
+    """Transient == worth retrying: device/runtime faults, not bugs."""
+    if isinstance(exc, InjectedFailure):
+        return True
+    # jax.errors.JaxRuntimeError wraps XLA/PJRT runtime failures; keep the
+    # check name-based so this works across jax versions without importing
+    # private exception types.
+    for klass in type(exc).__mro__:
+        if klass.__name__ in ("JaxRuntimeError", "XlaRuntimeError"):
+            return True
+    return False
+
+
+def run_with_retry(
+    fn: Callable[[], Any],
+    *,
+    retries: int = 0,
+    context: Optional[dict] = None,
+    log_path: Optional[str] = None,
+    backoff_s: float = 0.5,
+) -> Any:
+    """Run `fn()`, retrying up to `retries` times on transient faults.
+
+    Each detected fault emits a `level_retry` JSONL record (utils/logging)
+    with the error type and attempt number.  Non-transient exceptions and
+    faults beyond the retry budget propagate unchanged.
+    """
+    attempt = 0
+    while True:
+        try:
+            if _INJECT["n"] > 0:
+                _INJECT["n"] -= 1
+                raise InjectedFailure("synthetic fault (inject_failures)")
+            return fn()
+        except BaseException as exc:  # noqa: BLE001 - filtered below
+            if not _is_transient(exc) or attempt >= retries:
+                raise
+            attempt += 1
+            ialog.emit({
+                "event": "level_retry",
+                "attempt": attempt,
+                "error": type(exc).__name__,
+                "detail": str(exc)[:200],
+                **(context or {}),
+            }, log_path)
+            try:
+                import jax
+
+                jax.clear_caches()  # drop live executables/buffers that may
+                # reference poisoned device state before re-running
+            except Exception:  # pragma: no cover - cache clear is best-effort
+                pass
+            time.sleep(backoff_s * attempt)

@@ -89,6 +89,59 @@ def test_profile_dir_writes_trace(tmp_path, rng):
     assert found, "profiler produced no trace files"
 
 
+@pytest.fixture(autouse=True)
+def _disarm_fault_injector():
+    """The injector is process-global: always reset it so a failing test
+    cannot leak armed synthetic faults into unrelated tests."""
+    yield
+    from image_analogies_tpu.utils import failure
+
+    failure.inject_failures(0)
+
+
+def test_level_retry_recovers_from_transient_fault(tmp_path, rng):
+    """SURVEY.md §5.3: a transient device fault mid-run retries at level
+    granularity and completes, logging a level_retry record; the output
+    equals an undisturbed run."""
+    from image_analogies_tpu.utils import failure
+
+    a, ap, b = make_pair(14, 14, seed=5)
+    clean = create_image_analogy(a, ap, b, AnalogyParams(levels=2,
+                                                         backend="cpu"))
+    log = str(tmp_path / "log.jsonl")
+    failure.inject_failures(1)  # first level attempt dies
+    res = create_image_analogy(a, ap, b, AnalogyParams(
+        levels=2, backend="cpu", level_retries=2, log_path=log))
+    np.testing.assert_array_equal(res.bp_y, clean.bp_y)
+    recs = [json.loads(l) for l in open(log)]
+    retries = [r for r in recs if r.get("event") == "level_retry"]
+    assert len(retries) == 1 and retries[0]["error"] == "InjectedFailure"
+
+
+def test_level_retry_exhausted_propagates(rng):
+    from image_analogies_tpu.utils import failure
+
+    a, ap, b = make_pair(12, 12, seed=5)
+    failure.inject_failures(3)  # more faults than the retry budget
+    with pytest.raises(failure.InjectedFailure):
+        create_image_analogy(a, ap, b, AnalogyParams(
+            levels=1, backend="cpu", level_retries=1))
+
+
+def test_nontransient_errors_not_retried():
+    from image_analogies_tpu.utils import failure
+
+    calls = {"n": 0}
+
+    def bad():
+        calls["n"] += 1
+        raise ValueError("a bug, not a fault")
+
+    with pytest.raises(ValueError):
+        failure.run_with_retry(bad, retries=5)
+    assert calls["n"] == 1  # no retry on programming errors
+
+
 def test_ssim_properties(rng):
     x = rng.uniform(0, 1, (32, 32))
     assert ssim(x, x) == pytest.approx(1.0, abs=1e-9)
