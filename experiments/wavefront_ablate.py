@@ -1,0 +1,120 @@
+"""Ablation probe: where does the wavefront finest-level time go on-chip?
+
+Times the REAL wavefront scan against variants with pieces stubbed out:
+  full        - THE production scan (wavefront_scan_core itself, so this
+                baseline cannot drift from backends/tpu.py)
+  no_coh      - skip the batched coherence block (kappa=0-ish path cost)
+  no_kernel   - replace the Pallas argmin with a constant index (keeps
+                gathers/scatters; isolates the kernel's share)
+  kernel_only - argmin + scatter only (no coherence, no rescore)
+
+    python experiments/wavefront_ablate.py --size 512
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from examples.make_assets import make_structured
+from image_analogies_tpu.backends.base import LevelJob
+from image_analogies_tpu.backends.tpu import (
+    TpuLevelDB,
+    TpuMatcher,
+    _batched_coherence,
+    make_approx_fn,
+    wavefront_scan_core,
+)
+from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.ops import color
+from image_analogies_tpu.ops.features import spec_for_level
+
+_F32 = jnp.float32
+
+
+@functools.partial(jax.jit, static_argnames=("variant",))
+def _run_variant(db: TpuLevelDB, kappa_mult, variant: str):
+    approx_fn = make_approx_fn(db)
+    if variant == "full":  # the REAL production scan
+        return wavefront_scan_core(db, kappa_mult, approx_fn)
+    nb = db.hb * db.wb
+    t_total = int(db.diag.shape[0])
+    nf = int(db.off.shape[0])
+
+    def step(t, state):
+        bp, s, n = state
+        pix = db.diag[t]
+        lane_ok = pix >= 0
+        pixc = jnp.maximum(pix, 0)
+        idx = db.flat_idx[pixc]
+        dyn = bp[idx] * db.written[pixc] * db.fine_sqrtw[None, :]
+        queries = jax.lax.dynamic_update_slice(
+            db.static_q[pixc], dyn, (0, db.fine_start))
+        if variant == "no_kernel":
+            p_app = jnp.zeros((pix.shape[0],), jnp.int32)
+        else:
+            p_app, _ = approx_fn(queries)
+        if variant == "no_kernel":
+            d_app = jnp.sum((db.db[p_app] - queries) ** 2, axis=1)
+            p_coh, d_coh, has_coh = _batched_coherence(
+                db, s, queries, idx, db.valid[pixc] > 0, nf,
+                lambda i: db.db[i])
+            use_coh = has_coh & (d_coh <= d_app * kappa_mult)
+            p = jnp.where(use_coh, p_coh, p_app).astype(jnp.int32)
+        elif variant == "no_coh":
+            d_app = jnp.sum((db.db[p_app] - queries) ** 2, axis=1)
+            p = p_app.astype(jnp.int32)
+            use_coh = lane_ok & (d_app < 0)
+        else:  # kernel_only
+            p = p_app.astype(jnp.int32)
+            use_coh = lane_ok & (p < 0)
+        wpix = jnp.where(lane_ok, pix, nb)
+        bp = bp.at[wpix].set(db.a_filt_flat[p], mode="drop")
+        s = s.at[wpix].set(p, mode="drop")
+        return bp, s, n + (use_coh & lane_ok).sum(dtype=jnp.int32)
+
+    bp0 = jnp.zeros((nb,), _F32)
+    s0 = jnp.zeros((nb,), jnp.int32)
+    return jax.lax.fori_loop(0, t_total, step, (bp0, s0, jnp.int32(0)))
+
+
+def main() -> int:
+    ap_ = argparse.ArgumentParser()
+    ap_.add_argument("--size", type=int, default=512)
+    ap_.add_argument("--reps", type=int, default=3)
+    args = ap_.parse_args()
+
+    a, ap, b = make_structured(args.size)
+    params = AnalogyParams(levels=1, backend="tpu", strategy="wavefront")
+    spec = spec_for_level(params, 0, 1, 1)
+    a_src, a_filt, b_src = (color.luminance(a), color.luminance(ap),
+                            color.luminance(b))
+    a_src, a_filt = color.remap_pair(a_src, a_filt, b_src)
+    job = LevelJob(level=0, spec=spec, kappa_mult=params.kappa_factor(0) ** 2,
+                   a_src=a_src, a_filt=a_filt, b_src=b_src)
+    db = TpuMatcher(params).build_features(job)
+    km = jnp.float32(job.kappa_mult)
+
+    for variant in ("full", "no_coh", "kernel_only", "no_kernel"):
+        np.asarray(_run_variant(db, km, variant)[0])  # compile + drain
+        ts = []
+        for _ in range(args.reps):
+            t0 = time.perf_counter()
+            np.asarray(_run_variant(db, km, variant)[0])  # host copy blocks
+            ts.append(time.perf_counter() - t0)
+        print(f"{variant:>12}: {min(ts):.2f}s (min of {args.reps})",
+              flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

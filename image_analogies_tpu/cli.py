@@ -62,6 +62,11 @@ def _add_engine_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--resume-from-level", type=int, default=None)
     p.add_argument("--log-path", default=None)
     p.add_argument("--profile-dir", default=None)
+    p.add_argument("--coordinator", default=None,
+                   help="multi-host: coordinator address host:port "
+                        "(jax.distributed); see parallel/distributed.py")
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
 
 
 def _params_from_args(args, base: AnalogyParams) -> AnalogyParams:
@@ -237,6 +242,15 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    if hasattr(args, "coordinator"):  # engine commands (not eval)
+        from image_analogies_tpu.parallel.distributed import \
+            initialize_distributed
+
+        # no-ops for single-process runs; also honors the
+        # JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID env
+        # configuration with no flags at all
+        initialize_distributed(args.coordinator, args.num_processes,
+                               args.process_id)
     if args.cmd in ("run", "sweep"):
         required = {"filter": ("a", "b"), "texture_by_numbers": ("a", "b"),
                     "super_resolution": ("b",), "texture_synthesis": ()}

@@ -1,0 +1,65 @@
+"""Multi-host (DCN) initialization (SURVEY.md §5.8).
+
+Single-slice multi-chip runs need nothing from this module: `make_mesh`
+over `jax.devices()` rides ICI.  For MULTI-HOST pods/slices, JAX requires
+`jax.distributed.initialize` before any device access; this module wraps it
+with environment autodetection so the same CLI works on one host or many:
+
+    # host 0
+    python -m image_analogies_tpu.cli run ... \\
+        --coordinator h0:1234 --num-processes 2 --process-id 0
+    # host 1: same command with --process-id 1
+
+After initialization, `jax.devices()` spans every host's chips and
+`make_mesh(db_shards=..., data_shards=...)` lays the ('data','db') mesh over
+the global device list — jax orders devices so the fast ICI dimension maps
+to contiguous mesh axes, and the min+argmin all-reduce / psum row lookups
+(parallel/step.py) ride ICI within a slice and DCN across slices with no
+further code changes (XLA inserts the hierarchical collectives).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize JAX's multi-host runtime when configured; no-op otherwise.
+
+    Order of precedence: explicit args > JAX_COORDINATOR_ADDRESS /
+    JAX_NUM_PROCESSES / JAX_PROCESS_ID env vars > cloud autodetection
+    (jax.distributed.initialize with no args works on TPU pods where the
+    metadata server provides topology).  Returns True if initialization ran.
+
+    Must be called BEFORE any jax device/array API touches the backend.
+    Single-process runs (the common case, and every test in this repo)
+    simply skip it.
+    """
+    coordinator_address = (coordinator_address
+                           or os.environ.get("JAX_COORDINATOR_ADDRESS"))
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+
+    if coordinator_address is None and num_processes is None:
+        if process_id is not None:
+            raise ValueError(
+                "process_id given without coordinator_address/num_processes "
+                "— a partially-configured multi-host run would silently "
+                "start standalone and hang the other hosts")
+        return False  # single-process: nothing to do
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True

@@ -112,3 +112,28 @@ def test_end_to_end_sharded_matches_unsharded(rng):
     assert sv >= 0.99, f"sharded-vs-unsharded SSIM {sv}"
     agree = (r1.source_map == r4.source_map).mean()
     assert agree >= 0.95, f"source-map agreement {agree}"
+
+
+def test_distributed_initialize_noop_and_plumbing(monkeypatch):
+    """SURVEY.md §5.8: single-process runs skip jax.distributed entirely;
+    configured runs pass coordinates through (initialize itself is mocked —
+    a real multi-host handshake needs actual hosts)."""
+    from image_analogies_tpu.parallel import distributed
+
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    assert distributed.initialize_distributed() is False  # no-op path
+
+    calls = {}
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.update(kw))
+    assert distributed.initialize_distributed("h0:1234", 2, 1) is True
+    assert calls == {"coordinator_address": "h0:1234",
+                     "num_processes": 2, "process_id": 1}
+
+    calls.clear()
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "h9:99")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "4")
+    monkeypatch.setenv("JAX_PROCESS_ID", "3")
+    assert distributed.initialize_distributed() is True
+    assert calls["num_processes"] == 4 and calls["process_id"] == 3
