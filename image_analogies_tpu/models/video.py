@@ -73,6 +73,15 @@ class VideoResult:
     frames_y: List[np.ndarray]  # synthesized luminance planes
     stats: List[Dict[str, Any]] = field(default_factory=list)
 
+    def flicker(self) -> List[float]:
+        """Temporal-stability metric: SSIM between consecutive output
+        frames (higher = less flicker — the quantity the temporal term
+        exists to raise, BASELINE.json:12).  len == n_frames - 1."""
+        from image_analogies_tpu.utils.ssim import ssim
+
+        return [float(ssim(self.frames_y[t], self.frames_y[t + 1]))
+                for t in range(len(self.frames_y) - 1)]
+
 
 def _sharded_phase(a, ap, frames, params: AnalogyParams, mesh,
                    temporal_prevs: Optional[Sequence[np.ndarray]],

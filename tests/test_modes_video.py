@@ -115,6 +115,16 @@ def test_video_temporal_term_increases_frame_coherence(small):
     assert dt <= d0 + 1e-6, (dt, d0)
 
 
+def test_video_flicker_metric(small):
+    a, ap, _ = small
+    r = np.random.default_rng(0)
+    frames = [np.clip(a + 0.01 * r.standard_normal(a.shape), 0, 1)
+              .astype(np.float32) for _ in range(3)]
+    res = video_analogy(a, ap, frames, _params(temporal_weight=1.0))
+    f = res.flicker()
+    assert len(f) == 2 and all(-1.0 <= x <= 1.0 for x in f)
+
+
 def test_temporal_spec_only_with_prev_frame():
     p = AnalogyParams(temporal_weight=1.0)
     s_on = spec_for_level(p, 0, 1, 1, temporal=True)
