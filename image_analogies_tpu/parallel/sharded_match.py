@@ -29,6 +29,26 @@ from image_analogies_tpu.ops.pallas_match import (
 )
 
 
+def _shard_score(queries, db_shard, dbn_shard, *, force_xla: bool,
+                 precision, tile_n: int = 2048):
+    """Score raw (M, F) queries against ONE `shard_level_db` shard
+    (features 128-lane-aligned, +inf norm padding) — the single dispatch
+    used by the all-reduce and ring variants: XLA off-TPU, the prepadded
+    Pallas entry when the shard's rows are tile-aligned, and the
+    self-padding kernel entry otherwise (correct, one extra copy)."""
+    m, f = queries.shape
+    rows, fp = db_shard.shape
+    if force_xla or jax.default_backend() != "tpu":
+        qf = jnp.zeros((m, fp), jnp.float32).at[:, :f].set(queries)
+        return xla_argmin_l2(qf, db_shard, dbn_shard)
+    if rows % min(tile_n, rows) == 0:
+        return prepadded_argmin_queries(
+            queries, db_shard, dbn_shard[None, :], tile_n=tile_n,
+            precision=precision)
+    qf = jnp.zeros((m, fp), jnp.float32).at[:, :f].set(queries)
+    return argmin_l2(qf, db_shard, dbn_shard, precision=precision)
+
+
 def local_argmin_allreduce(queries, db_shard, dbn_shard, axis: str,
                            force_xla: bool = False,
                            precision=jax.lax.Precision.DEFAULT,
@@ -48,18 +68,9 @@ def local_argmin_allreduce(queries, db_shard, dbn_shard, axis: str,
     prepadded entry runs with no per-step copy work (unaligned rows fall
     back to the self-padding kernel entry — correct, just one extra copy)."""
     if prepadded:
-        m, f = queries.shape
-        rows, fp = db_shard.shape
-        if force_xla or jax.default_backend() != "tpu":
-            qf = jnp.zeros((m, fp), jnp.float32).at[:, :f].set(queries)
-            idx, d = xla_argmin_l2(qf, db_shard, dbn_shard)
-        elif rows % min(tile_n, rows) == 0:
-            idx, d = prepadded_argmin_queries(
-                queries, db_shard, dbn_shard[None, :], tile_n=tile_n,
-                precision=precision)
-        else:  # rows not tile-aligned: per-call row padding, same math
-            qf = jnp.zeros((m, fp), jnp.float32).at[:, :f].set(queries)
-            idx, d = argmin_l2(qf, db_shard, dbn_shard, precision=precision)
+        idx, d = _shard_score(queries, db_shard, dbn_shard,
+                              force_xla=force_xla, precision=precision,
+                              tile_n=tile_n)
     else:
         idx, d = argmin_l2(queries, db_shard, dbn_shard, force_xla=force_xla,
                            precision=precision)
@@ -128,5 +139,68 @@ def make_sharded_argmin(mesh: Mesh, axis: str = "db",
         local, mesh=mesh,
         in_specs=(P(), P(axis, None), P(axis)),
         out_specs=(P(), P()),
+        check_rep=False,
+    )
+
+
+def make_ring_argmin(mesh: Mesh, axis: str = "db",
+                     force_xla: bool = False,
+                     precision=jax.lax.Precision.DEFAULT) -> Callable:
+    """Ring-parallel sharded k-NN: BOTH queries and DB shard over ``axis``
+    (SURVEY.md §5.7's nearest analogue of ring attention).
+
+    Each chip starts with its own query tile; over D hops the tiles rotate
+    around the ring via `lax.ppermute`, scoring the RESIDENT DB shard at
+    every hop and carrying the running (best distance, best global index)
+    with them.  After D hops every tile has visited every shard and is back
+    home.  Versus `make_sharded_argmin` (replicated queries + one
+    all_gather), the ring keeps per-chip query memory at M/D and moves only
+    tile-sized messages per hop — the right trade when the query batch
+    itself is too large to replicate (the "long-context" axis).
+
+    Ties break to the lowest GLOBAL row index — lexicographic (d, gidx)
+    carry — exactly matching the single-chip kernel and the all-reduce
+    variant (locked by tests/test_sharded.py).
+
+    Returns argmin_fn(queries (M, F), db_sharded, dbn_sharded) -> (idx, d);
+    M must divide by the axis size (pad queries if needed).
+    """
+    n_shards = mesh.shape[axis]
+
+    def local(q_tile, db_shard, dbn_shard):
+        rows = db_shard.shape[0]
+        me = jax.lax.axis_index(axis)
+        # tile starting on chip `me` was authored by chip `me`; after k hops
+        # chip `me` holds the tile of chip (me - k) — it just scores it
+        # against its resident shard, whose global row offset is me * rows.
+
+        def hop(k, carry):
+            q, best_d, best_i = carry
+            idx, d = _shard_score(q, db_shard, dbn_shard,
+                                  force_xla=force_xla, precision=precision)
+            gidx = idx + me * rows
+            better = (d < best_d) | ((d == best_d) & (gidx < best_i))
+            best_d = jnp.where(better, d, best_d)
+            best_i = jnp.where(better, gidx, best_i)
+            # rotate tiles one step around the ring (carry travels along)
+            perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+            q = jax.lax.ppermute(q, axis, perm)
+            best_d = jax.lax.ppermute(best_d, axis, perm)
+            best_i = jax.lax.ppermute(best_i, axis, perm)
+            return q, best_d, best_i
+
+
+        m = q_tile.shape[0]
+        init = (q_tile, jnp.full((m,), jnp.inf, jnp.float32),
+                jnp.full((m,), jnp.iinfo(jnp.int32).max, jnp.int32))
+        # D hops: visit every shard once; the D-th ppermute returns each
+        # tile (and its carried best) to its home chip
+        _, best_d, best_i = jax.lax.fori_loop(0, n_shards, hop, init)
+        return best_i.astype(jnp.int32), best_d
+
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None), P(axis)),
+        out_specs=(P(axis), P(axis)),
         check_rep=False,
     )

@@ -137,3 +137,29 @@ def test_distributed_initialize_noop_and_plumbing(monkeypatch):
     monkeypatch.setenv("JAX_PROCESS_ID", "3")
     assert distributed.initialize_distributed() is True
     assert calls["num_processes"] == 4 and calls["process_id"] == 3
+
+
+@pytest.mark.parametrize("shards", [2, 4, 8])
+def test_ring_argmin_matches_allreduce(shards, rng):
+    """Ring-rotating query tiles (SURVEY.md §5.7's ring-attention analogue)
+    must produce exactly the all-reduce variant's picks, including the
+    lowest-global-index tie-break."""
+    from image_analogies_tpu.parallel.sharded_match import make_ring_argmin
+
+    n, f, m = 96, 40, 16  # m divides every shard count
+    db = jnp.asarray(rng.standard_normal((n, f)), jnp.float32)
+    dbn = jnp.sum(db * db, axis=1)
+    q = jnp.asarray(rng.standard_normal((m, f)), jnp.float32)
+    # plant cross-shard duplicates of query 0 -> exact tie, lowest must win
+    db = db.at[5].set(q[0]).at[n - 3].set(q[0])
+    dbn = jnp.sum(db * db, axis=1)
+
+    mesh = make_mesh(db_shards=shards)
+    db_sh, dbn_sh, _ = shard_level_db(db, dbn, jnp.zeros((n,)), mesh)
+    ref = make_sharded_argmin(mesh, force_xla=True)
+    ring = make_ring_argmin(mesh, force_xla=True)
+    ri, rd = ref(q, db_sh, dbn_sh)
+    gi, gd = ring(q, db_sh, dbn_sh)
+    np.testing.assert_array_equal(np.asarray(gi), np.asarray(ri))
+    np.testing.assert_allclose(np.asarray(gd), np.asarray(rd), atol=1e-4)
+    assert int(gi[0]) == 5  # tie broken to the lowest global index
