@@ -108,7 +108,8 @@ class TpuLevelDB:
     db_sharded: Optional[jax.Array]  # (Npad, Fp) laid out over mesh 'db' axis
     dbn_sharded: Optional[jax.Array]
     afilt_sharded: Optional[jax.Array]  # (Npad,) A' values, sharded alongside
-    diag: Optional[jax.Array]  # (T, Mmax) anti-diagonal schedule (wavefront)
+    diag: Optional[Tuple[jax.Array, ...]]  # anti-diagonal schedule
+    # segments (wavefront): tuple of (T_s, M_s) index arrays, tight widths
     # Pre-padded rowsafe DB for the hot loop (tile-aligned rows, 128-aligned
     # features, +inf norms on padding) — pads ONCE per level instead of every
     # scan row inside the fori_loop.
@@ -139,23 +140,60 @@ jax.tree_util.register_dataclass(
 
 
 @functools.lru_cache(maxsize=64)
-def _diag_schedule(h: int, w: int, c: int) -> jax.Array:
-    """Anti-diagonal wavefront schedule, skew c: row t holds the flat indices
-    of every pixel (i, j) with j + c*i == t (-1 padding on short diagonals).
+def _diag_schedule(h: int, w: int, c: int) -> Tuple[jax.Array, ...]:
+    """Anti-diagonal wavefront schedule, skew c, as a tuple of SEGMENTS:
+    within each segment, row t holds the flat indices of every pixel (i, j)
+    with j + c*i == t (-1 padding on short diagonals).
 
     With c = patch_radius + 1 all of pixel (i, j)'s causal dependencies lie on
-    strictly earlier diagonals (see `wavefront_scan_core`), so each row of
-    this schedule is an independently-resolvable batch."""
+    strictly earlier diagonals (see `wavefront_scan_core`), so each schedule
+    row is an independently-resolvable batch.  Diagonal width ramps up from 1,
+    plateaus at ~min(h, w/c), and ramps back down; padding every row to the
+    plateau width would waste ~25% of the argmin kernel's MXU work on dead
+    lanes at 1024², so the unimodal width curve is cut into contiguous
+    segments, each padded only to ITS maximum width (8-aligned, short
+    segments merged).  Segment order preserves t order, so the scan
+    semantics are untouched — this is purely an occupancy optimization."""
     t_total = c * (h - 1) + w
     m_max = min(h, (w + c - 1) // c)
-    sched = np.full((t_total, m_max), -1, np.int32)
     ii = np.arange(h)
+    rows = []
+    counts = np.empty((t_total,), np.int64)
     for t in range(t_total):
         jj = t - c * ii
         ok = (jj >= 0) & (jj < w)
-        pix = (ii[ok] * w + jj[ok]).astype(np.int32)
-        sched[t, :pix.size] = pix
-    return jax.device_put(jnp.asarray(sched))
+        rows.append((ii[ok] * w + jj[ok]).astype(np.int32))
+        counts[t] = rows[-1].size
+
+    # cut where the 8-aligned quartile bucket of the width changes; merge
+    # segments shorter than 64 steps into their successor (avoid a pile of
+    # tiny compiled loop bodies)
+    def bucket(n):
+        q = max(1, m_max // 4)
+        return min(3, (n - 1) // q)
+
+    cuts = [0]
+    for t in range(1, t_total):
+        if bucket(counts[t]) != bucket(counts[t - 1]):
+            cuts.append(t)
+    cuts.append(t_total)
+    spans = [(a, b) for a, b in zip(cuts[:-1], cuts[1:])]
+    merged = []
+    for span in spans:
+        if merged and (span[1] - span[0] < 64
+                       or merged[-1][1] - merged[-1][0] < 64):
+            merged[-1] = (merged[-1][0], span[1])
+        else:
+            merged.append(span)
+
+    segs = []
+    for a, b in merged:
+        seg_m = int(_round_up(max(int(counts[a:b].max()), 1), 8))
+        sched = np.full((b - a, seg_m), -1, np.int32)
+        for k, t in enumerate(range(a, b)):
+            sched[k, :rows[t].size] = rows[t]
+        segs.append(jax.device_put(jnp.asarray(sched)))
+    return tuple(segs)
 
 
 @functools.lru_cache(maxsize=64)
@@ -558,41 +596,49 @@ def wavefront_scan_core(db: TpuLevelDB, kappa_mult, approx_fn,
     strategy's symmetric rowsafe-masked one.
     """
     nb = db.hb * db.wb
-    t_total = int(db.diag.shape[0])
     if row_fn is None:
         row_fn = lambda i: db.db[i]
     if afilt_fn is None:
         afilt_fn = lambda i: db.a_filt_flat[i]
 
-    def step(t, state):
-        bp, s, n_coh = state
-        pix = db.diag[t]  # (M,) flat indices, -1 on short diagonals
-        lane_ok = pix >= 0
-        pixc = jnp.maximum(pix, 0)
-        idx = db.flat_idx[pixc]  # (M, nf)
-        dyn = bp[idx] * db.written[pixc] * db.fine_sqrtw[None, :]
-        queries = jax.lax.dynamic_update_slice(
-            db.static_q[pixc], dyn, (0, db.fine_start))
-        p_app, _ = approx_fn(queries)
-        d_app = jnp.sum((row_fn(p_app) - queries) ** 2, axis=1)
+    def make_step(seg):
+        def step(t, state):
+            bp, s, n_coh = state
+            pix = seg[t]  # (M,) flat indices, -1 on short diagonals
+            lane_ok = pix >= 0
+            pixc = jnp.maximum(pix, 0)
+            idx = db.flat_idx[pixc]  # (M, nf)
+            dyn = bp[idx] * db.written[pixc] * db.fine_sqrtw[None, :]
+            queries = jax.lax.dynamic_update_slice(
+                db.static_q[pixc], dyn, (0, db.fine_start))
+            p_app, _ = approx_fn(queries)
+            d_app = jnp.sum((row_fn(p_app) - queries) ** 2, axis=1)
 
-        # batched Ashikhmin coherence over the full causal window, scored
-        # against the FULL DB (the oracle's metric)
-        nf = int(db.off.shape[0])
-        p_coh, d_coh, has_coh = _batched_coherence(
-            db, s, queries, idx, db.valid[pixc] > 0, nf, row_fn)
+            # batched Ashikhmin coherence over the full causal window,
+            # scored against the FULL DB (the oracle's metric)
+            nf = int(db.off.shape[0])
+            p_coh, d_coh, has_coh = _batched_coherence(
+                db, s, queries, idx, db.valid[pixc] > 0, nf, row_fn)
 
-        use_coh = has_coh & (d_coh <= d_app * kappa_mult)
-        p = jnp.where(use_coh, p_coh, p_app).astype(jnp.int32)
-        # write only live lanes: -1 padding -> index nb, dropped by scatter
-        wpix = jnp.where(lane_ok, pix, nb)
-        bp = bp.at[wpix].set(afilt_fn(p), mode="drop")
-        s = s.at[wpix].set(p, mode="drop")
-        return bp, s, n_coh + (use_coh & lane_ok).sum(dtype=jnp.int32)
+            use_coh = has_coh & (d_coh <= d_app * kappa_mult)
+            p = jnp.where(use_coh, p_coh, p_app).astype(jnp.int32)
+            # write only live lanes: -1 padding -> index nb, dropped
+            wpix = jnp.where(lane_ok, pix, nb)
+            bp = bp.at[wpix].set(afilt_fn(p), mode="drop")
+            s = s.at[wpix].set(p, mode="drop")
+            return bp, s, n_coh + (use_coh & lane_ok).sum(dtype=jnp.int32)
 
-    bp0 = jnp.zeros((nb,), _F32)
-    s0 = jnp.zeros((nb,), jnp.int32)
-    return jax.lax.fori_loop(0, t_total, step, (bp0, s0, jnp.int32(0)))
+        return step
+
+    # the schedule comes in width-bucketed segments (see _diag_schedule):
+    # one fori_loop per segment, chained in t order — identical semantics,
+    # each segment's batch padded only to its own max diagonal width
+    state = (jnp.zeros((nb,), _F32), jnp.zeros((nb,), jnp.int32),
+             jnp.int32(0))
+    for seg in db.diag:
+        state = jax.lax.fori_loop(0, int(seg.shape[0]), make_step(seg),
+                                  state)
+    return state
 
 
 @jax.jit

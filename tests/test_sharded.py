@@ -148,7 +148,6 @@ def test_ring_argmin_matches_allreduce(shards, rng):
 
     n, f, m = 96, 40, 16  # m divides every shard count
     db = jnp.asarray(rng.standard_normal((n, f)), jnp.float32)
-    dbn = jnp.sum(db * db, axis=1)
     q = jnp.asarray(rng.standard_normal((m, f)), jnp.float32)
     # plant cross-shard duplicates of query 0 -> exact tie, lowest must win
     db = db.at[5].set(q[0]).at[n - 3].set(q[0])
