@@ -45,7 +45,7 @@ from __future__ import annotations
 import functools
 import time
 from dataclasses import dataclass, field, fields
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -56,7 +56,6 @@ from image_analogies_tpu.backends.base import LevelJob, Matcher
 from image_analogies_tpu.ops.features import (
     build_features_jax,
     causal_mask,
-    fine_gather_maps,
     window_offsets,
 )
 from image_analogies_tpu.ops.pallas_match import (
