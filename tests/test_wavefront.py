@@ -75,3 +75,19 @@ def test_wavefront_sharded_matches_unsharded():
         a, ap, b, AnalogyParams(db_shards=4, **base))
     np.testing.assert_array_equal(solo.source_map, sharded.source_map)
     np.testing.assert_allclose(solo.bp_y, sharded.bp_y, atol=1e-6)
+
+
+def test_wavefront_a_b_different_sizes():
+    # exemplar and target need not share shapes; parity must survive the
+    # asymmetric DB/query geometry (A 28x26 vs B 20x24)
+    rng = np.random.default_rng(13)
+    a = rng.uniform(0, 1, (28, 26)).astype(np.float32)
+    ap = (np.round(a * 5) / 5).astype(np.float32)
+    b = rng.uniform(0, 1, (20, 24)).astype(np.float32)
+    base = dict(levels=2, kappa=3.0)
+    oracle = create_image_analogy(a, ap, b, AnalogyParams(backend="cpu", **base))
+    wf = create_image_analogy(
+        a, ap, b, AnalogyParams(backend="tpu", strategy="wavefront", **base))
+    assert wf.bp_y.shape == (20, 24)
+    mismatch = (wf.source_map != oracle.source_map).mean()
+    assert mismatch < 0.02, f"{mismatch:.2%}"
