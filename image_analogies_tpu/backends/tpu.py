@@ -268,6 +268,93 @@ def _prepare_level_arrays(
     return out
 
 
+@functools.lru_cache(maxsize=None)
+def _cached_sharded_db_builder(mesh, spec, pad_full: bool, npad: int,
+                               fp: int):
+    """Jit that builds a level's scoring DB DIRECTLY sharded over the mesh's
+    'db' axis (out_shardings): GSPMD partitions the window-gather feature
+    build by output rows, so each chip materializes only ITS shard — the
+    full (Na, F) DB never exists on any single device, closing the
+    transient-build memory bound that `shard_level_db`'s
+    device_put-after-build path had."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh_db = NamedSharding(mesh, P("db", None))
+    sh_row = NamedSharding(mesh, P("db"))
+
+    def build(a_src, a_filt, a_src_coarse, a_filt_coarse, a_temporal,
+              rowsafe):
+        db = build_features_jax(spec, a_src, a_filt, a_src_coarse,
+                                a_filt_coarse, temporal_fine=a_temporal)
+        if not pad_full:  # batched scores against the rowsafe-masked DB
+            db = db.at[:, spec.fine_filt_slice].multiply(rowsafe[None, :])
+        dbn = jnp.sum(db * db, axis=1)
+        n, f = db.shape
+        dbp = jnp.zeros((npad, fp), _F32).at[:n, :f].set(db)
+        dbnp = jnp.full((npad,), jnp.inf, _F32).at[:n].set(dbn)
+        afp = jnp.zeros((npad,), _F32).at[:n].set(
+            a_filt.reshape(-1).astype(_F32))
+        return dbp, dbnp, afp
+
+    return jax.jit(build, out_shardings=(sh_db, sh_row, sh_row))
+
+
+@functools.partial(jax.jit, static_argnames=("spec",))
+def _prepare_query_arrays(spec, b_src, b_src_coarse, b_filt_coarse,
+                          b_temporal):
+    """Query-side features only — the sharded build path computes the DB
+    side in `_cached_sharded_db_builder` and must not also run
+    `_prepare_level_arrays`, whose program materializes the full DB."""
+    return build_features_jax(spec, b_src, None, b_src_coarse,
+                              b_filt_coarse, temporal_fine=b_temporal)
+
+
+def build_sharded_db(spec, a_src, a_filt, a_src_coarse, a_filt_coarse,
+                     a_temporal, rowsafe, mesh, pad_full: bool, tile: int):
+    """Build the level's (dbp, dbnp, afiltp) laid out sharded over the
+    mesh's 'db' axis without any chip holding the full DB (see
+    `_cached_sharded_db_builder`).  Used by the single-image sharded path
+    and the sharded video phase."""
+    from image_analogies_tpu.parallel.sharded_match import \
+        sharded_pad_geometry
+
+    ha, wa = a_filt.shape[:2]
+    npad, fp = sharded_pad_geometry(ha * wa, spec.total, mesh.shape["db"],
+                                    tile)
+    fn = _cached_sharded_db_builder(mesh, spec, pad_full, npad, fp)
+    return fn(a_src, a_filt, a_src_coarse, a_filt_coarse, a_temporal,
+              rowsafe)
+
+
+def make_level_template(params, job: LevelJob, strategy: str) -> TpuLevelDB:
+    """Slim per-level TpuLevelDB for the mesh step: real query-side maps
+    (gather indices, masks, schedule, weights), 1-row placeholders for every
+    DB-sized array — the mesh step reads DB rows only through the sharded
+    inputs, so the full arrays must never exist per chip."""
+    spec = job.spec
+    hb, wb = job.b_shape
+    ha, wa = job.a_shape
+    flat_idx, valid, written = _gather_maps_device(hb, wb, spec.fine_size)
+    off = window_offsets(spec.fine_size)
+    rowsafe = ((off[:, 0] < 0).astype(np.float32)
+               * causal_mask(spec.fine_size))
+    diag = (_diag_schedule(hb, wb, spec.fine_size // 2 + 1)
+            if strategy == "wavefront" else None)
+    z2 = jnp.zeros((1, spec.total), _F32)
+    z1 = jnp.zeros((1,), _F32)
+    fsl = spec.fine_filt_slice
+    return TpuLevelDB(
+        db=z2, db_sqnorm=z1, db_rowsafe=z2, db_rowsafe_sqnorm=z1,
+        static_q=z2, flat_idx=flat_idx, valid=valid, written=written,
+        rowsafe=jnp.asarray(rowsafe), a_filt_flat=z1,
+        fine_sqrtw=jnp.asarray(spec.sqrt_weights()[fsl]),
+        off=jnp.asarray(off), db_sharded=None, dbn_sharded=None,
+        afilt_sharded=None, diag=diag, db_pad=None, dbn_pad=None,
+        ha=ha, wa=wa, hb=hb, wb=wb, fine_start=fsl.start,
+        n_rowsafe=(spec.fine_size // 2) * spec.fine_size,
+        strategy=strategy, refine_passes=params.refine_passes)
+
+
 def slim_for_mesh(db: TpuLevelDB, keep_sharded: bool = False) -> TpuLevelDB:
     """Replace the per-chip copies of DB-sized arrays with 1-row
     placeholders — the ONE definition of which fields the sharded-memory
@@ -659,19 +746,20 @@ class TpuMatcher(Matcher):
     compile on the CPU backend for the virtual-mesh tests."""
 
     def build_features(self, job: LevelJob) -> TpuLevelDB:
+        import dataclasses
+
         spec = job.spec
         to_j = lambda x: None if x is None else jnp.asarray(x, _F32)
-        hb, wb = job.b_shape
         ha, wa = job.a_shape
-        flat_idx, valid, written = _gather_maps_device(hb, wb, spec.fine_size)
-        off = window_offsets(spec.fine_size)
-        # rows-above-only subset of the causal window: known at row start.
-        rowsafe = ((off[:, 0] < 0).astype(np.float32)
-                   * causal_mask(spec.fine_size))
 
         strategy = self.params.strategy
         if strategy == "auto":
             strategy = "wavefront"
+
+        # ONE construction of the query-side maps/schedule/weights for both
+        # the sharded and single-chip paths (review round 2: the two paths
+        # must not carry separate copies of the causal-mask invariants)
+        template = make_level_template(self.params, job, strategy)
 
         # wavefront scores against the FULL DB (the oracle's metric); batched
         # against the rowsafe-masked DB (its symmetric metric).
@@ -689,67 +777,43 @@ class TpuMatcher(Matcher):
             pad_tile = min(_tile_rows(spec.total),
                            max((na + 127) // 128 * 128, 128))
 
-        arrs = _prepare_level_arrays(
-            spec, to_j(job.a_src), to_j(job.a_filt), to_j(job.a_src_coarse),
-            to_j(job.a_filt_coarse), to_j(job.a_temporal), to_j(job.b_src),
-            to_j(job.b_src_coarse), to_j(job.b_filt_coarse),
-            to_j(job.b_temporal), jnp.asarray(rowsafe), pad_tile, pad_full)
-
-        mesh = db_sharded = dbn_sharded = afilt_sharded = None
         if sharded:
             from image_analogies_tpu.parallel.mesh import make_mesh
-            from image_analogies_tpu.parallel.sharded_match import \
-                shard_level_db
 
             mesh = make_mesh(db_shards=self.params.db_shards)
-            score_db, score_dbn = ((arrs["db"], arrs["db_sqnorm"]) if pad_full
-                                   else (arrs["db_rowsafe"],
-                                         arrs["db_rowsafe_sqnorm"]))
             tile = (_tile_rows(spec.total)
                     if jax.default_backend() == "tpu" else 1)
-            db_sharded, dbn_sharded, afilt_sharded = shard_level_db(
-                score_db, score_dbn, arrs["a_filt_flat"], mesh, tile)
+            db_sharded, dbn_sharded, afilt_sharded = build_sharded_db(
+                spec, to_j(job.a_src), to_j(job.a_filt),
+                to_j(job.a_src_coarse), to_j(job.a_filt_coarse),
+                to_j(job.a_temporal), template.rowsafe, mesh, pad_full,
+                tile)
+            # query side in its own program — the DB never materializes
+            # unsharded anywhere
+            static_q = _prepare_query_arrays(
+                spec, to_j(job.b_src), to_j(job.b_src_coarse),
+                to_j(job.b_filt_coarse), to_j(job.b_temporal))
+            return dataclasses.replace(
+                template, static_q=static_q, db_sharded=db_sharded,
+                dbn_sharded=dbn_sharded, afilt_sharded=afilt_sharded,
+                mesh=mesh)
 
-        diag = None
-        if strategy == "wavefront":
-            diag = _diag_schedule(hb, wb, spec.fine_size // 2 + 1)
-
-        fsl = spec.fine_filt_slice
-        out = TpuLevelDB(
+        arrs = _prepare_level_arrays(
+            spec, to_j(job.a_src), to_j(job.a_filt),
+            to_j(job.a_src_coarse), to_j(job.a_filt_coarse),
+            to_j(job.a_temporal), to_j(job.b_src),
+            to_j(job.b_src_coarse), to_j(job.b_filt_coarse),
+            to_j(job.b_temporal), template.rowsafe, pad_tile, pad_full)
+        return dataclasses.replace(
+            template,
             db=arrs["db"],
             db_sqnorm=arrs["db_sqnorm"],
             db_rowsafe=arrs["db_rowsafe"],
             db_rowsafe_sqnorm=arrs["db_rowsafe_sqnorm"],
             static_q=arrs["static_q"],
-            flat_idx=flat_idx,
-            valid=valid,
-            written=written,
-            rowsafe=jnp.asarray(rowsafe),
             a_filt_flat=arrs["a_filt_flat"],
-            fine_sqrtw=jnp.asarray(spec.sqrt_weights()[fsl]),
-            off=jnp.asarray(off),
-            db_sharded=db_sharded,
-            dbn_sharded=dbn_sharded,
-            afilt_sharded=afilt_sharded,
-            diag=diag,
             db_pad=arrs["db_pad"],
-            dbn_pad=arrs["dbn_pad"],
-            ha=ha,
-            wa=wa,
-            hb=hb,
-            wb=wb,
-            fine_start=fsl.start,
-            n_rowsafe=(spec.fine_size // 2) * spec.fine_size,
-            strategy=strategy,
-            refine_passes=self.params.refine_passes,
-            mesh=mesh,
-        )
-        if sharded:
-            # steady-state memory is sharded: the full per-chip DB copies
-            # become 1-row placeholders (ONE slimming definition); the scan
-            # reads rows only through the sharded arrays + psum lookups
-            out = slim_for_mesh(out, keep_sharded=True)
-        return out
+            dbn_pad=arrs["dbn_pad"])
 
     # ------------------------------------------------------------- protocol
 

@@ -47,26 +47,6 @@ from image_analogies_tpu.utils import failure
 from image_analogies_tpu.utils import logging as ialog
 
 
-_static_q_fn = None
-
-
-def _static_q_jit(spec, b_src, b_src_coarse, b_filt_coarse, b_temporal):
-    """Jitted query-side feature build (one fused program per frame instead
-    of eager per-op PJRT dispatch — same reasoning as tpu.py's
-    `_prepare_level_arrays`)."""
-    global _static_q_fn
-    if _static_q_fn is None:
-        import jax
-
-        from image_analogies_tpu.ops.features import build_features_jax
-
-        _static_q_fn = jax.jit(
-            lambda spec, b, bc, bfc, bt: build_features_jax(
-                spec, b, None, bc, bfc, temporal_fine=bt),
-            static_argnums=0)
-    return _static_q_fn(spec, b_src, b_src_coarse, b_filt_coarse, b_temporal)
-
-
 @dataclass
 class VideoResult:
     frames: List[np.ndarray]  # synthesized B' frames
@@ -101,15 +81,14 @@ def _sharded_phase(a, ap, frames, params: AnalogyParams, mesh,
 
     from image_analogies_tpu.backends.base import LevelJob
     from image_analogies_tpu.backends.tpu import (
-        TpuMatcher,
+        _prepare_query_arrays,
         _tile_rows,
-        slim_for_mesh,
+        build_sharded_db,
+        make_level_template,
     )
-    from image_analogies_tpu.ops.features import build_features_jax, \
-        spec_for_level
+    from image_analogies_tpu.ops.features import spec_for_level
     from image_analogies_tpu.ops.pyramid import build_pyramid_np, \
         num_feasible_levels
-    from image_analogies_tpu.parallel.sharded_match import shard_level_db
     from image_analogies_tpu.parallel.step import multichip_level_step
 
     t_real = len(frames)
@@ -157,7 +136,6 @@ def _sharded_phase(a, ap, frames, params: AnalogyParams, mesh,
         prevs = [np.asarray(temporal_prevs[i], np.float32) for i in idx]
         b_temp_pyrs = [build_pyramid_np(p, levels) for p in prevs]
 
-    matcher = TpuMatcher(params.replace(db_shards=1))
     force_xla = jax.default_backend() != "tpu"
     strategy = params.strategy
     if strategy == "auto":
@@ -193,29 +171,25 @@ def _sharded_phase(a, ap, frames, params: AnalogyParams, mesh,
             """The whole level's DEVICE work — features, sharded layout, and
             the mesh scan — so a transient-fault retry re-materializes every
             device buffer from host-side pyramids (stale captured buffers
-            would just fail again after a real device reset)."""
-            db0 = matcher.build_features(job0)
-            # the mesh step reads DB rows/A' values ONLY through the sharded
-            # inputs and psum lookups; the template ships placeholders
-            # instead of replicated full-DB copies
-            template = slim_for_mesh(db0)
-
+            would just fail again after a real device reset).  The DB builds
+            DIRECTLY sharded (build_sharded_db): no chip ever holds the full
+            exemplar DB, during the build or the scan."""
             to_j = lambda x: None if x is None else jnp.asarray(x,
                                                                 jnp.float32)
-            static_qs = [db0.static_q]
-            for i in range(1, t_pad):
+            template = make_level_template(params, job0, strategy)
+            tile = _tile_rows(spec.total) if not force_xla else 1
+            dbp, dbnp, afp = build_sharded_db(
+                spec, to_j(job0.a_src), to_j(job0.a_filt),
+                to_j(job0.a_src_coarse), to_j(job0.a_filt_coarse),
+                to_j(job0.a_temporal), template.rowsafe, mesh,
+                strategy == "wavefront", tile)
+            static_qs = []
+            for i in range(t_pad):
                 j = job_for(i)
-                static_qs.append(_static_q_jit(
+                static_qs.append(_prepare_query_arrays(
                     spec, to_j(j.b_src), to_j(j.b_src_coarse),
                     to_j(j.b_filt_coarse), to_j(j.b_temporal)))
             frame_static_q = jnp.stack(static_qs)
-
-            score_db, score_dbn = (
-                (db0.db, db0.db_sqnorm) if strategy == "wavefront"
-                else (db0.db_rowsafe, db0.db_rowsafe_sqnorm))
-            tile = _tile_rows(spec.total) if not force_xla else 1
-            dbp, dbnp, afp = shard_level_db(score_db, score_dbn,
-                                            db0.a_filt_flat, mesh, tile)
             return multichip_level_step(
                 mesh, frame_static_q, dbp, dbnp, afp, template,
                 job0.kappa_mult, force_xla=force_xla)

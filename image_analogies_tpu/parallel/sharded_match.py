@@ -83,6 +83,18 @@ def local_argmin_allreduce(queries, db_shard, dbn_shard, axis: str,
     return i.astype(jnp.int32), d
 
 
+def sharded_pad_geometry(n: int, f: int, shards: int, tile: int = 1):
+    """(npad, fp) for a sharded level DB: per-shard rows are a multiple of
+    ``tile`` capped at the 128-aligned per-shard need, features pad to the
+    128-lane boundary.  The ONE definition shared by `shard_level_db` and
+    the sharded feature builder (backends/tpu.py) so their layouts can
+    never diverge."""
+    fp = max(_round_up(f, 128), 128)
+    per_shard = -(-n // shards)
+    tile = min(max(tile, 1), max(_round_up(per_shard, 128), 128))
+    return shards * _round_up(per_shard, tile), fp
+
+
 def shard_level_db(score_db: jax.Array, score_dbn: jax.Array,
                    a_filt_flat: jax.Array, mesh: Mesh, tile: int = 1,
                    axis: str = "db"):
@@ -100,13 +112,7 @@ def shard_level_db(score_db: jax.Array, score_dbn: jax.Array,
     """
     shards = mesh.shape[axis]
     n, f = score_db.shape
-    fp = max(_round_up(f, 128), 128)
-    # cap the tile at the (128-aligned) per-shard need: tiny coarse-pyramid
-    # levels must not balloon to a full 8192-row tile of padding per shard
-    per_shard = -(-n // shards)
-    tile = min(max(tile, 1), max(_round_up(per_shard, 128), 128))
-    r = _round_up(per_shard, tile)
-    npad = shards * r
+    npad, fp = sharded_pad_geometry(n, f, shards, tile)
     dbp = jnp.zeros((npad, fp), score_db.dtype).at[:n, :f].set(score_db)
     dbnp = jnp.full((npad,), jnp.inf, jnp.float32).at[:n].set(score_dbn)
     afp = jnp.zeros((npad,), jnp.float32).at[:n].set(a_filt_flat)

@@ -121,10 +121,12 @@ def multichip_level_step(
     (bp (T, Nb), s (T, Nb), n_coherence (T,)).
 
     The scoring DB must match the template's strategy (rowsafe-masked for
-    batched, full for wavefront) and come from
-    `parallel.sharded_match.shard_level_db` (tile/lane-aligned layout).
-    Slim the template with `backends.tpu.slim_for_mesh` first — the step
-    reads DB rows and A' values only through the sharded inputs."""
+    batched, full for wavefront) and use the `shard_level_db` /
+    `sharded_pad_geometry` layout — production callers build it DIRECTLY
+    sharded via `backends.tpu.build_sharded_db` and construct the template
+    with `backends.tpu.make_level_template` (the step reads DB rows and A'
+    values only through the sharded inputs, so the template must carry
+    placeholders, never full per-chip DB arrays)."""
     t_total = frame_static_q.shape[0]
     data_shards = mesh.shape["data"]
     db_shards = mesh.shape["db"]
@@ -133,7 +135,8 @@ def multichip_level_step(
                          f"data={data_shards}")
     if db_shard_src.shape[0] % db_shards:
         raise ValueError("DB rows must be padded to a multiple of db shards "
-                         "(use parallel.sharded_match.shard_level_db)")
+                         "(build via backends.tpu.build_sharded_db or "
+                         "parallel.sharded_match.shard_level_db)")
     precision = (jax.lax.Precision.HIGHEST
                  if template.strategy == "wavefront"
                  else jax.lax.Precision.DEFAULT)
