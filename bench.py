@@ -64,12 +64,12 @@ def input_digest(a, ap, b) -> str:
     return h.hexdigest()[:16]
 
 
-def _run_tpu(a, ap, b, params):
+def _run_tpu(a, ap, b, params, keep_levels=False):
     from image_analogies_tpu.models.analogy import create_image_analogy
 
     create_image_analogy(a, ap, b, params)  # compile warm-up
     t0 = time.perf_counter()
-    res = create_image_analogy(a, ap, b, params)
+    res = create_image_analogy(a, ap, b, params, keep_levels=keep_levels)
     return res, time.perf_counter() - t0
 
 
@@ -83,62 +83,109 @@ def main() -> int:
     dev = jax.devices()[0].device_kind
     configs = {}
 
+    from image_analogies_tpu.utils.parity import (
+        audit_source_map_mismatches,
+    )
+
+    def _parity_fields(res, o_bp, o_smap):
+        diff = np.abs(res.bp_y - o_bp)
+        return {
+            "ssim_vs_oracle": round(ssim(res.bp_y, o_bp), 4),
+            "value_match": round(float((res.bp_y == o_bp).mean()), 4),
+            "output_mae": round(float(diff.mean()), 6),
+            "source_map_mismatch": round(float(
+                (res.source_map != o_smap).mean()), 6),
+        }
+
+    def _audit_fields(a, ap, b, p, res, oracle_levels):
+        """Tie-audit (utils/parity.py): mechanically classify every
+        mismatched pick; `mismatch_explained_by_ties` target is 1.0."""
+        audit = audit_source_map_mismatches(a, ap, b, p, res.levels,
+                                            oracle_levels)
+        return {
+            "mismatch_explained_by_ties":
+                audit["mismatch_explained_by_ties"],
+            "mismatch_classes": {
+                k: audit[k] for k in ("mismatches", "ctx_diverged",
+                                      "tie_exact", "tie_fp",
+                                      "kappa_boundary", "unexplained")},
+            "first_divergence_is_tie": audit["first_divergence_is_tie"],
+        }
+
     # ---- config 2 (oil filter, 256^2, 3 levels): LIVE oracle ----
     a, ap, b = make_structured(256)
     p = AnalogyParams(levels=3, kappa=5.0, backend="tpu",
                       strategy="wavefront")
-    res_tpu, tpu_s = _run_tpu(a, ap, b, p)
+    res_tpu, tpu_s = _run_tpu(a, ap, b, p, keep_levels=True)
     t0 = time.perf_counter()
-    res_cpu = create_image_analogy(a, ap, b, p.replace(backend="cpu"))
+    res_cpu = create_image_analogy(a, ap, b, p.replace(backend="cpu"),
+                                   keep_levels=True)
     cpu_s = time.perf_counter() - t0
-    diff = np.abs(res_tpu.bp_y - res_cpu.bp_y)
-    match = float((res_tpu.bp_y == res_cpu.bp_y).mean())
     configs["oil_256"] = {
         "tpu_s": round(tpu_s, 3),
         "cpu_oracle_s": round(cpu_s, 1),
         "speedup": round(cpu_s / tpu_s, 1),
-        "ssim_vs_oracle": round(ssim(res_tpu.bp_y, res_cpu.bp_y), 4),
-        "value_match": round(match, 4),
-        "output_mae": round(float(diff.mean()), 6),
-        "source_map_mismatch": round(float(
-            (res_tpu.source_map != res_cpu.source_map).mean()), 6),
+        **_parity_fields(res_tpu, res_cpu.bp_y, res_cpu.source_map),
+        **_audit_fields(a, ap, b, p, res_tpu, res_cpu.levels),
         "oracle": "live",
     }
 
-    # ---- north star (1024^2, 5 levels): cached oracle ----
+    # ---- north star (1024^2, 5 levels): every cached oracle seed ----
+    # seed 7 is the historic headline; additional seeds (13) make the
+    # at-scale parity claim n>=2 (round-2 VERDICT weak item 2).  The TPU
+    # run is re-timed per seed (same compiled program, different inputs).
     cache = os.path.join(_HERE, "bench_cache")
-    with open(os.path.join(cache, "oracle_1024.json")) as f:
-        ocfg = json.load(f)
-    oz = np.load(os.path.join(
-        cache, f"oracle_1024_seed{ocfg['config']['seed']}.npz"))
-    a, ap, b = make_structured(ocfg["config"]["size"],
-                               ocfg["config"]["seed"])
-    if "input_digest" in ocfg:
-        got = input_digest(a, ap, b)
-        if got != ocfg["input_digest"]:
-            raise SystemExit(
-                f"bench inputs drifted from the cached oracle's "
-                f"({got} != {ocfg['input_digest']}): re-run "
-                "experiments/oracle_1024.py before benching")
-    p = AnalogyParams(levels=ocfg["config"]["levels"],
-                      kappa=ocfg["config"]["kappa"], backend="tpu",
-                      strategy="wavefront")
-    res_ns, ns_s = _run_tpu(a, ap, b, p)
-    oracle_s = float(ocfg["wall_s"])
-    ns_ssim = ssim(res_ns.bp_y, oz["bp_y"])
-    ns_diff = np.abs(res_ns.bp_y - oz["bp_y"])
-    ns_match = float((res_ns.bp_y == oz["bp_y"]).mean())
-    configs["north_star_1024"] = {
-        "tpu_s": round(ns_s, 3),
-        "cpu_oracle_s": oracle_s,
-        "speedup": round(oracle_s / ns_s, 1),
-        "ssim_vs_oracle": round(ns_ssim, 4),
-        "value_match": round(ns_match, 4),
-        "output_mae": round(float(ns_diff.mean()), 6),
-        "source_map_mismatch": round(float(
-            (res_ns.source_map != oz["source_map"]).mean()), 6),
-        "oracle": "cached (experiments/oracle_1024.py)",
-    }
+    import glob as _glob
+
+    seed_jsons = _glob.glob(os.path.join(cache, "oracle_1024_seed*.json"))
+    if not seed_jsons:  # legacy single-seed cache layout (seed 7)
+        legacy = os.path.join(cache, "oracle_1024.json")
+        if not os.path.exists(legacy):
+            raise SystemExit("no cached 1024^2 oracle; run "
+                             "experiments/oracle_1024.py first")
+        seed_jsons = [legacy]
+    ocfgs = []
+    for sj in seed_jsons:
+        with open(sj) as f:
+            ocfgs.append(json.load(f))
+    # deterministic order: historic seed 7 is the headline, then by seed
+    ocfgs.sort(key=lambda c: (c["config"]["seed"] != 7,
+                              c["config"]["seed"]))
+    ns_headline = None
+    for ocfg in ocfgs:
+        seed = ocfg["config"]["seed"]
+        oz = np.load(os.path.join(cache, f"oracle_1024_seed{seed}.npz"))
+        a, ap, b = make_structured(ocfg["config"]["size"], seed)
+        if "input_digest" in ocfg:
+            got = input_digest(a, ap, b)
+            if got != ocfg["input_digest"]:
+                raise SystemExit(
+                    f"bench inputs drifted from cached oracle seed {seed} "
+                    f"({got} != {ocfg['input_digest']}): re-run "
+                    "experiments/oracle_1024.py before benching")
+        p = AnalogyParams(levels=ocfg["config"]["levels"],
+                          kappa=ocfg["config"]["kappa"], backend="tpu",
+                          strategy="wavefront")
+        res_ns, ns_s = _run_tpu(a, ap, b, p, keep_levels=True)
+        oracle_s = float(ocfg["wall_s"])
+        rec = {
+            "tpu_s": round(ns_s, 3),
+            "cpu_oracle_s": oracle_s,
+            "speedup": round(oracle_s / ns_s, 1),
+            **_parity_fields(res_ns, oz["bp_y"], oz["source_map"]),
+            "oracle": f"cached seed {seed} (experiments/oracle_1024.py)",
+        }
+        if "s_l0" in oz.files:  # level planes present -> full tie-audit
+            n_lv = ocfg["config"]["levels"]
+            o_levels = [(oz[f"bp_l{i}"], oz[f"s_l{i}"])
+                        for i in range(n_lv)]
+            rec.update(_audit_fields(a, ap, b, p, res_ns, o_levels))
+        configs[f"north_star_1024_seed{seed}"] = rec
+        if ns_headline is None:
+            ns_headline = (ns_s, oracle_s, rec)
+    ns_s, oracle_s, ns_rec = ns_headline
+    ns_ssim = ns_rec["ssim_vs_oracle"]
+    ns_match = ns_rec["value_match"]
 
     print(json.dumps({
         "metric": "1024x1024 B' synthesis wall-clock, 5-level pyramid, "

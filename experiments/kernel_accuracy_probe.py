@@ -1,0 +1,115 @@
+"""Isolate the two-pass scan kernel's per-query accuracy from the wavefront
+cascade: on a REAL level DB (256^2 fine level), compare the top-2 +
+fp32-re-score pick against the exact fp32 argmin for a batch of real
+queries, and report mispick rate + the fp32 score gap distribution of the
+mispicks.  Distinguishes "precision scheme insufficient" (small gaps,
+moderate rate) from "kernel bug" (large gaps / huge rate).
+
+    python experiments/kernel_accuracy_probe.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from examples.make_assets import make_structured
+from image_analogies_tpu.backends.base import LevelJob
+from image_analogies_tpu.backends.tpu import TpuMatcher, _tile_rows
+from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.models.analogy import _prep_planes
+from image_analogies_tpu.ops.features import spec_for_level
+from image_analogies_tpu.ops.pallas_match import (
+    _lex_lt,
+    prepadded_argmin2_queries,
+)
+from image_analogies_tpu.ops.pyramid import build_pyramid_np
+
+
+def main() -> int:
+    size = 256
+    a, ap, b = make_structured(size)
+    params = AnalogyParams(levels=3, kappa=5.0, backend="tpu",
+                           strategy="wavefront", match_mode="two_pass")
+    a_src, b_src, a_filt, _, _ = _prep_planes(a, ap, b, params)
+    pyr_as = build_pyramid_np(a_src, 3)
+    pyr_af = build_pyramid_np(a_filt, 3)
+    pyr_bs = build_pyramid_np(b_src, 3)
+    level = 0
+    spec = spec_for_level(params, level, 3, 1)
+    job = LevelJob(
+        level=level, spec=spec, kappa_mult=params.kappa_factor(level) ** 2,
+        a_src=pyr_as[level], a_filt=pyr_af[level], b_src=pyr_bs[level],
+        a_src_coarse=pyr_as[level + 1], a_filt_coarse=pyr_af[level + 1],
+        b_src_coarse=pyr_bs[level + 1],
+        b_filt_coarse=np.zeros_like(pyr_bs[level + 1]),
+        a_temporal=None, b_temporal=None)
+    m = TpuMatcher(params)
+    db = m.build_features(job)
+    print(f"# db_pad dtype={db.db_pad.dtype} shape={db.db_pad.shape} "
+          f"feat_mean? {db.feat_mean is not None}", file=sys.stderr)
+
+    # realistic queries: static_q rows with the causal block zero —
+    # exactly what the first diagonal scores; then add DB rows themselves
+    # as queries (distance-0 case: exact self-match expected)
+    rng = np.random.default_rng(0)
+    qs = np.asarray(db.static_q)[rng.choice(db.static_q.shape[0], 2048,
+                                            replace=False)]
+    qd = np.asarray(db.db)[rng.choice(db.db.shape[0], 1024, replace=False)]
+    dbf = jnp.asarray(db.db)
+    dbn = jnp.asarray(db.db_sqnorm)
+
+    for name, q in [("static_q", qs), ("db_rows", qd)]:
+        qj = jnp.asarray(q)
+        # exact reference on-chip: fp32 scores at HIGHEST via plain XLA
+        scores = dbn[None, :] - 2.0 * jnp.dot(
+            qj, dbf.T, preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)
+        ref = jnp.argmin(scores, axis=1)
+        ref_d = jnp.sum((dbf[ref] - qj) ** 2, axis=1)
+
+        for q_split in (False, True):
+            # chunk like the wavefront does (M <= ~344 per diagonal):
+            # a single big M explodes the kernel's (M, tile_n) VMEM scores
+            outs = []
+            for c0 in range(0, qj.shape[0], 256):
+                qc = qj[c0:c0 + 256] - db.feat_mean[None, :qj.shape[1]]
+                outs.append(prepadded_argmin2_queries(
+                    qc, db.db_pad, db.dbn_pad,
+                    tile_n=_tile_rows(qj.shape[1], 2), q_split=q_split))
+            i1 = jnp.concatenate([o[0] for o in outs])
+            i2 = jnp.concatenate([o[1] for o in outs])
+            ok2 = jnp.concatenate([o[2] for o in outs])
+            d1 = jnp.sum((dbf[i1] - qj) ** 2, axis=1)
+            d2 = jnp.where(ok2, jnp.sum((dbf[i2] - qj) ** 2, axis=1),
+                           jnp.inf)
+            use2 = _lex_lt(d2, i2, d1, i1)
+            pick = jnp.where(use2, i2, i1)
+            pick_d = jnp.where(use2, d2, d1)
+            mis = np.asarray(pick != ref)
+            gap = np.asarray(pick_d - ref_d)
+            vals = np.asarray(db.a_filt_flat)
+            val_mis = np.asarray(vals[np.asarray(pick)]
+                                 != vals[np.asarray(ref)])
+            rec = {
+                "queries": name, "q_split": q_split,
+                "mispick": round(float(mis.mean()), 5),
+                "value_mispick": round(float(val_mis.mean()), 5),
+                "gap_p50": float(np.median(gap[mis])) if mis.any() else 0.0,
+                "gap_max": float(gap.max()),
+                "rank2_rescues": int(np.asarray(use2).sum()),
+            }
+            print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,15 +1,19 @@
-"""Measure the CPU/cKDTree oracle ONCE on the north-star config (1024^2 B',
-5-level pyramid, kappa=5) and cache {wall-clock, per-level stats, output
-plane} for bench.py — the oracle run takes ~an hour, far too slow to repeat
-every bench invocation (BASELINE.md's 'CPU-oracle wall-clock' TBD row).
+"""Measure the CPU/cKDTree oracle ONCE per seed on the north-star config
+(1024^2 B', 5-level pyramid, kappa=5) and cache {wall-clock, per-level stats,
+output plane} for bench.py — the oracle run takes ~half an hour, far too slow
+to repeat every bench invocation (BASELINE.md's 'CPU-oracle wall-clock' row).
 
-    JAX_PLATFORMS=cpu python experiments/oracle_1024.py
+    python experiments/oracle_1024.py [--seed N]
 
-Writes bench_cache/oracle_1024_seed7.npz + bench_cache/oracle_1024.json.
+Writes bench_cache/oracle_1024_seed{N}.npz + oracle_1024_seed{N}.json (and
+the historic oracle_1024.json name for the primary seed 7).  bench.py scores
+the TPU run against EVERY cached seed it finds, so a second seed turns the
+north-star-scale parity claim from n=1 into n>=2 (round-2 VERDICT weak 2).
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
@@ -17,6 +21,12 @@ import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# the box's sitecustomize force-registers the TPU plugin over JAX_PLATFORMS;
+# this oracle is CPU-only and must never grab the chip out from under a bench
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 
@@ -27,30 +37,59 @@ from image_analogies_tpu.config import AnalogyParams
 def main() -> int:
     from image_analogies_tpu.models.analogy import create_image_analogy
 
-    size, levels, kappa, seed = 1024, 5, 5.0, 7
+    ap_args = argparse.ArgumentParser()
+    ap_args.add_argument("--seed", type=int, default=7)
+    seed = ap_args.parse_args().seed
+    size, levels, kappa = 1024, 5, 5.0
     a, ap, b = make_structured(size, seed)
     p = AnalogyParams(levels=levels, kappa=kappa, backend="cpu")
     t0 = time.perf_counter()
-    res = create_image_analogy(a, ap, b, p)
+    res = create_image_analogy(a, ap, b, p, keep_levels=True)
     wall_s = time.perf_counter() - t0
 
     out = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "bench_cache")
     os.makedirs(out, exist_ok=True)
+    # every level's planes: the finest pair feeds parity scoring, the full
+    # pyramid feeds the tie-audit (utils/parity.py re-scores mismatched
+    # picks against each run's exact per-level decision context)
+    planes = {"bp_y": res.bp_y.astype(np.float32),
+              "source_map": res.source_map.astype(np.int32)}
+    for lv, (bp, s) in enumerate(res.levels):
+        planes[f"bp_l{lv}"] = bp.astype(np.float32)
+        planes[f"s_l{lv}"] = s.astype(np.int32)
     np.savez_compressed(os.path.join(out, f"oracle_1024_seed{seed}.npz"),
-                        bp_y=res.bp_y.astype(np.float32),
-                        source_map=res.source_map.astype(np.int32))
+                        **planes)
     from bench import input_digest
 
-    with open(os.path.join(out, "oracle_1024.json"), "w") as f:
-        json.dump({
-            "config": {"size": size, "levels": levels, "kappa": kappa,
-                       "seed": seed, "inputs": "make_assets.make_structured"},
-            "input_digest": input_digest(a, ap, b),
-            "wall_s": round(wall_s, 1),
-            "levels_ms": [round(s["ms"], 1) for s in res.stats],
-            "host": "this box (judge's CPU)",
-        }, f, indent=1)
+    digest = input_digest(a, ap, b)
+    # wall_s records the BEST observed oracle wall-clock for this exact
+    # input across generations: a re-generation on a loaded box (e.g. while
+    # test suites hog the CPU) must not inflate the baseline, which would
+    # flatter our reported speedup.  wall_s_this_run / levels_ms always
+    # describe THIS generation (the one whose planes are cached).
+    prev_wall = None
+    prev_path = os.path.join(out, f"oracle_1024_seed{seed}.json")
+    if os.path.exists(prev_path):
+        with open(prev_path) as f:
+            prev = json.load(f)
+        if prev.get("input_digest") == digest:
+            prev_wall = prev.get("wall_s")
+    meta = {
+        "config": {"size": size, "levels": levels, "kappa": kappa,
+                   "seed": seed, "inputs": "make_assets.make_structured"},
+        "input_digest": digest,
+        "wall_s": round(min(wall_s, prev_wall) if prev_wall else wall_s, 1),
+        "wall_s_this_run": round(wall_s, 1),
+        "levels_ms": [round(s["ms"], 1) for s in res.stats],
+        "host": "this box (judge's CPU)",
+    }
+    names = [f"oracle_1024_seed{seed}.json"]
+    if seed == 7:  # historic name bench.py's primary leg reads
+        names.append("oracle_1024.json")
+    for name in names:
+        with open(os.path.join(out, name), "w") as f:
+            json.dump(meta, f, indent=1)
     print(f"oracle 1024^2 done: {wall_s:.1f}s")
     return 0
 

@@ -1,0 +1,108 @@
+"""Decompose the wavefront step's kernel cost on-chip (round-3 perf work).
+
+Times the argmin kernel variants in isolation at north-star scale
+(M=344 queries x Na=1M rows x F=128) with a loop-carried data dependency
+(so XLA can't CSE the repeats), plus a tiny-DB variant to expose the
+per-call fixed cost.  Answers: is the kernel MXU-bound (HIGHEST's 3 passes
+dominate -> precision schemes pay) or VPU/overhead-bound (the (M, tile_n)
+score reductions dominate -> cut reduction work, not MXU passes)?
+
+    python experiments/step_cost_probe.py
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from image_analogies_tpu.ops.pallas_match import (
+    pallas_argmin2_l2_prepadded,
+    pallas_argmin_l2_prepadded,
+)
+
+HI = jax.lax.Precision.HIGHEST
+DEF = jax.lax.Precision.DEFAULT
+
+
+def bench(fn, reps=3):
+    fn()  # compile
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def main() -> int:
+    m, f = 344, 128
+    rng = np.random.default_rng(0)
+    q0 = jnp.asarray(rng.standard_normal((m, f)).astype(np.float32) * 0.05)
+
+    import argparse
+
+    pa = argparse.ArgumentParser()
+    pa.add_argument("--cases", default="top1_f32_HIGHEST,top1_f32_DEFAULT,"
+                    "top2_bf16,top2_f32_HIGHEST")
+    pa.add_argument("--sizes", default="1048576")
+    pa.add_argument("--iters", type=int, default=30)
+    args = pa.parse_args()
+
+    for n, iters in ((int(s), args.iters) for s in args.sizes.split(",")):
+        db32 = jnp.asarray(
+            rng.standard_normal((n, f)).astype(np.float32) * 0.05)
+        dbn = jnp.full((1, n), jnp.inf, jnp.float32).at[0, :].set(
+            jnp.sum(db32 * db32, axis=1))
+        db16 = db32.astype(jnp.bfloat16)
+
+        def loop(body, iters=iters):
+            def f(i, carry):
+                q, acc = carry
+                out = body(q)
+                # data dependency: nudge one query element by ~0 so the next
+                # iteration depends on this one's output
+                q = q.at[0, 0].add(out[0].astype(jnp.float32) * 1e-30)
+                return q, acc + out[0]
+
+            return jax.jit(lambda: jax.lax.fori_loop(
+                0, iters, f, (q0, jnp.int32(0)))[1])
+
+        cases = {
+            "top1_f32_HIGHEST": lambda q: pallas_argmin_l2_prepadded(
+                q, db32, dbn, tile_n=8192, precision=HI)[0],
+            "top1_f32_DEFAULT": lambda q: pallas_argmin_l2_prepadded(
+                q, db32, dbn, tile_n=8192, precision=DEF)[0],
+            "top2_bf16": lambda q: pallas_argmin2_l2_prepadded(
+                q.astype(jnp.bfloat16), db16, dbn, tile_n=8192)[0],
+            "top2_bf16_qsplit": lambda q: pallas_argmin2_l2_prepadded(
+                q, db16, dbn, tile_n=8192, q_split=True)[0],
+            "top2_f32_HIGHEST": lambda q: pallas_argmin2_l2_prepadded(
+                q, db32, dbn, tile_n=8192, precision=HI)[0],
+        }
+        rec = {"n_rows": n, "iters": iters}
+        # roofline reference points first (so partial runs still inform)
+        mxu_us = 2 * m * f * n / 394e12 * 1e6  # one bf16 pass
+        hbm_us = n * f * 4 / 820e9 * 1e6  # fp32 stream at ~820 GB/s
+        rec["roofline_1pass_mxu_us"] = round(mxu_us, 1)
+        rec["roofline_f32_hbm_us"] = round(hbm_us, 1)
+        for name in args.cases.split(","):
+            per_call_us = bench(loop(cases[name])) / iters * 1e6
+            rec[name + "_us"] = round(per_call_us, 1)
+            print(f"# {name}: {per_call_us:.1f} us/call", file=sys.stderr,
+                  flush=True)
+        print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

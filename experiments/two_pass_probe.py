@@ -1,0 +1,104 @@
+"""On-chip A/B of the wavefront anchor modes (round-3 VERDICT item 1).
+
+Runs the wavefront strategy end-to-end in both match modes —
+"exact_hi" (round-2 baseline: HIGHEST-precision scan kernel) and
+"two_pass" (bf16 top-2 scan + exact fp32 re-score) — and reports wall-clock
+plus parity (value_match / SSIM / source-map mismatch) against the live CPU
+oracle at sizes where the oracle is affordable, and two_pass-vs-exact_hi
+agreement at every size.
+
+    python experiments/two_pass_probe.py [--sizes 256,512] [--reps 3]
+
+Timing variance over the PJRT tunnel is +-40% run-to-run: report min of
+--reps (the schedulable floor) AND the list.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from examples.make_assets import make_structured
+from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.models.analogy import create_image_analogy
+from image_analogies_tpu.utils.ssim import ssim
+
+
+def timed(p, a, ap, b, reps):
+    res = create_image_analogy(a, ap, b, p)  # compile warm-up
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        res = create_image_analogy(a, ap, b, p)
+        ts.append(round(time.perf_counter() - t0, 3))
+    return res, ts
+
+
+def parity(x, y):
+    return {
+        "value_match": round(float((x.bp_y == y.bp_y).mean()), 5),
+        "ssim": round(ssim(x.bp_y, y.bp_y), 5),
+        "map_mismatch": round(
+            float((x.source_map != y.source_map).mean()), 5),
+        "mae": round(float(np.abs(x.bp_y - y.bp_y).mean()), 7),
+    }
+
+
+def main() -> int:
+    ap_args = argparse.ArgumentParser()
+    ap_args.add_argument("--sizes", default="256,512")
+    ap_args.add_argument("--reps", type=int, default=3)
+    ap_args.add_argument("--oracle-max", type=int, default=256,
+                         help="run the live CPU oracle up to this size")
+    ap_args.add_argument("--modes",
+                         default="two_pass,two_pass_1p,exact_hi")
+    args = ap_args.parse_args()
+
+    import jax
+
+    print(f"# backend={jax.default_backend()} "
+          f"dev={jax.devices()[0].device_kind}", file=sys.stderr)
+
+    modes = args.modes.split(",")
+    for size in [int(s) for s in args.sizes.split(",")]:
+        levels = 5 if size >= 1024 else 3
+        a, ap, b = make_structured(size)
+        base = AnalogyParams(levels=levels, kappa=5.0, backend="tpu",
+                             strategy="wavefront")
+        runs = {}
+        for mode in modes:
+            runs[mode] = timed(base.replace(match_mode=mode), a, ap, b,
+                               args.reps)
+            print(f"# {size} {mode}: {runs[mode][1]}", file=sys.stderr,
+                  flush=True)
+        rec = {"size": size, "levels": levels}
+        for mode, (_, ts) in runs.items():
+            rec[f"{mode}_s"] = ts
+            rec[f"{mode}_min"] = min(ts)
+        if "exact_hi" in runs:
+            for mode in modes:
+                if mode != "exact_hi":
+                    rec[f"speedup_{mode}_vs_hi"] = round(
+                        min(runs["exact_hi"][1]) / min(runs[mode][1]), 2)
+                    rec[f"{mode}_vs_hi"] = parity(runs[mode][0],
+                                                  runs["exact_hi"][0])
+        if size <= args.oracle_max:
+            t0 = time.perf_counter()
+            r_cpu = create_image_analogy(a, ap, b,
+                                         base.replace(backend="cpu"))
+            rec["oracle_s"] = round(time.perf_counter() - t0, 1)
+            for mode in modes:
+                rec[f"{mode}_vs_oracle"] = parity(runs[mode][0], r_cpu)
+        print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -59,8 +59,10 @@ from image_analogies_tpu.ops.features import (
     window_offsets,
 )
 from image_analogies_tpu.ops.pallas_match import (
+    _lex_lt,
     _round_up,
     argmin_l2,
+    prepadded_argmin2_queries,
     prepadded_argmin_queries,
 )
 
@@ -73,8 +75,11 @@ _ARGMIN_TILE = 8192
 
 
 def _tile_rows(f: int) -> int:
-    """Kernel tile rows for feature dim `f`, holding the VMEM tile bytes at
-    _ARGMIN_TILE x 128 x 4 regardless of the padded feature width."""
+    """Kernel tile rows for feature dim `f`, holding tile ROWS at
+    _ARGMIN_TILE x (128 / padded-F) regardless of the DB dtype: the binding
+    VMEM constraint is the kernel's (M, tile_n) fp32 scores block (scoped
+    limit 16 MB), which depends on tile rows, not DB bytes — doubling rows
+    for a bf16 DB OOMs the scores block at wavefront M (measured)."""
     fp = max(_round_up(f, 128), 128)
     return max(512, _ARGMIN_TILE * 128 // fp)
 
@@ -114,6 +119,11 @@ class TpuLevelDB:
     # scan row inside the fori_loop.
     db_pad: Optional[jax.Array]  # (Npad128, Fp)
     dbn_pad: Optional[jax.Array]  # (1, Npad128)
+    # two-pass scan: per-level feature column mean subtracted from the bf16
+    # scan copy AND the queries (distances are shift-invariant; the bf16
+    # absolute error ~|q|.|d| is not — centering shrinks it ~10x for these
+    # all-positive features).  None for fp32 pads / non-wavefront.
+    feat_mean: Optional[jax.Array]  # (Fp,) or None
     ha: int = field(metadata=dict(static=True))
     wa: int = field(metadata=dict(static=True))
     hb: int = field(metadata=dict(static=True))
@@ -124,6 +134,10 @@ class TpuLevelDB:
     # batched strategy's left-propagation refinement passes (config knob)
     refine_passes: int = field(default=_REFINE_PASSES,
                                metadata=dict(static=True))
+    # wavefront anchor scheme (config.AnalogyParams.match_mode, resolved):
+    # "two_pass" = bf16 top-2 scan + exact fp32 re-score, "exact_hi" =
+    # HIGHEST-precision scan (see make_anchor_fn)
+    match_mode: str = field(default="exact_hi", metadata=dict(static=True))
     # mesh for the sharded whole-level step (db_shards > 1); hashable, so a
     # valid static field — synthesize_level dispatches to parallel/step.py
     mesh: Any = field(default=None, metadata=dict(static=True))
@@ -138,8 +152,19 @@ jax.tree_util.register_dataclass(
 )
 
 
-@functools.lru_cache(maxsize=64)
 def _diag_schedule(h: int, w: int, c: int) -> Tuple[jax.Array, ...]:
+    """Device-resident wavefront schedule: the cached NumPy segments of
+    `_diag_schedule_np`, device_put at use site.  Caching NUMPY (not device
+    buffers) keeps the lru_cache from pinning megabytes of schedule on
+    whatever device was default at first call for process lifetime
+    (round-2 ADVICE item 5); a per-level device_put of a few MB is noise
+    next to the level's feature build."""
+    return tuple(jax.device_put(jnp.asarray(s))
+                 for s in _diag_schedule_np(h, w, c))
+
+
+@functools.lru_cache(maxsize=64)
+def _diag_schedule_np(h: int, w: int, c: int) -> Tuple[np.ndarray, ...]:
     """Anti-diagonal wavefront schedule, skew c, as a tuple of SEGMENTS:
     within each segment, row t holds the flat indices of every pixel (i, j)
     with j + c*i == t (-1 padding on short diagonals).
@@ -191,7 +216,7 @@ def _diag_schedule(h: int, w: int, c: int) -> Tuple[jax.Array, ...]:
         sched = np.full((b - a, seg_m), -1, np.int32)
         for k, t in enumerate(range(a, b)):
             sched[k, :rows[t].size] = rows[t]
-        segs.append(jax.device_put(jnp.asarray(sched)))
+        segs.append(sched)
     return tuple(segs)
 
 
@@ -221,11 +246,12 @@ def _gather_maps_device(h: int, w: int, p: int):
             jax.device_put(valid), jax.device_put(written))
 
 
-@functools.partial(jax.jit, static_argnames=("spec", "pad_tile", "pad_full"))
+@functools.partial(jax.jit, static_argnames=("spec", "pad_tile", "pad_full",
+                                             "pad_bf16"))
 def _prepare_level_arrays(
     spec, a_src, a_filt, a_src_coarse, a_filt_coarse, a_temporal,
     b_src, b_src_coarse, b_filt_coarse, b_temporal, rowsafe, pad_tile,
-    pad_full=False,
+    pad_full=False, pad_bf16=False,
 ):
     """All device-side level preparation fused into ONE program: eager
     per-op dispatch over the PJRT tunnel costs ~1s/level otherwise.
@@ -233,7 +259,11 @@ def _prepare_level_arrays(
     ``pad_full`` selects which DB the pre-padded argmin tiles score against:
     the rowsafe-masked DB (batched strategy's symmetric metric) or the FULL
     DB (wavefront strategy — the oracle's metric: full A/A' rows vs
-    zero-masked queries)."""
+    zero-masked queries).  ``pad_bf16`` stores the pre-padded scan copy in
+    bfloat16 (the two-pass scheme's fast pass: half the HBM stream, one MXU
+    pass); the fp32 ``db`` stays the re-score / coherence source either
+    way, and ``dbn_pad`` keeps the EXACT fp32 row norms so identical rows
+    score identically and ties stay lowest-index."""
     db = build_features_jax(spec, a_src, a_filt, a_src_coarse, a_filt_coarse,
                             temporal_fine=a_temporal)
     static_q = build_features_jax(spec, b_src, None, b_src_coarse,
@@ -256,6 +286,7 @@ def _prepare_level_arrays(
         "a_filt_flat": a_filt.reshape(-1),
         "db_pad": None,
         "dbn_pad": None,
+        "feat_mean": None,
     }
     if pad_tile:
         src = db if pad_full else db_rowsafe
@@ -263,8 +294,20 @@ def _prepare_level_arrays(
         n, f = src.shape
         fp = max((f + 127) // 128 * 128, 128)
         npad = (n + pad_tile - 1) // pad_tile * pad_tile
-        out["db_pad"] = jnp.zeros((npad, fp), _F32).at[:n, :f].set(src)
-        out["dbn_pad"] = jnp.full((1, npad), jnp.inf, _F32).at[0, :n].set(srcn)
+        if pad_bf16:
+            # centered bf16 scan copy + EXACT fp32 norms of the centered
+            # rows (identical rows stay identical -> ties stay lowest-index)
+            mean = jnp.mean(src, axis=0)
+            srcc = src - mean[None, :]
+            out["feat_mean"] = jnp.zeros((fp,), _F32).at[:f].set(mean)
+            out["db_pad"] = jnp.zeros((npad, fp), jnp.bfloat16).at[
+                :n, :f].set(srcc.astype(jnp.bfloat16))
+            out["dbn_pad"] = jnp.full((1, npad), jnp.inf, _F32).at[
+                0, :n].set(jnp.sum(srcc * srcc, axis=1))
+        else:
+            out["db_pad"] = jnp.zeros((npad, fp), _F32).at[:n, :f].set(src)
+            out["dbn_pad"] = jnp.full((1, npad), jnp.inf, _F32).at[
+                0, :n].set(srcn)
     return out
 
 
@@ -326,15 +369,25 @@ def build_sharded_db(spec, a_src, a_filt, a_src_coarse, a_filt_coarse,
               rowsafe)
 
 
-def make_level_template(params, job: LevelJob, strategy: str) -> TpuLevelDB:
+def make_level_template(params, job: LevelJob, strategy: str,
+                        match_mode: str = "exact_hi") -> TpuLevelDB:
     """Slim per-level TpuLevelDB for the mesh step: real query-side maps
     (gather indices, masks, schedule, weights), 1-row placeholders for every
     DB-sized array — the mesh step reads DB rows only through the sharded
-    inputs, so the full arrays must never exist per chip."""
+    inputs, so the full arrays must never exist per chip.
+
+    The wavefront scan computes its window indices/masks from iota math
+    inside the step (`wavefront_scan_core`), so for that strategy the
+    (Nb, p^2) gather maps are 1-row placeholders too — at 1024^2 that drops
+    ~300 MB of HBM (and of replicated mesh-template shipping) per level."""
     spec = job.spec
     hb, wb = job.b_shape
     ha, wa = job.a_shape
-    flat_idx, valid, written = _gather_maps_device(hb, wb, spec.fine_size)
+    if strategy == "wavefront":
+        flat_idx = jnp.zeros((1, spec.fine_n), jnp.int32)
+        valid = written = jnp.zeros((1, spec.fine_n), _F32)
+    else:
+        flat_idx, valid, written = _gather_maps_device(hb, wb, spec.fine_size)
     off = window_offsets(spec.fine_size)
     rowsafe = ((off[:, 0] < 0).astype(np.float32)
                * causal_mask(spec.fine_size))
@@ -350,9 +403,11 @@ def make_level_template(params, job: LevelJob, strategy: str) -> TpuLevelDB:
         fine_sqrtw=jnp.asarray(spec.sqrt_weights()[fsl]),
         off=jnp.asarray(off), db_sharded=None, dbn_sharded=None,
         afilt_sharded=None, diag=diag, db_pad=None, dbn_pad=None,
+        feat_mean=None,
         ha=ha, wa=wa, hb=hb, wb=wb, fine_start=fsl.start,
         n_rowsafe=(spec.fine_size // 2) * spec.fine_size,
-        strategy=strategy, refine_passes=params.refine_passes)
+        strategy=strategy, refine_passes=params.refine_passes,
+        match_mode=match_mode)
 
 
 def slim_for_mesh(db: TpuLevelDB, keep_sharded: bool = False) -> TpuLevelDB:
@@ -367,7 +422,10 @@ def slim_for_mesh(db: TpuLevelDB, keep_sharded: bool = False) -> TpuLevelDB:
     ``keep_sharded=True`` retains the sharded arrays + mesh (build_features
     uses this for the steady-state LevelDB); the default also drops them —
     the shard_map template must not re-ship what the step receives as
-    sharded inputs."""
+    sharded inputs.  ``static_q`` is slimmed too: the step receives the
+    query features as its own (sharded) input and reads only the template's
+    feature WIDTH, so shipping the (Nb, F) copy replicated would waste
+    hundreds of MB per chip at 1024^2 (round-2 ADVICE item 1)."""
     import dataclasses
 
     z2 = jnp.zeros((1, db.static_q.shape[1]), _F32)
@@ -376,7 +434,8 @@ def slim_for_mesh(db: TpuLevelDB, keep_sharded: bool = False) -> TpuLevelDB:
                                       afilt_sharded=None, mesh=None)
     return dataclasses.replace(
         db, db=z2, db_sqnorm=z1, db_rowsafe=z2, db_rowsafe_sqnorm=z1,
-        a_filt_flat=z1, db_pad=None, dbn_pad=None, **kw)
+        static_q=z2, a_filt_flat=z1, db_pad=None, dbn_pad=None,
+        feat_mean=None, **kw)
 
 
 # --------------------------------------------------------------- exact scan
@@ -650,10 +709,60 @@ def _run_batched(db: TpuLevelDB, kappa_mult):
     return batched_scan_core(db, kappa_mult, make_approx_fn(db))
 
 
+def make_anchor_fn(db: TpuLevelDB):
+    """The wavefront strategy's full-DB anchor: (queries (M,F)) ->
+    (p_app (M,) int32, d_app (M,) fp32 EXACT squared distance).
+
+    Both modes end in an exact fp32 re-score against the fp32 DB, so d_app —
+    the kappa rule's threshold — is always oracle-grade; the modes differ in
+    how the candidate pick(s) come off the MXU:
+
+    - "two_pass" (default): ONE bf16 MXU pass over the bf16-resident padded
+      DB tracking the global top-2 (score, index) pairs, then fp32 re-score
+      of BOTH candidates; the (val, idx)-lexicographic min wins, so a bf16
+      rank-1/2 inversion never changes the pick and exact ties stay
+      lowest-index (identical rows quantize identically, so their bf16
+      scores still tie exactly).  ~3x less MXU work + half the HBM stream
+      of exact_hi.
+    - "exact_hi": fp32-grade scores inside the kernel (HIGHEST, 3 bf16
+      passes), single candidate — round-2 behavior, the A/B baseline.
+
+    The mesh-sharded step never comes here: parallel/step.py builds its own
+    anchor over the all-reduced sharded argmin."""
+    if (db.match_mode in ("two_pass", "two_pass_1p")
+            and db.db_pad is not None
+            and db.db_pad.dtype == jnp.bfloat16):
+        q_split = db.match_mode == "two_pass"  # _1p: single-pass probe mode
+        # q_split doubles the kernel's query rows, so its (2M, tile_n)
+        # score block needs half the tile to stay inside scoped VMEM
+        tile = _tile_rows(db.static_q.shape[1]) // (2 if q_split else 1)
+
+        def anchor(queries):
+            qc = queries - db.feat_mean[None, :queries.shape[1]]
+            i1, i2, ok2 = prepadded_argmin2_queries(
+                qc, db.db_pad, db.dbn_pad, tile_n=tile, q_split=q_split)
+            d1 = jnp.sum((db.db[i1] - queries) ** 2, axis=1)
+            d2 = jnp.where(ok2, jnp.sum((db.db[i2] - queries) ** 2, axis=1),
+                           jnp.inf)
+            use2 = _lex_lt(d2, i2, d1, i1)
+            return (jnp.where(use2, i2, i1).astype(jnp.int32),
+                    jnp.where(use2, d2, d1))
+
+        return anchor
+
+    approx = make_approx_fn(db)
+
+    def anchor(queries):
+        p, _ = approx(queries)
+        return p, jnp.sum((db.db[p] - queries) ** 2, axis=1)
+
+    return anchor
+
+
 # ------------------------------------------------------------ wavefront scan
 
 
-def wavefront_scan_core(db: TpuLevelDB, kappa_mult, approx_fn,
+def wavefront_scan_core(db: TpuLevelDB, kappa_mult, anchor_fn,
                         row_fn=None, afilt_fn=None):
     """The parity fast path (VERDICT.md round-1 item 1): the oracle's exact
     algorithm on an anti-diagonal schedule.
@@ -670,22 +779,36 @@ def wavefront_scan_core(db: TpuLevelDB, kappa_mult, approx_fn,
                                                          (d <= r, k >= 1)
 
     so all pixels of one diagonal are independent given previous diagonals
-    and resolve in ONE batch: fused Pallas full-DB argmin anchors, exact
-    fp32 re-score, batched Ashikhmin coherence over the full causal window,
-    kappa rule (Hertzmann §3.2 eq. 2).  Every per-pixel decision sees the
-    same dependency values as the oracle's raster scan, so the output IS the
-    oracle's up to fp tie-breaks — no Gauss-Seidel iteration, no sequential
-    inner loop, ~(W + (r+1)H) batched steps per level.
+    and resolve in ONE batch: the anchor (fused Pallas full-DB scan + exact
+    fp32 re-score — `make_anchor_fn`), batched Ashikhmin coherence over the
+    full causal window, kappa rule (Hertzmann §3.2 eq. 2).  Every per-pixel
+    decision sees the same dependency values as the oracle's raster scan, so
+    the output IS the oracle's up to fp tie-breaks — no Gauss-Seidel
+    iteration, no sequential inner loop, ~(W + (r+1)H) batched steps per
+    level.
+
+    The per-pixel window indices and causal/written masks are iota math on
+    the diagonal's pixel ids — NOT gathers of precomputed (Nb, p^2) maps
+    (the maps cost ~300 MB HBM + a triple gather per step at 1024^2; the
+    math is a handful of VPU ops).  Semantics are identical: flat indices
+    clamp at the edges, `written` tests clamped-index < pixel-index,
+    exactly as `_gather_maps_device` builds them.
 
     All scoring uses the oracle's metric: FULL A/A' DB rows against
     zero-masked causal queries (the cKDTree metric), not the batched
     strategy's symmetric rowsafe-masked one.
     """
     nb = db.hb * db.wb
+    hb, wb = db.hb, db.wb
     if row_fn is None:
         row_fn = lambda i: db.db[i]
     if afilt_fn is None:
         afilt_fn = lambda i: db.a_filt_flat[i]
+
+    # causal-window invariants from the offset table (tiny, device-resident)
+    off_i = db.off[:, 0][None, :]  # (1, nf)
+    off_j = db.off[:, 1][None, :]
+    causal = (off_i < 0) | ((off_i == 0) & (off_j < 0))
 
     def make_step(seg):
         def step(t, state):
@@ -693,18 +816,24 @@ def wavefront_scan_core(db: TpuLevelDB, kappa_mult, approx_fn,
             pix = seg[t]  # (M,) flat indices, -1 on short diagonals
             lane_ok = pix >= 0
             pixc = jnp.maximum(pix, 0)
-            idx = db.flat_idx[pixc]  # (M, nf)
-            dyn = bp[idx] * db.written[pixc] * db.fine_sqrtw[None, :]
+            qi = pixc // wb
+            qj = pixc - qi * wb
+            wi = qi[:, None] + off_i
+            wj = qj[:, None] + off_j
+            inb = (wi >= 0) & (wi < hb) & (wj >= 0) & (wj < wb)
+            idx = (jnp.clip(wi, 0, hb - 1) * wb
+                   + jnp.clip(wj, 0, wb - 1))  # (M, nf) edge-clamped
+            written = (causal & (idx < pixc[:, None])).astype(_F32)
+            dyn = bp[idx] * written * db.fine_sqrtw[None, :]
             queries = jax.lax.dynamic_update_slice(
                 db.static_q[pixc], dyn, (0, db.fine_start))
-            p_app, _ = approx_fn(queries)
-            d_app = jnp.sum((row_fn(p_app) - queries) ** 2, axis=1)
+            p_app, d_app = anchor_fn(queries)
 
             # batched Ashikhmin coherence over the full causal window,
             # scored against the FULL DB (the oracle's metric)
             nf = int(db.off.shape[0])
             p_coh, d_coh, has_coh = _batched_coherence(
-                db, s, queries, idx, db.valid[pixc] > 0, nf, row_fn)
+                db, s, queries, idx, inb & causal, nf, row_fn)
 
             use_coh = has_coh & (d_coh <= d_app * kappa_mult)
             p = jnp.where(use_coh, p_coh, p_app).astype(jnp.int32)
@@ -729,7 +858,7 @@ def wavefront_scan_core(db: TpuLevelDB, kappa_mult, approx_fn,
 
 @jax.jit
 def _run_wavefront(db: TpuLevelDB, kappa_mult):
-    return wavefront_scan_core(db, kappa_mult, make_approx_fn(db))
+    return wavefront_scan_core(db, kappa_mult, make_anchor_fn(db))
 
 
 # Strategies with the uniform (db, kappa_mult) -> (bp, s, n_coh) signature;
@@ -756,16 +885,33 @@ class TpuMatcher(Matcher):
         if strategy == "auto":
             strategy = "wavefront"
 
-        # ONE construction of the query-side maps/schedule/weights for both
-        # the sharded and single-chip paths (review round 2: the two paths
-        # must not carry separate copies of the causal-mask invariants)
-        template = make_level_template(self.params, job, strategy)
-
         # wavefront scores against the FULL DB (the oracle's metric); batched
         # against the rowsafe-masked DB (its symmetric metric).
         pad_full = strategy == "wavefront"
         sharded = (self.params.db_shards > 1
                    and strategy in ("batched", "wavefront"))
+        # anchor mode (wavefront only): the sharded mesh step always scans
+        # at HIGHEST (parallel/step.py), so two_pass resolves only for the
+        # single-chip Pallas path.
+        mode = self.params.match_mode
+        if mode == "auto":
+            # measured on-chip (experiments/two_pass_probe.py): the bf16
+            # scan's ~1e-5 score error lands step-level picks on value-equal
+            # rows (kernel_accuracy_probe: value_mispick 0.0) but the
+            # source-map drift CASCADES through downstream coherence
+            # candidates — end-to-end value_match 0.935 vs the oracle's
+            # 1.0 at 256^2.  Parity requires the HIGHEST scan.
+            mode = "exact_hi"
+        if sharded:
+            mode = "exact_hi"
+        pad_bf16 = (mode in ("two_pass", "two_pass_1p")
+                    and strategy == "wavefront")
+
+        # ONE construction of the query-side maps/schedule/weights for both
+        # the sharded and single-chip paths (review round 2: the two paths
+        # must not carry separate copies of the causal-mask invariants)
+        template = make_level_template(self.params, job, strategy, mode)
+
         # data_shards > 1 means the multi-frame mesh step (parallel/step.py)
         # supplies its own sharded approx_fn — don't build the single-chip
         # prepadded DB copy it would never read.
@@ -803,7 +949,8 @@ class TpuMatcher(Matcher):
             to_j(job.a_src_coarse), to_j(job.a_filt_coarse),
             to_j(job.a_temporal), to_j(job.b_src),
             to_j(job.b_src_coarse), to_j(job.b_filt_coarse),
-            to_j(job.b_temporal), template.rowsafe, pad_tile, pad_full)
+            to_j(job.b_temporal), template.rowsafe, pad_tile, pad_full,
+            pad_bf16)
         return dataclasses.replace(
             template,
             db=arrs["db"],
@@ -813,7 +960,8 @@ class TpuMatcher(Matcher):
             static_q=arrs["static_q"],
             a_filt_flat=arrs["a_filt_flat"],
             db_pad=arrs["db_pad"],
-            dbn_pad=arrs["dbn_pad"])
+            dbn_pad=arrs["dbn_pad"],
+            feat_mean=arrs["feat_mean"])
 
     # ------------------------------------------------------------- protocol
 
@@ -821,11 +969,21 @@ class TpuMatcher(Matcher):
                    bp_flat: np.ndarray, s_flat: np.ndarray
                    ) -> Tuple[int, float, bool]:
         """Single-pixel reference path (unit-test seam, not the fast path)."""
+        import dataclasses
+
         if db.mesh is not None:
             raise ValueError(
                 "best_match reads the per-chip DB arrays, which are 1-row "
                 "placeholders when db_shards > 1; use synthesize_level "
                 "(the mesh step) or build with db_shards=1")
+        if db.flat_idx.shape[0] == 1 and db.hb * db.wb > 1:
+            # wavefront LevelDBs carry placeholder gather maps (the scan
+            # computes window indices from iota math); this seam is per-pixel
+            # and cold, so materialize the cached maps here
+            p = int(round(int(db.off.shape[0]) ** 0.5))
+            flat_idx, valid, written = _gather_maps_device(db.hb, db.wb, p)
+            db = dataclasses.replace(db, flat_idx=flat_idx, valid=valid,
+                                     written=written)
         bp = jnp.asarray(bp_flat, _F32)
         s = jnp.asarray(s_flat, jnp.int32)
         qvec = _exact_qvec(db, q, bp)

@@ -41,7 +41,18 @@ def _add_engine_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--strategy",
                    choices=("exact", "rowwise", "batched", "wavefront",
                             "auto"),
-                   default=None)
+                   default=None,
+                   help="TPU scan strategy.  auto=wavefront (oracle parity "
+                        "at full speed; use this).  batched: ~2x faster, "
+                        "approximate (non-parity) synthesis.  exact/rowwise: "
+                        "sequential VALIDATION seams, ~100-1000x slower — "
+                        "never for production runs")
+    p.add_argument("--match-mode",
+                   choices=("auto", "exact_hi", "two_pass", "two_pass_1p"),
+                   default=None,
+                   help="wavefront anchor scheme (auto=exact_hi, the parity "
+                        "mode; two_pass* are measured approximate A/B "
+                        "points — see config.AnalogyParams)")
     p.add_argument("--db-shards", type=int, default=None)
     p.add_argument("--data-shards", type=int, default=None,
                    help="video mode: shard frames over this many mesh "
@@ -62,6 +73,10 @@ def _add_engine_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--resume-from-level", type=int, default=None)
     p.add_argument("--log-path", default=None)
     p.add_argument("--profile-dir", default=None)
+    p.add_argument("--save-levels", dest="save_levels_dir", default=None,
+                   metavar="DIR",
+                   help="write each level's B' plane as DIR/level_XX.png "
+                        "(coarse-to-fine visual debugging)")
     p.add_argument("--coordinator", default=None,
                    help="multi-host: coordinator address host:port "
                         "(jax.distributed); see parallel/distributed.py")
@@ -71,10 +86,10 @@ def _add_engine_flags(p: argparse.ArgumentParser) -> None:
 
 def _params_from_args(args, base: AnalogyParams) -> AnalogyParams:
     kw = {}
-    for name in ("levels", "kappa", "backend", "strategy",
+    for name in ("levels", "kappa", "backend", "strategy", "match_mode",
                  "db_shards", "data_shards", "refine_passes",
                  "level_retries", "checkpoint_dir", "resume_from_level",
-                 "log_path", "profile_dir"):
+                 "log_path", "profile_dir", "save_levels_dir"):
         v = getattr(args, name)
         if v is not None:
             kw[name] = v

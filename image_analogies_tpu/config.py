@@ -79,6 +79,26 @@ class AnalogyParams:
     # coherence, slightly slower rows.
     refine_passes: int = 3
 
+    # How the wavefront strategy's full-DB argmin gets its pick
+    # (single-chip Pallas path; the CPU oracle and the XLA fallback are
+    # always exact fp32, and the mesh-sharded step scans at HIGHEST):
+    #   "exact_hi" - fp32-grade scores inside the scan kernel (HIGHEST =
+    #                3 bf16 MXU passes), single candidate + exact fp32
+    #                re-score.  The PARITY mode; what "auto" resolves to.
+    #   "two_pass" - fast scan (bf16-resident DB, centered features, hi/lo
+    #                query split) tracking top-2 candidates + exact fp32
+    #                re-score of both.  Measured on-chip: per-step picks
+    #                always land on VALUE-equal rows (~1e-5 score band),
+    #                but source-map drift cascades through downstream
+    #                coherence candidates -> end-to-end value_match ~0.935
+    #                vs oracle (256^2).  NOT a parity mode; kept as the
+    #                measured A/B point (experiments/two_pass_probe.py).
+    #   "two_pass_1p" - two_pass without the query split (1 MXU pass);
+    #                same picks as two_pass in measurement (the DB-side
+    #                truncation dominates).  Experiments only.
+    #   "auto"     - exact_hi.
+    match_mode: str = "auto"
+
     # Use the cKDTree index for the CPU approximate match (the reference's ANN
     # toggle); False = brute force (native C++ matcher if built, else NumPy).
     use_ann: bool = True
@@ -103,6 +123,10 @@ class AnalogyParams:
     resume_from_level: Optional[int] = None  # level index (finest=0) to resume at
     profile_dir: Optional[str] = None  # jax.profiler trace dir if set
     log_path: Optional[str] = None  # JSONL structured per-level records
+    # Write each level's synthesized B' plane as level_XX.png into this dir
+    # (the reference family's de-facto debug behavior): visual debugging of
+    # coarse-to-fine progress without touching checkpoints.
+    save_levels_dir: Optional[str] = None
 
     def __post_init__(self):
         if self.levels < 1:
@@ -120,6 +144,10 @@ class AnalogyParams:
         if self.strategy not in ("exact", "rowwise", "batched", "wavefront",
                                  "auto"):
             raise ValueError(f"unknown strategy {self.strategy!r}")
+        if self.match_mode not in ("two_pass", "two_pass_1p", "exact_hi",
+                                   "auto"):
+            # two_pass_1p: single-scan-pass probe variant (experiments only)
+            raise ValueError(f"unknown match_mode {self.match_mode!r}")
         if self.level_retries < 0:
             raise ValueError(
                 f"level_retries must be >= 0, got {self.level_retries}")

@@ -32,15 +32,24 @@ class AnalogyResult:
     bp_y: np.ndarray  # (H,W) synthesized filtered plane (luminance)
     source_map: np.ndarray  # (H,W) int32 flat indices into A (finest level)
     stats: List[Dict[str, Any]] = field(default_factory=list)
+    # with keep_levels=True: every level's (bp, s), finest first — the
+    # tie-audit (utils/parity.py) re-scores mismatched picks against the
+    # exact per-level decision context
+    levels: Optional[List] = None
 
 
-def _prep_planes(a, ap, b, params):
+def _prep_planes(a, ap, b, params, remap_anchor=None):
     """Build the src/filt planes per color mode.
 
     Returns (a_src, b_src, a_filt, ap_rgb, b_yiq) where a_src/b_src are the
     matching planes ((H,W) or (H,W,C)), a_filt is A' luminance (possibly
     remapped), ap_rgb is A' as float RGB (for source_rgb reconstruction), and
     b_yiq is B in YIQ (None when B is grayscale).
+
+    ``remap_anchor``: optional image whose luminance stats drive the
+    Hertzmann §3.4 remap INSTEAD of b's — video mode anchors every frame of
+    a clip on frame 0 so the A mapping stays consistent across frames
+    (round-2 ADVICE item 3; both the serial and mesh paths use it).
     """
     a = color.as_float(np.asarray(a))
     ap = color.as_float(np.asarray(ap))
@@ -51,6 +60,11 @@ def _prep_planes(a, ap, b, params):
     a_filt = color.luminance(ap)
     b_yiq = color.rgb2yiq(b) if (b.ndim == 3 and b.shape[-1] == 3) else None
 
+    def _remap_target(b_src):
+        if remap_anchor is None:
+            return b_src
+        return color.luminance(color.as_float(np.asarray(remap_anchor)))
+
     if params.color_mode == "yiq_transfer":
         a_src = color.luminance(a)
         b_src = b_yiq[..., 0] if b_yiq is not None else color.luminance(b)
@@ -58,7 +72,8 @@ def _prep_planes(a, ap, b, params):
             # ONE affine transform (A's stats -> B's stats) applied to both A
             # and A' (Hertzmann §3.4); per-plane remapping would cancel any
             # affine filter A -> A'.
-            a_src, a_filt = color.remap_pair(a_src, a_filt, b_src)
+            a_src, a_filt = color.remap_pair(a_src, a_filt,
+                                             _remap_target(b_src))
     else:  # source_rgb: keep label/source channels as-is
         a_src = a
         b_src = b
@@ -70,7 +85,8 @@ def _prep_planes(a, ap, b, params):
         if params.remap_luminance and a_src.ndim == 2:
             # the SAME affine transform must hit both planes (remap_pair's
             # invariant) or an affine filter A -> A' would be cancelled
-            a_src, a_filt = color.remap_pair(a_src, a_filt, b_src)
+            a_src, a_filt = color.remap_pair(a_src, a_filt,
+                                             _remap_target(b_src))
     return a_src, b_src, a_filt, ap, b_yiq
 
 
@@ -81,6 +97,8 @@ def create_image_analogy(
     params: AnalogyParams = AnalogyParams(),
     backend=None,
     temporal_prev: Optional[np.ndarray] = None,
+    remap_anchor: Optional[np.ndarray] = None,
+    keep_levels: bool = False,
 ) -> AnalogyResult:
     """Synthesize B' such that A : A' :: B : B' (Hertzmann §3 pseudocode).
 
@@ -88,6 +106,9 @@ def create_image_analogy(
     (B'_{t-1}, same shape as B) for video mode: with
     ``params.temporal_weight > 0`` its windows join the feature vector and
     are matched against A' windows on the DB side (BASELINE.json:12).
+
+    `remap_anchor` pins the §3.4 luminance remap to another image's stats
+    (video clips anchor on frame 0 — see `_prep_planes`).
     """
     if params.data_shards > 1:
         raise ValueError(
@@ -95,7 +116,8 @@ def create_image_analogy(
             "models.video.video_analogy (single images shard the patch DB "
             "via db_shards instead)")
     backend = backend or get_backend(params)
-    a_src, b_src, a_filt, ap_rgb, b_yiq = _prep_planes(a, ap, b, params)
+    a_src, b_src, a_filt, ap_rgb, b_yiq = _prep_planes(
+        a, ap, b, params, remap_anchor=remap_anchor)
 
     min_shape = (min(a_src.shape[0], b_src.shape[0]),
                  min(a_src.shape[1], b_src.shape[1]))
@@ -170,6 +192,14 @@ def create_image_analogy(
             if params.checkpoint_dir:
                 ckpt.save_level(params.checkpoint_dir, level, bp, s,
                                 digest=digest)
+            if params.save_levels_dir:
+                from image_analogies_tpu.utils.imageio import save_image
+                import os
+
+                os.makedirs(params.save_levels_dir, exist_ok=True)
+                save_image(os.path.join(params.save_levels_dir,
+                                        f"level_{level:02d}.png"),
+                           np.clip(bp, 0.0, 1.0))
 
     bp_y = bp_pyr[0]
     s_map = s_pyr[0]
@@ -183,4 +213,6 @@ def create_image_analogy(
             np.stack([bp_y, b_yiq[..., 1], b_yiq[..., 2]], axis=-1))
     else:
         out = np.clip(bp_y, 0.0, 1.0)
-    return AnalogyResult(bp=out, bp_y=bp_y, source_map=s_map, stats=stats)
+    return AnalogyResult(
+        bp=out, bp_y=bp_y, source_map=s_map, stats=stats,
+        levels=(list(zip(bp_pyr, s_pyr)) if keep_levels else None))

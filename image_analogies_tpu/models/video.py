@@ -21,12 +21,11 @@ Two execution schemes:
 level of ALL frames through ONE `shard_map` program on a ('data','db') mesh
 (`parallel/step.py`): frames shard over 'data' and vmap within a chip, the
 patch DB shards over 'db' with the min+argmin all-reduce.  Semantics note:
-the sharded path computes the luminance remap (Hertzmann §3.4) against the
-clip's FIRST frame and reuses it for every frame of both phases — one
-consistent A mapping per clip (less flicker) — whereas the serial path
-remaps per frame; with
-``remap_luminance=False`` the two paths produce identical frames (locked by
-tests/test_video_sharded.py).
+BOTH paths compute the luminance remap (Hertzmann §3.4) against the clip's
+FIRST frame and reuse it for every frame of both phases — one consistent A
+mapping per clip (less flicker), and sharded == serial frame-for-frame with
+remapping on or off (locked by tests/test_video_sharded.py; round-2 ADVICE
+item 3).
 """
 
 from __future__ import annotations
@@ -299,8 +298,12 @@ def video_analogy(
                            frames_y=[r.bp_y for r in outs], stats=stats)
 
     def synth(b, prev_y, tag, idx):
+        # remap anchored on the clip's FIRST frame — the same consistent
+        # per-clip A mapping the mesh path uses (round-2 ADVICE item 3), so
+        # serial and sharded runs agree with remap_luminance=True too
         res = create_image_analogy(a, ap, b, params, backend=backend,
-                                   temporal_prev=prev_y)
+                                   temporal_prev=prev_y,
+                                   remap_anchor=frames[0])
         for st in res.stats:
             st.update(frame=idx, phase=tag)
             stats.append(st)

@@ -80,6 +80,186 @@ def _argmin_kernel(q_ref, db_ref, dbn_ref, idx_out, val_out,
         val_out[:] = best_val[:]
 
 
+def _lex_lt(va, ia, vb, ib):
+    """Lexicographic (value, index) less-than — the one ordering every argmin
+    path uses, so 'lowest index wins ties' holds bit-for-bit everywhere."""
+    return (va < vb) | ((va == vb) & (ia < ib))
+
+
+_IDX_INF = 2**31 - 1  # init index: loses every (val, idx) tie
+
+
+def _argmin2_kernel(q_ref, db_ref, dbn_ref, i1_out, v1_out, i2_out, v2_out,
+                    b1v, b1i, b2v, b2i, *, tile_n: int, n_total: int,
+                    precision, q_split: bool):
+    """Top-2 variant of `_argmin_kernel`: track the two best (val, idx) pairs
+    per query across tiles, ordered lexicographically by (val, idx).
+
+    This is the scan pass of the TWO-PASS exact-match scheme
+    (backends/tpu.py `make_anchor_fn`): a fast MXU scan over the
+    bf16-resident DB produces two candidates per query; the caller
+    re-scores both in exact fp32 and takes the (val, idx)-min — so a scan
+    rank-1/rank-2 inversion never changes the final pick.
+
+    With ``q_split`` the query block is (2M, F): rows [0, M) hold the bf16
+    HI halves and rows [M, 2M) the LO residuals of the fp32 queries
+    (q = qh + ql, ||ql|| <= 2^-9 ||q||), and the tile's score uses
+    qh.db + ql.db — TWO MXU passes that eliminate the query-side
+    truncation entirely, leaving only the DB-side 2^-9.  Combined with
+    feature centering on the host side (backends/tpu.py — distances are
+    shift-invariant but the bf16 absolute error scales with |q|.|d|, which
+    centering shrinks ~10x for these all-positive features), the scan
+    misranks only inside a ~1e-5-wide band, where the top-2 fp32 re-score
+    recovers the winner.  Still one bf16 HBM stream (half of fp32) and 2
+    passes vs HIGHEST's 3.
+    """
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        b1v[:] = jnp.full_like(b1v, jnp.inf)
+        b2v[:] = jnp.full_like(b2v, jnp.inf)
+        b1i[:] = jnp.full_like(b1i, _IDX_INF)
+        b2i[:] = jnp.full_like(b2i, _IDX_INF)
+
+    dots = jax.lax.dot_general(
+        q_ref[:], db_ref[:],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=_F32,
+        precision=precision,
+    )
+    if q_split:  # (2M, TILE_N): hi rows + lo rows, fp32 accumulation
+        m = dots.shape[0] // 2
+        dots = dots[:m] + dots[m:]
+    scores = dbn_ref[:] - 2.0 * dots
+    col = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+    gidx = col + t * tile_n
+    scores = jnp.where(gidx < n_total, scores, jnp.inf)
+
+    # in-tile top-2: min, then min with the argmin's position masked out
+    # (argmin returns the FIRST occurrence, so ties stay lowest-index)
+    t1v = jnp.min(scores, axis=1, keepdims=True)
+    t1a = jnp.argmin(scores, axis=1).astype(jnp.int32)[:, None]
+    masked = jnp.where(col == t1a, jnp.inf, scores)
+    t2v = jnp.min(masked, axis=1, keepdims=True)
+    t2a = jnp.argmin(masked, axis=1).astype(jnp.int32)[:, None]
+    t1i = t1a + t * tile_n
+    t2i = t2a + t * tile_n
+
+    # merge sorted pairs (g1<g2, t1<t2; all (val,idx) keys distinct):
+    # new1 = min(g1, t1); new2 = min(max(g1, t1)'s list head, other's 2nd)
+    g_first = _lex_lt(b1v[:], b1i[:], t1v, t1i)
+    n1v = jnp.where(g_first, b1v[:], t1v)
+    n1i = jnp.where(g_first, b1i[:], t1i)
+    # candidates for 2nd place: the loser of the firsts, and the winner's 2nd
+    lv = jnp.where(g_first, t1v, b1v[:])
+    li = jnp.where(g_first, t1i, b1i[:])
+    wv = jnp.where(g_first, b2v[:], t2v)
+    wi = jnp.where(g_first, b2i[:], t2i)
+    l_second = _lex_lt(lv, li, wv, wi)
+    b1v[:], b1i[:] = n1v, n1i
+    b2v[:] = jnp.where(l_second, lv, wv)
+    b2i[:] = jnp.where(l_second, li, wi)
+
+    @pl.when(t == pl.num_programs(0) - 1)
+    def _flush():
+        i1_out[:] = b1i[:]
+        v1_out[:] = b1v[:]
+        i2_out[:] = b2i[:]
+        v2_out[:] = b2v[:]
+
+
+@functools.partial(jax.jit, static_argnames=("tile_n", "interpret",
+                                             "precision", "q_split"))
+def pallas_argmin2_l2_prepadded(
+    q: jax.Array,  # (Mp, Fp) tile-aligned, fp32 or bf16
+    dbp: jax.Array,  # (Npad, Fp) tile-aligned (zero feature padding)
+    dbn: jax.Array,  # (1, Npad) fp32 squared norms, +inf on padding rows
+    *,
+    tile_n: int = 2048,
+    interpret: bool = False,
+    precision=jax.lax.Precision.DEFAULT,
+    q_split: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Top-2 kernel entry.  Returns (i1, s1, i2, s2) per query, (val, idx)
+    lexicographic order, scores = dist - ||q||^2 like the top-1 entry.
+
+    With a bf16 `dbp` the MXU pass reads half the HBM bytes and DEFAULT
+    precision is the operands' full precision — the fast scan of the
+    two-pass exact scheme.  ``q_split`` feeds the kernel the hi/lo bf16
+    decomposition of fp32 queries (see `_argmin2_kernel`), removing the
+    query-side truncation error for one extra MXU pass."""
+    mp, fp = q.shape
+    npad = dbp.shape[0]
+    tile_n = min(tile_n, npad)
+    assert npad % tile_n == 0, (npad, tile_n)
+    if q_split:
+        qf = q.astype(_F32)
+        qh = qf.astype(jnp.bfloat16)
+        ql = (qf - qh.astype(_F32)).astype(jnp.bfloat16)
+        q = jnp.concatenate([qh, ql], axis=0)  # (2Mp, Fp)
+    elif q.dtype != dbp.dtype:
+        q = q.astype(dbp.dtype)
+    qm = q.shape[0]
+
+    grid = npad // tile_n
+    kernel = functools.partial(_argmin2_kernel, tile_n=tile_n, n_total=npad,
+                               precision=precision, q_split=q_split)
+    outs = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((qm, fp), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_n, fp), lambda t: (t, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile_n), lambda t: (0, t),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[pl.BlockSpec((mp, 1), lambda t: (0, 0),
+                                memory_space=pltpu.VMEM)] * 4,
+        out_shape=[
+            jax.ShapeDtypeStruct((mp, 1), jnp.int32),
+            jax.ShapeDtypeStruct((mp, 1), _F32),
+            jax.ShapeDtypeStruct((mp, 1), jnp.int32),
+            jax.ShapeDtypeStruct((mp, 1), _F32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((mp, 1), _F32),
+            pltpu.VMEM((mp, 1), jnp.int32),
+            pltpu.VMEM((mp, 1), _F32),
+            pltpu.VMEM((mp, 1), jnp.int32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * mp * fp * npad,
+            bytes_accessed=npad * fp * dbp.dtype.itemsize
+            + mp * fp * q.dtype.itemsize + mp * 16,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(q, dbp, dbn)
+    i1, v1, i2, v2 = outs
+    return i1[:, 0], v1[:, 0], i2[:, 0], v2[:, 0]
+
+
+def prepadded_argmin2_queries(queries, dbp, dbn, *, tile_n: int,
+                              precision=jax.lax.Precision.DEFAULT,
+                              q_split: bool = False):
+    """Top-2 twin of `prepadded_argmin_queries` for RAW (M, F) fp32 queries:
+    pad, run the top-2 kernel, return (i1, i2, valid2) — scores are NOT
+    returned because two-pass callers re-score both candidates in exact
+    fp32 anyway.  `valid2` is False where no second distinct row exists
+    (DB of one row)."""
+    m, f = queries.shape
+    fp = dbp.shape[1]
+    mp = _round_up(max(m, 8), 16 if dbp.dtype == jnp.bfloat16 else 8)
+    qp = jnp.zeros((mp, fp), queries.dtype).at[:m, :f].set(queries)
+    i1, _, i2, v2 = pallas_argmin2_l2_prepadded(
+        qp, dbp, dbn, tile_n=min(tile_n, dbp.shape[0]), precision=precision,
+        q_split=q_split)
+    return i1[:m], i2[:m], jnp.isfinite(v2[:m])
+
+
 @functools.partial(jax.jit, static_argnames=("tile_n", "interpret", "bf16",
                                              "precision"))
 def pallas_argmin_l2(

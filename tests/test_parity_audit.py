@@ -1,0 +1,74 @@
+"""Tie-audit (utils/parity.py): the source-map mismatch story must be a
+checked theorem, not a narrative (round-2 VERDICT item 4 / round-3 item 2).
+
+Runs the TPU wavefront (XLA-exact on the CPU test platform) against the
+CPU/cKDTree oracle on posterized inputs (dense exact ties), audits every
+mismatched pick, and asserts NOTHING is unexplained.  A negative control
+corrupts one pick and checks the audit actually flags it.
+"""
+
+import numpy as np
+import pytest
+
+from examples.make_assets import make_structured
+from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.models.analogy import create_image_analogy
+from image_analogies_tpu.utils.parity import audit_source_map_mismatches
+
+
+@pytest.fixture(scope="module")
+def runs():
+    # 128^2 seed 5 measured: 3.04% pick mismatch, 99.77% value match — a
+    # real tie population for the audit to chew on
+    a, ap, b = make_structured(128, seed=5)
+    p = AnalogyParams(levels=2, kappa=5.0, backend="tpu",
+                      strategy="wavefront")
+    x = create_image_analogy(a, ap, b, p, keep_levels=True)
+    y = create_image_analogy(a, ap, b, p.replace(backend="cpu"),
+                             keep_levels=True)
+    return a, ap, b, p, x, y
+
+
+def test_all_mismatches_explained(runs):
+    a, ap, b, p, x, y = runs
+    audit = audit_source_map_mismatches(a, ap, b, p, x.levels, y.levels)
+    # posterized inputs at 96^2 must produce SOME tie-driven mismatches for
+    # the audit to be meaningful; if this ever goes to zero the fixture
+    # inputs need more posterization, not a weaker assert
+    assert audit["mismatches"] > 0
+    assert audit["unexplained"] == 0, audit
+    assert audit["mismatch_explained_by_ties"] == 1.0
+    assert audit["first_divergence_is_tie"] is True
+    # every clean-context mismatch is an exact or fp32-resolution tie
+    assert audit["clean_ctx_tie_fraction"] == 1.0
+
+
+def test_outputs_value_match_despite_tie_mismatches(runs):
+    # the companion claim: tie mismatches land on value-equal rows
+    _, _, _, _, x, y = runs
+    match = float((x.bp_y == y.bp_y).mean())
+    assert match >= 0.995, match
+
+
+def test_audit_flags_real_disparity(runs):
+    """Negative control: corrupt one coarsest-level pick with a strictly
+    worse row — the audit must report it unexplained (and the first
+    divergence is then NOT a tie)."""
+    a, ap, b, p, x, y = runs
+    lx = [(bp.copy(), s.copy()) for bp, s in x.levels]
+    coarsest = len(lx) - 1
+    bp_c, s_c = lx[coarsest]
+    sy_c = y.levels[coarsest][1]
+    # corrupt the first pixel where the runs AGREE (a clean mismatch site)
+    q = int(np.nonzero(s_c.reshape(-1) == sy_c.reshape(-1))[0][0])
+    s_flat = s_c.reshape(-1)
+    s_flat[q] = (s_flat[q] + 7919) % (s_c.size)  # arbitrary distant row
+    audit = audit_source_map_mismatches(a, ap, b, p, lx, y.levels)
+    assert audit["unexplained"] >= 1
+    assert audit["mismatch_explained_by_ties"] < 1.0
+
+
+def test_audit_level_count_guard(runs):
+    a, ap, b, p, x, y = runs
+    with pytest.raises(ValueError, match="level count"):
+        audit_source_map_mismatches(a, ap, b, p, x.levels[:1], y.levels)

@@ -80,16 +80,21 @@ def test_sharded_video_does_not_retrace():
     assert step._cache_size() == before  # no new traces for equal shapes
 
 
-def test_sharded_video_remap_smoke():
-    # remap on: semantics differ from serial by design (first-frame remap);
-    # assert the path runs and produces sane output
-    a, ap, _ = make_pair(16, 16, seed=5)
-    frames = _frames(a, 2)
-    res = video_analogy(a, ap, frames, AnalogyParams(
-        levels=1, backend="tpu", strategy="wavefront", temporal_weight=1.0,
-        data_shards=2, db_shards=2))
-    assert len(res.frames) == 2
-    assert all(np.isfinite(f).all() for f in res.frames_y)
+def test_sharded_video_matches_serial_with_remap():
+    """With remap_luminance=True BOTH paths anchor the §3.4 remap on the
+    clip's first frame (round-2 ADVICE item 3), so sharded == serial holds
+    with remapping ON too — toggling data_shards must never change output."""
+    a, ap, _ = make_pair(18, 18, seed=5)
+    frames = _frames(a, 3)
+    base = dict(levels=2, kappa=2.0, backend="tpu", strategy="wavefront",
+                temporal_weight=1.0, remap_luminance=True)
+    serial = video_analogy(a, ap, frames, AnalogyParams(**base))
+    sharded = video_analogy(
+        a, ap, frames, AnalogyParams(data_shards=2, db_shards=2, **base))
+    assert len(sharded.frames) == 3
+    for t, (fs, fr) in enumerate(zip(sharded.frames_y, serial.frames_y)):
+        np.testing.assert_allclose(fs, fr, atol=1e-5,
+                                   err_msg=f"frame {t} diverged (remap on)")
 
 
 def test_sequential_scheme_rejects_data_shards():
