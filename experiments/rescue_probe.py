@@ -1,0 +1,169 @@
+"""Screen candidate-rescue depths for the fast wavefront anchor
+(round-3 VERDICT item 1).
+
+The scan_rescue anchor is: per-tile top-K champions under the centered bf16
+scan metric -> top-T tiles by champion score -> exact fp32 re-score of the
+T*K candidates -> (distance, index)-lexicographic min.  Its failure mode is
+a true argmin whose scan score ranks BELOW K other rows in its own tile
+(near-ties cluster within a tile: adjacent A pixels are near-duplicate
+patches and tiles are contiguous row ranges), or whose tile's champion
+ranks below T other tiles.  The round-3 audit showed K=1, T=8 mispicks from
+the coarsest level up (first_divergence_is_tie=false, 48 clean unexplained)
+— this probe measures the per-decision mispick rate for a (K, T) grid on
+REAL evolved queries (reconstructed exactly from an exact_hi run's final
+level planes; causality makes the final plane equal the decision-time
+values).
+
+    python experiments/rescue_probe.py [--size 256] [--level 0] [--sample N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from examples.make_assets import make_structured
+from image_analogies_tpu.backends.tpu import _scan_tile
+from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.models.analogy import _prep_planes, create_image_analogy
+from image_analogies_tpu.ops.features import (
+    build_features_np,
+    fine_gather_maps,
+    spec_for_level,
+)
+from image_analogies_tpu.ops.pyramid import build_pyramid_np
+
+HI = jax.lax.Precision.HIGHEST
+
+
+def main() -> int:
+    pa = argparse.ArgumentParser()
+    pa.add_argument("--size", type=int, default=256)
+    pa.add_argument("--sample", type=int, default=16384)
+    pa.add_argument("--level", type=int, default=0)
+    pa.add_argument("--ks", default="1,2,4")
+    pa.add_argument("--ts", default="4,8,16")
+    args = pa.parse_args()
+
+    size = args.size
+    levels = 5 if size >= 1024 else 3
+    a, ap, b = make_structured(size)
+    params = AnalogyParams(levels=levels, kappa=5.0, backend="tpu",
+                           strategy="wavefront", match_mode="exact_hi")
+    res = create_image_analogy(a, ap, b, params, keep_levels=True)
+
+    a_src, b_src, a_filt, _, _ = _prep_planes(a, ap, b, params)
+    a_src_pyr = build_pyramid_np(a_src, levels)
+    a_filt_pyr = build_pyramid_np(a_filt, levels)
+    b_src_pyr = build_pyramid_np(b_src, levels)
+    lv = args.level
+    spec = spec_for_level(params, lv, levels, 1)
+    coarse = lv + 1 < levels
+    db = build_features_np(
+        spec, a_src_pyr[lv], a_filt_pyr[lv],
+        a_src_pyr[lv + 1] if coarse else None,
+        a_filt_pyr[lv + 1] if coarse else None)
+    static_q = build_features_np(
+        spec, b_src_pyr[lv], None,
+        b_src_pyr[lv + 1] if coarse else None,
+        np.asarray(res.levels[lv + 1][0], np.float32) if coarse else None)
+    hb, wb = np.asarray(res.levels[lv][0]).shape
+    flat_idx, valid, written = fine_gather_maps(hb, wb, spec.fine_size)
+    fsl = spec.fine_filt_slice
+    sqrtw = spec.sqrt_weights()[fsl]
+    bp_final = np.asarray(res.levels[lv][0], np.float32).reshape(-1)
+
+    rng = np.random.default_rng(0)
+    nb = hb * wb
+    sel = np.sort(rng.choice(nb, min(args.sample, nb), replace=False))
+    q = static_q[sel].copy()
+    q[:, fsl] = bp_final[flat_idx[sel]] * written[sel] * sqrtw[None, :]
+
+    na, f = db.shape
+    a_filt_flat = a_filt_pyr[lv].reshape(-1).astype(np.float32)
+
+    # production pad/tile geometry (backends/tpu.py build_features)
+    pad_tile = min(8192, max((na + 255) // 256 * 256, 256))
+    npad = (na + pad_tile - 1) // pad_tile * pad_tile
+    tile = _scan_tile(npad, 128)
+    ntiles = npad // tile
+
+    dbj = jnp.asarray(db)
+    dbn = jnp.sum(dbj * dbj, axis=1)
+    mean = jnp.mean(dbj, axis=0)
+    dbc = dbj - mean[None, :]
+    dbc16p = jnp.zeros((npad, f), jnp.bfloat16).at[:na].set(
+        dbc.astype(jnp.bfloat16))
+    dbnhp = jnp.full((npad,), jnp.inf, jnp.float32).at[:na].set(
+        0.5 * jnp.sum(dbc * dbc, axis=1))
+    qj = jnp.asarray(q)
+    kmax = max(int(k) for k in args.ks.split(","))
+
+    @jax.jit
+    def chunk_stats(qc):
+        # exact reference: HIGHEST-score argmin (= the exact_hi kernel pick)
+        s_hi = dbn[None, :] - 2.0 * jnp.dot(
+            qc, dbj.T, preferred_element_type=jnp.float32, precision=HI)
+        ref = jnp.argmin(s_hi, axis=1).astype(jnp.int32)
+        d_ref = jnp.sum((dbj[ref] - qc) ** 2, axis=-1)
+        # scan sim (two_pass metric): centered bf16, hi/lo query split
+        qcc = qc - mean[None, :]
+        qh = qcc.astype(jnp.bfloat16)
+        ql = (qcc - qh.astype(jnp.float32)).astype(jnp.bfloat16)
+        dots = (jnp.dot(qh, dbc16p.T, preferred_element_type=jnp.float32)
+                + jnp.dot(ql, dbc16p.T, preferred_element_type=jnp.float32))
+        s2 = dots - dbnhp[None, :]  # bigger = closer; -inf on padding
+        s2t = s2.reshape(s2.shape[0], ntiles, tile)
+        tv, ta = jax.lax.top_k(s2t, kmax)  # per-tile top-kmax
+        gidx = ta + (jnp.arange(ntiles) * tile)[None, :, None]
+        return ref, d_ref, tv, gidx
+
+    refs, drefs, tvs, gidxs = [], [], [], []
+    C = 1024
+    for c0 in range(0, qj.shape[0], C):
+        r, dr, tv, gi = chunk_stats(qj[c0:c0 + C])
+        refs.append(np.asarray(r)); drefs.append(np.asarray(dr))
+        tvs.append(np.asarray(tv)); gidxs.append(np.asarray(gi))
+    ref = np.concatenate(refs); d_ref = np.concatenate(drefs)
+    tv = np.concatenate(tvs); gidx = np.concatenate(gidxs)
+    m = ref.shape[0]
+
+    print(json.dumps({"size": size, "level": lv, "na": int(na),
+                      "tile": tile, "ntiles": ntiles,
+                      "nb_sampled": int(m)}), flush=True)
+    for k in [int(x) for x in args.ks.split(",")]:
+        for t in [int(x) for x in args.ts.split(",")]:
+            t_eff = min(t, ntiles)
+            # top-t tiles by champion score
+            torder = np.argsort(-tv[:, :, 0], axis=1, kind="stable")[:, :t_eff]
+            cand = np.take_along_axis(
+                gidx[:, :, :k], torder[:, :, None], axis=1).reshape(m, -1)
+            cand = np.minimum(cand, na - 1)
+            d = ((db[cand] - q[:, None, :]) ** 2).sum(-1)
+            order = np.lexsort((cand, d), axis=-1)[:, 0]
+            pick = np.take_along_axis(cand, order[:, None], 1)[:, 0]
+            pick_d = np.take_along_axis(d, order[:, None], 1)[:, 0]
+            mis = pick != ref
+            rec = {
+                "scheme": f"K{k}_T{t_eff}",
+                "mispick": round(float(mis.mean()), 6),
+                "value_mispick": round(float(
+                    (a_filt_flat[pick] != a_filt_flat[ref]).mean()), 6),
+                "dist_mispick": round(float((pick_d > d_ref).mean()), 6),
+                "gap_max": float((pick_d - d_ref).max()),
+            }
+            print(json.dumps(rec), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -26,9 +26,25 @@ import jax
 import jax.numpy as jnp
 
 from image_analogies_tpu.ops.pallas_match import (
+    bf16_split3,
     pallas_argmin2_l2_prepadded,
     pallas_argmin_l2_prepadded,
+    pallas_packed3_champions,
+    pallas_pertile_champions,
 )
+
+
+def _packed3(q, db16, dn, tile):
+    """Shape-faithful exact_hi2 scan: 3-way split queries, db16 stands in
+    for both packed weight arrays."""
+    import jax.numpy as jnp
+
+    g1, g2, gr = bf16_split3(q)
+    qa = jnp.concatenate([g1.astype(jnp.bfloat16),
+                          g2.astype(jnp.bfloat16)], axis=0)
+    qc = gr.astype(jnp.bfloat16)
+    return pallas_packed3_champions(qa, qc, db16, db16, dn,
+                                    tile_n=tile)[1][0]
 
 HI = jax.lax.Precision.HIGHEST
 DEF = jax.lax.Precision.DEFAULT
@@ -65,29 +81,49 @@ def main() -> int:
             jnp.sum(db32 * db32, axis=1))
         db16 = db32.astype(jnp.bfloat16)
 
-        def loop(body, iters=iters):
+        def loop(name, iters=iters):
+            body = cases[name]
+            # the DB arrays must be jit ARGUMENTS, not closure constants:
+            # constants are embedded in the compile payload, and a 512 MB
+            # DB blows the axon remote-compile request limit (HTTP 413)
             def f(i, carry):
-                q, acc = carry
-                out = body(q)
+                q, acc, db, dbnorm = carry
+                out = body(q, db, dbnorm)
                 # data dependency: nudge one query element by ~0 so the next
                 # iteration depends on this one's output
                 q = q.at[0, 0].add(out[0].astype(jnp.float32) * 1e-30)
-                return q, acc + out[0]
+                return q, acc + out[0], db, dbnorm
 
-            return jax.jit(lambda: jax.lax.fori_loop(
-                0, iters, f, (q0, jnp.int32(0)))[1])
+            db = db16 if ("bf16" in name or "packed3" in name) else db32
+            run = jax.jit(lambda d, dn: jax.lax.fori_loop(
+                0, iters, f, (q0, jnp.int32(0), d, dn))[1])
+            return lambda: run(db, dbn)
 
         cases = {
-            "top1_f32_HIGHEST": lambda q: pallas_argmin_l2_prepadded(
-                q, db32, dbn, tile_n=8192, precision=HI)[0],
-            "top1_f32_DEFAULT": lambda q: pallas_argmin_l2_prepadded(
-                q, db32, dbn, tile_n=8192, precision=DEF)[0],
-            "top2_bf16": lambda q: pallas_argmin2_l2_prepadded(
-                q.astype(jnp.bfloat16), db16, dbn, tile_n=8192)[0],
-            "top2_bf16_qsplit": lambda q: pallas_argmin2_l2_prepadded(
-                q, db16, dbn, tile_n=8192, q_split=True)[0],
-            "top2_f32_HIGHEST": lambda q: pallas_argmin2_l2_prepadded(
-                q, db32, dbn, tile_n=8192, precision=HI)[0],
+            "top1_f32_HIGHEST": lambda q, db, dn: pallas_argmin_l2_prepadded(
+                q, db, dn, tile_n=8192, precision=HI)[0],
+            "top1_f32_DEFAULT": lambda q, db, dn: pallas_argmin_l2_prepadded(
+                q, db, dn, tile_n=8192, precision=DEF)[0],
+            "top2_bf16": lambda q, db, dn: pallas_argmin2_l2_prepadded(
+                q.astype(jnp.bfloat16), db, dn, tile_n=8192)[0],
+            "top2_bf16_qsplit": lambda q, db, dn: pallas_argmin2_l2_prepadded(
+                q, db, dn, tile_n=8192, q_split=True)[0],
+            "top2_f32_HIGHEST": lambda q, db, dn: pallas_argmin2_l2_prepadded(
+                q, db, dn, tile_n=8192, precision=HI)[0],
+            # per-tile champion kernel (dn passed = HALF norms here; the
+            # probe times, it does not validate values)
+            "pertile_hi": lambda q, db, dn: pallas_pertile_champions(
+                q, db, dn, tile_n=4096, precision=HI)[1][0],
+            "pertile_bf16": lambda q, db, dn: pallas_pertile_champions(
+                q.astype(jnp.bfloat16), db, dn, tile_n=4096)[1][0],
+            "pertile_bf16_qsplit": lambda q, db, dn:
+                pallas_pertile_champions(q, db, dn, tile_n=4096,
+                                         q_split=True)[1][0],
+            # 3-pass packed exact scan (exact_hi2); db/dn shapes reused as
+            # stand-ins for W1/W2 — the probe times, it does not validate
+            "packed3_t2048": lambda q, db, dn: _packed3(q, db, dn, 2048),
+            "packed3_t4096": lambda q, db, dn: _packed3(q, db, dn, 4096),
+            "packed3_t8192": lambda q, db, dn: _packed3(q, db, dn, 8192),
         }
         rec = {"n_rows": n, "iters": iters}
         # roofline reference points first (so partial runs still inform)
@@ -96,7 +132,7 @@ def main() -> int:
         rec["roofline_1pass_mxu_us"] = round(mxu_us, 1)
         rec["roofline_f32_hbm_us"] = round(hbm_us, 1)
         for name in args.cases.split(","):
-            per_call_us = bench(loop(cases[name])) / iters * 1e6
+            per_call_us = bench(loop(name)) / iters * 1e6
             rec[name + "_us"] = round(per_call_us, 1)
             print(f"# {name}: {per_call_us:.1f} us/call", file=sys.stderr,
                   flush=True)

@@ -62,6 +62,9 @@ from image_analogies_tpu.ops.pallas_match import (
     _lex_lt,
     _round_up,
     argmin_l2,
+    bf16_split3,
+    packed3_champions,
+    pertile_champions_queries,
     prepadded_argmin2_queries,
     prepadded_argmin_queries,
 )
@@ -76,12 +79,18 @@ _ARGMIN_TILE = 8192
 
 def _tile_rows(f: int) -> int:
     """Kernel tile rows for feature dim `f`, holding tile ROWS at
-    _ARGMIN_TILE x (128 / padded-F) regardless of the DB dtype: the binding
+    ~_ARGMIN_TILE x (128 / padded-F) regardless of the DB dtype: the binding
     VMEM constraint is the kernel's (M, tile_n) fp32 scores block (scoped
     limit 16 MB), which depends on tile rows, not DB bytes — doubling rows
-    for a bf16 DB OOMs the scores block at wavefront M (measured)."""
+    for a bf16 DB OOMs the scores block at wavefront M (measured).
+
+    Always a multiple of 256: level pads are built as multiples of this
+    tile, and `_scan_tile` needs every realizable npad to have a
+    power-of-2 divisor >= 256 (a 2730-row tile at fp=384 would leave npads
+    whose largest power-of-2 divisor is 2, collapsing the champion-kernel
+    grid to npad/2 tiles)."""
     fp = max(_round_up(f, 128), 128)
-    return max(512, _ARGMIN_TILE * 128 // fp)
+    return max(512, _ARGMIN_TILE * 128 // fp // 256 * 256)
 
 _F32 = jnp.float32
 _HIGHEST = jax.lax.Precision.HIGHEST
@@ -118,12 +127,23 @@ class TpuLevelDB:
     # features, +inf norms on padding) — pads ONCE per level instead of every
     # scan row inside the fori_loop.
     db_pad: Optional[jax.Array]  # (Npad128, Fp)
+    # second packed weight array of the exact_hi2 3-pass scan (W2 = [d3|d1];
+    # db_pad holds W1 = [d1|d2]) — None for every other pad mode
+    db_pad2: Optional[jax.Array]  # (Npad128, Kp)
     dbn_pad: Optional[jax.Array]  # (1, Npad128)
+    # HALF squared norms (+inf on padding rows) for the per-tile champion
+    # scan kernel, whose score is q.db - ||db||^2/2 (one VPU sub per
+    # element); built alongside dbn_pad for both fp32 and bf16 pads.
+    dbnh_pad: Optional[jax.Array]  # (1, Npad128)
     # two-pass scan: per-level feature column mean subtracted from the bf16
     # scan copy AND the queries (distances are shift-invariant; the bf16
     # absolute error ~|q|.|d| is not — centering shrinks it ~10x for these
     # all-positive features).  None for fp32 pads / non-wavefront.
     feat_mean: Optional[jax.Array]  # (Fp,) or None
+    # query-live feature columns (FeatureSpec.query_live_mask nonzeros) —
+    # the ONE derivation shared by the packed-DB lane layout and the
+    # anchor's query packing; only set for pad_mode="packed"
+    live_idx: Optional[jax.Array]  # (L,) int32 or None
     ha: int = field(metadata=dict(static=True))
     wa: int = field(metadata=dict(static=True))
     hb: int = field(metadata=dict(static=True))
@@ -134,9 +154,9 @@ class TpuLevelDB:
     # batched strategy's left-propagation refinement passes (config knob)
     refine_passes: int = field(default=_REFINE_PASSES,
                                metadata=dict(static=True))
-    # wavefront anchor scheme (config.AnalogyParams.match_mode, resolved):
-    # "two_pass" = bf16 top-2 scan + exact fp32 re-score, "exact_hi" =
-    # HIGHEST-precision scan (see make_anchor_fn)
+    # wavefront anchor scheme (config.AnalogyParams.match_mode, RESOLVED
+    # per level — "auto" picks exact_hi2 above the measured DB-size
+    # crossover, exact_hi below; see make_anchor_fn for every mode)
     match_mode: str = field(default="exact_hi", metadata=dict(static=True))
     # mesh for the sharded whole-level step (db_shards > 1); hashable, so a
     # valid static field — synthesize_level dispatches to parallel/step.py
@@ -247,11 +267,11 @@ def _gather_maps_device(h: int, w: int, p: int):
 
 
 @functools.partial(jax.jit, static_argnames=("spec", "pad_tile", "pad_full",
-                                             "pad_bf16"))
+                                             "pad_mode"))
 def _prepare_level_arrays(
     spec, a_src, a_filt, a_src_coarse, a_filt_coarse, a_temporal,
     b_src, b_src_coarse, b_filt_coarse, b_temporal, rowsafe, pad_tile,
-    pad_full=False, pad_bf16=False,
+    pad_full=False, pad_mode="f32",
 ):
     """All device-side level preparation fused into ONE program: eager
     per-op dispatch over the PJRT tunnel costs ~1s/level otherwise.
@@ -259,11 +279,20 @@ def _prepare_level_arrays(
     ``pad_full`` selects which DB the pre-padded argmin tiles score against:
     the rowsafe-masked DB (batched strategy's symmetric metric) or the FULL
     DB (wavefront strategy — the oracle's metric: full A/A' rows vs
-    zero-masked queries).  ``pad_bf16`` stores the pre-padded scan copy in
-    bfloat16 (the two-pass scheme's fast pass: half the HBM stream, one MXU
-    pass); the fp32 ``db`` stays the re-score / coherence source either
-    way, and ``dbn_pad`` keeps the EXACT fp32 row norms so identical rows
-    score identically and ties stay lowest-index."""
+    zero-masked queries).  ``pad_mode`` selects the scan copy's layout:
+
+    - "f32": plain fp32 pre-pad (exact_hi / exact_hi_merged / batched).
+    - "bf16": centered bf16 copy (the approximate scan_rescue/two_pass
+      schemes: half the HBM stream, one MXU pass); ``dbn_pad`` keeps EXACT
+      fp32 row norms so identical rows score identically and ties stay
+      lowest-index.
+    - "packed": the exact_hi2 hi/lo lane-packed bf16 copy — query-LIVE
+      dims only (dead dims reach scores via the norm term exactly),
+      centered on live dims, hi halves in lanes [0, L) and lo residuals in
+      [L, 2L).  One bf16 HBM stream + 2 stacked MXU passes reproduce
+      HIGHEST's exact product set (see make_anchor_fn).
+
+    The fp32 ``db`` stays the re-score / coherence source in every mode."""
     db = build_features_jax(spec, a_src, a_filt, a_src_coarse, a_filt_coarse,
                             temporal_fine=a_temporal)
     static_q = build_features_jax(spec, b_src, None, b_src_coarse,
@@ -285,8 +314,11 @@ def _prepare_level_arrays(
         "static_q": static_q,
         "a_filt_flat": a_filt.reshape(-1),
         "db_pad": None,
+        "db_pad2": None,
         "dbn_pad": None,
+        "dbnh_pad": None,
         "feat_mean": None,
+        "live_idx": None,
     }
     if pad_tile:
         src = db if pad_full else db_rowsafe
@@ -294,20 +326,58 @@ def _prepare_level_arrays(
         n, f = src.shape
         fp = max((f + 127) // 128 * 128, 128)
         npad = (n + pad_tile - 1) // pad_tile * pad_tile
-        if pad_bf16:
+        if pad_mode == "bf16":
             # centered bf16 scan copy + EXACT fp32 norms of the centered
             # rows (identical rows stay identical -> ties stay lowest-index)
             mean = jnp.mean(src, axis=0)
             srcc = src - mean[None, :]
+            nrm = jnp.sum(srcc * srcc, axis=1)
             out["feat_mean"] = jnp.zeros((fp,), _F32).at[:f].set(mean)
             out["db_pad"] = jnp.zeros((npad, fp), jnp.bfloat16).at[
                 :n, :f].set(srcc.astype(jnp.bfloat16))
             out["dbn_pad"] = jnp.full((1, npad), jnp.inf, _F32).at[
-                0, :n].set(jnp.sum(srcc * srcc, axis=1))
+                0, :n].set(nrm)
+        elif pad_mode == "packed":
+            # exact_hi2: live-dim hi/mid/lo lane packing (3-way bf16 split
+            # covers ~24 mantissa bits; the 3-pass kernel's product set ==
+            # jax HIGHEST's bf16_6x — see ops/pallas_match._packed3_kernel).
+            # The shift vector is the live-masked column mean — dead dims
+            # stay RAW (queries are identically zero there, so shifting
+            # them would break the distance-shift invariance); centering
+            # shrinks |q||db| and with it every dropped-term error.
+            live = np.nonzero(spec.query_live_mask())[0]
+            lw = live.size
+            shift = jnp.zeros((f,), _F32).at[live].set(
+                jnp.mean(src[:, live], axis=0))
+            srcc = src - shift[None, :]
+            nrm = jnp.sum(srcc * srcc, axis=1)  # centered-live + raw-dead
+            # bitmask split — the dtype-round-trip split is folded away
+            # under --xla_allow_excess_precision (see bf16_split3)
+            h1, h2, r2 = bf16_split3(srcc[:, live])
+            d1 = h1.astype(jnp.bfloat16)
+            d2 = h2.astype(jnp.bfloat16)
+            d3 = r2.astype(jnp.bfloat16)
+            pk = max((2 * lw + 127) // 128 * 128, 128)
+
+            def pack(left, right):
+                return jnp.zeros((npad, pk), jnp.bfloat16).at[
+                    :n, :lw].set(left).at[:n, lw:2 * lw].set(right)
+
+            out["feat_mean"] = jnp.zeros((fp,), _F32).at[:f].set(shift)
+            out["db_pad"] = pack(d1, d2)
+            out["db_pad2"] = pack(d3, d1)
+            # the EXACT index array the DB lanes were packed by — the
+            # anchor's query packing reuses it, one derivation total
+            out["live_idx"] = jnp.asarray(live, jnp.int32)
         else:
             out["db_pad"] = jnp.zeros((npad, fp), _F32).at[:n, :f].set(src)
             out["dbn_pad"] = jnp.full((1, npad), jnp.inf, _F32).at[
                 0, :n].set(srcn)
+            nrm = None  # f32 pads have no champion-kernel consumer
+        if nrm is not None:
+            # half norms for the champion scan kernels (bf16 / packed only)
+            out["dbnh_pad"] = jnp.full((1, npad), jnp.inf, _F32).at[
+                0, :n].set(0.5 * nrm)
     return out
 
 
@@ -402,8 +472,9 @@ def make_level_template(params, job: LevelJob, strategy: str,
         rowsafe=jnp.asarray(rowsafe), a_filt_flat=z1,
         fine_sqrtw=jnp.asarray(spec.sqrt_weights()[fsl]),
         off=jnp.asarray(off), db_sharded=None, dbn_sharded=None,
-        afilt_sharded=None, diag=diag, db_pad=None, dbn_pad=None,
-        feat_mean=None,
+        afilt_sharded=None, diag=diag, db_pad=None, db_pad2=None,
+        dbn_pad=None,
+        dbnh_pad=None, feat_mean=None, live_idx=None,
         ha=ha, wa=wa, hb=hb, wb=wb, fine_start=fsl.start,
         n_rowsafe=(spec.fine_size // 2) * spec.fine_size,
         strategy=strategy, refine_passes=params.refine_passes,
@@ -434,8 +505,9 @@ def slim_for_mesh(db: TpuLevelDB, keep_sharded: bool = False) -> TpuLevelDB:
                                       afilt_sharded=None, mesh=None)
     return dataclasses.replace(
         db, db=z2, db_sqnorm=z1, db_rowsafe=z2, db_rowsafe_sqnorm=z1,
-        static_q=z2, a_filt_flat=z1, db_pad=None, dbn_pad=None,
-        feat_mean=None, **kw)
+        static_q=z2, a_filt_flat=z1, db_pad=None, db_pad2=None,
+        dbn_pad=None,
+        dbnh_pad=None, feat_mean=None, live_idx=None, **kw)
 
 
 # --------------------------------------------------------------- exact scan
@@ -709,6 +781,34 @@ def _run_batched(db: TpuLevelDB, kappa_mult):
     return batched_scan_core(db, kappa_mult, make_approx_fn(db))
 
 
+# rescue breadth of the scan_rescue anchor: the exact fp32 re-score covers
+# the top-T tile champions by scan score.
+_RESCUE_T = 8
+
+
+def _scan_tile(npad: int, fp: int) -> int:
+    """Tile rows for the per-tile champion scans over an (npad, fp) padded
+    DB: the largest power of two that (a) divides npad, (b) fits the VMEM
+    cap (~half the argmin tile — the fp32 multi-row-block dots must fit
+    scoped VMEM), then halved until the champion set spans >= 16 tiles.
+
+    Divisibility is the hard constraint (`pallas_*_champions` asserts
+    npad % tile == 0): npad is a multiple of the build-time pad tile, which
+    is a multiple of 128 but possibly an ODD multiple (round128 of a small
+    DB), and the VMEM cap for wide packed features (_tile_rows(fp)//2) need
+    not be a power of two — so both are snapped down to powers of two
+    before taking the min, which then always divides npad."""
+    p2_npad = npad & (-npad)  # largest power of 2 dividing npad (>= 256:
+    # build pads are multiples of 256 — _tile_rows and the small-DB round
+    # in build_features both guarantee it)
+    cap = max(_tile_rows(fp) // 2, 256)
+    cap = 1 << (cap.bit_length() - 1)  # snap down to a power of 2
+    tile = min(cap, p2_npad, npad)
+    while npad // tile < 16 and tile >= 256:
+        tile //= 2
+    return tile
+
+
 def make_anchor_fn(db: TpuLevelDB):
     """The wavefront strategy's full-DB anchor: (queries (M,F)) ->
     (p_app (M,) int32, d_app (M,) fp32 EXACT squared distance).
@@ -729,6 +829,85 @@ def make_anchor_fn(db: TpuLevelDB):
 
     The mesh-sharded step never comes here: parallel/step.py builds its own
     anchor over the all-reduced sharded argmin."""
+    if (db.match_mode in ("scan_rescue", "scan_rescue_1p")
+            and db.db_pad is not None
+            and db.db_pad.dtype == jnp.bfloat16):
+        # Per-tile champion scan + top-T rescue (round-3 VERDICT item 1):
+        # ONE minimal-VPU kernel pass emits each DB tile's best (score, row)
+        # under the bf16 centered metric; XLA takes the T best tiles per
+        # query, re-scores those T rows in exact fp32 (elementwise — no
+        # cancellation), and the (distance, index)-lexicographic min wins.
+        # Beats two_pass's global top-2 on BOTH axes: ~2x less VPU
+        # reduction work in the kernel, and a T-deep re-score set that
+        # recovers the true argmin through a much wider scan-error band.
+        q_split = db.match_mode == "scan_rescue"  # _1p: 1-pass probe mode
+        npad, fp = db.db_pad.shape
+        tile = _scan_tile(npad, fp)
+        ntiles = npad // tile
+        t_rescue = min(_RESCUE_T, ntiles)
+        na = db.db.shape[0]
+
+        def anchor(queries):
+            qc = queries - db.feat_mean[None, :queries.shape[1]]
+            vals, idx = pertile_champions_queries(
+                qc, db.db_pad, db.dbnh_pad, tile_n=tile, q_split=q_split)
+            if t_rescue < ntiles:
+                vals, tsel = jax.lax.top_k(vals, t_rescue)
+                cand = jnp.take_along_axis(idx, tsel, axis=1)
+            else:
+                cand = idx
+            # champions of all-padding tiles carry out-of-range rows (score
+            # -inf); clamp to the last real row — it can at worst TIE the
+            # real champion of the final partial tile and then loses the
+            # (d, idx) tie on its larger index.
+            cand = jnp.minimum(cand, na - 1)
+            cf = db.db[cand]  # (M, T, F) fp32 rows
+            d = jnp.sum((cf - queries[:, None, :]) ** 2, axis=-1)
+            bv, bi = d[:, 0], cand[:, 0]
+            for k in range(1, int(cand.shape[1])):
+                better = _lex_lt(d[:, k], cand[:, k], bv, bi)
+                bv = jnp.where(better, d[:, k], bv)
+                bi = jnp.where(better, cand[:, k], bi)
+            return bi.astype(jnp.int32), bv
+
+        return anchor
+
+    if (db.match_mode == "exact_hi2" and db.db_pad is not None
+            and db.db_pad2 is not None and db.dbnh_pad is not None
+            and db.live_idx is not None):
+        # Packed fp32-grade scan (the fast PARITY kernel).  jax HIGHEST on
+        # fp32 operands is bf16_6x — SIX MXU passes (measured: the
+        # per-pass cost fit is 898 = 1x445 + 450 fixed, 3123 = 6x445 + 450
+        # us at M=344/Na=1M, experiments/step_cost_probe.py).  Its product
+        # set over 3-way bf16 splits q = q1+q2+q3, d = d1+d2+d3 keeps the
+        # six products with coefficient > 2^-24.  Only L ~ 55 of the 128
+        # padded lanes are query-LIVE (13 fine-filt positions are
+        # identically zero in every query, the rest is padding), so those
+        # six products fit in THREE stacked K=128 passes against two
+        # packed weight arrays W1 = [d1|d2] (rows [q1|q1], [q2|q2]) and
+        # W2 = [d3|d1] (row [q1|q3]) — 2x fewer passes than HIGHEST over
+        # bf16 streams instead of fp32, at the same score-resolution
+        # class.  Dead dims enter scores exactly via the norm term.
+        live_idx = db.live_idx  # the derivation the DB lanes were packed by
+        npad, pk = db.db_pad.shape
+        tile = _scan_tile(npad, pk)
+        na = db.db.shape[0]
+
+        def anchor(queries):
+            qc = queries - db.feat_mean[None, :queries.shape[1]]
+            g1, g2, gr = bf16_split3(qc[:, live_idx])  # (M, L)
+            q1 = g1.astype(jnp.bfloat16)
+            q2 = g2.astype(jnp.bfloat16)
+            q3 = gr.astype(jnp.bfloat16)
+            vals, idx = packed3_champions(
+                q1, q2, q3, db.db_pad, db.db_pad2, db.dbnh_pad, tile_n=tile)
+            k = jnp.argmax(vals, axis=1)
+            p = jnp.minimum(
+                jnp.take_along_axis(idx, k[:, None], axis=1)[:, 0], na - 1)
+            return p, jnp.sum((db.db[p] - queries) ** 2, axis=1)
+
+        return anchor
+
     if (db.match_mode in ("two_pass", "two_pass_1p")
             and db.db_pad is not None
             and db.db_pad.dtype == jnp.bfloat16):
@@ -895,17 +1074,33 @@ class TpuMatcher(Matcher):
         # single-chip Pallas path.
         mode = self.params.match_mode
         if mode == "auto":
-            # measured on-chip (experiments/two_pass_probe.py): the bf16
-            # scan's ~1e-5 score error lands step-level picks on value-equal
-            # rows (kernel_accuracy_probe: value_mispick 0.0) but the
-            # source-map drift CASCADES through downstream coherence
-            # candidates — end-to-end value_match 0.935 vs the oracle's
-            # 1.0 at 256^2.  Parity requires the HIGHEST scan.
-            mode = "exact_hi"
+            # Per-level choice between the two fp32-grade PARITY scans.
+            # Only fp32-grade holds index-level oracle parity: measured
+            # (experiments/rescue_probe.py), every bf16-resolution scheme
+            # fails — the ~1e-5 scan band holds 5..50 near-tied rows per
+            # fine-level query (separated by ~1e-6, below bf16 resolution,
+            # above fp32-grade's ~7e-7), the picks are value-equal but the
+            # index drift feeds different Ashikhmin candidates downstream
+            # and the synthesis walks away from the oracle (value_match
+            # 0.935 at 256^2).  Between the parity scans: exact_hi2's
+            # 3-pass packed kernel wins on large DBs (1.38x end-to-end at
+            # 1024^2) but carries more per-step fixed cost (query
+            # splitting/packing, champion selection over ~256 tiles), so
+            # small levels stay on the merged HIGHEST kernel — measured
+            # crossover ~1e5 DB rows (256^2 levels: exact_hi faster;
+            # 512^2 level 0: exact_hi2 faster).
+            mode = "exact_hi2" if ha * wa >= 131072 else "exact_hi"
         if sharded:
             mode = "exact_hi"
-        pad_bf16 = (mode in ("two_pass", "two_pass_1p")
-                    and strategy == "wavefront")
+        if strategy != "wavefront":
+            pad_mode = "f32"
+        elif mode == "exact_hi2":
+            pad_mode = "packed"
+        elif mode in ("two_pass", "two_pass_1p", "scan_rescue",
+                      "scan_rescue_1p"):
+            pad_mode = "bf16"
+        else:
+            pad_mode = "f32"
 
         # ONE construction of the query-side maps/schedule/weights for both
         # the sharded and single-chip paths (review round 2: the two paths
@@ -920,8 +1115,10 @@ class TpuMatcher(Matcher):
                 and self.params.data_shards == 1 \
                 and jax.default_backend() == "tpu":
             na = ha * wa
+            # multiple of 256 so _scan_tile always finds a >=256
+            # power-of-2 divisor of the resulting npad
             pad_tile = min(_tile_rows(spec.total),
-                           max((na + 127) // 128 * 128, 128))
+                           max((na + 255) // 256 * 256, 256))
 
         if sharded:
             from image_analogies_tpu.parallel.mesh import make_mesh
@@ -950,7 +1147,7 @@ class TpuMatcher(Matcher):
             to_j(job.a_temporal), to_j(job.b_src),
             to_j(job.b_src_coarse), to_j(job.b_filt_coarse),
             to_j(job.b_temporal), template.rowsafe, pad_tile, pad_full,
-            pad_bf16)
+            pad_mode)
         return dataclasses.replace(
             template,
             db=arrs["db"],
@@ -960,8 +1157,11 @@ class TpuMatcher(Matcher):
             static_q=arrs["static_q"],
             a_filt_flat=arrs["a_filt_flat"],
             db_pad=arrs["db_pad"],
+            db_pad2=arrs["db_pad2"],
             dbn_pad=arrs["dbn_pad"],
-            feat_mean=arrs["feat_mean"])
+            dbnh_pad=arrs["dbnh_pad"],
+            feat_mean=arrs["feat_mean"],
+            live_idx=arrs["live_idx"])
 
     # ------------------------------------------------------------- protocol
 

@@ -82,21 +82,32 @@ class AnalogyParams:
     # How the wavefront strategy's full-DB argmin gets its pick
     # (single-chip Pallas path; the CPU oracle and the XLA fallback are
     # always exact fp32, and the mesh-sharded step scans at HIGHEST):
-    #   "exact_hi" - fp32-grade scores inside the scan kernel (HIGHEST =
-    #                3 bf16 MXU passes), single candidate + exact fp32
-    #                re-score.  The PARITY mode; what "auto" resolves to.
-    #   "two_pass" - fast scan (bf16-resident DB, centered features, hi/lo
-    #                query split) tracking top-2 candidates + exact fp32
-    #                re-score of both.  Measured on-chip: per-step picks
-    #                always land on VALUE-equal rows (~1e-5 score band),
-    #                but source-map drift cascades through downstream
-    #                coherence candidates -> end-to-end value_match ~0.935
-    #                vs oracle (256^2).  NOT a parity mode; kept as the
-    #                measured A/B point (experiments/two_pass_probe.py).
-    #   "two_pass_1p" - two_pass without the query split (1 MXU pass);
-    #                same picks as two_pass in measurement (the DB-side
-    #                truncation dominates).  Experiments only.
-    #   "auto"     - exact_hi.
+    #   "exact_hi2" - the fast PARITY mode: live-dim hi/mid/lo (3-way
+    #                bf16) lane-packed scan computing exactly jax
+    #                HIGHEST's bf16_6x product set (six products with
+    #                coefficient > 2^-24) in THREE stacked K=128 MXU
+    #                passes over two bf16 HBM streams, via the per-tile
+    #                champion kernel (backends/tpu.py make_anchor_fn
+    #                documents the packing).  Same score-resolution class
+    #                as exact_hi at ~2x fewer MXU passes.
+    #   "exact_hi" - fp32-grade scores (HIGHEST = 3 bf16 MXU passes)
+    #                inside the merged top-1 scan kernel + exact fp32
+    #                re-score.  The round-2 parity baseline and the
+    #                sharded path's scan; A/B seam for exact_hi2.
+    #   "scan_rescue" - bf16 per-tile champion scan + exact fp32 re-score
+    #                of the top-8 tile champions.  NOT a parity mode:
+    #                the bf16 band holds 5..50 near-tied (value-equal)
+    #                rows per fine-level query, index drift feeds
+    #                different coherence candidates downstream, and the
+    #                synthesis walks away from the oracle (value_match
+    #                0.935 at 256^2 — experiments/rescue_probe.py).
+    #   "two_pass" - bf16 scan tracking GLOBAL top-2 + exact fp32
+    #                re-score of both.  Same failure mode as scan_rescue,
+    #                shallower rescue; measured A/B point only.
+    #   "scan_rescue_1p" / "two_pass_1p" - single-scan-pass probe variants
+    #                without the hi/lo query split.  Experiments only.
+    #   "auto"     - per level: exact_hi2 when the DB has >= 131072 rows
+    #                (the measured crossover), exact_hi below.
     match_mode: str = "auto"
 
     # Use the cKDTree index for the CPU approximate match (the reference's ANN
@@ -144,9 +155,10 @@ class AnalogyParams:
         if self.strategy not in ("exact", "rowwise", "batched", "wavefront",
                                  "auto"):
             raise ValueError(f"unknown strategy {self.strategy!r}")
-        if self.match_mode not in ("two_pass", "two_pass_1p", "exact_hi",
-                                   "auto"):
-            # two_pass_1p: single-scan-pass probe variant (experiments only)
+        if self.match_mode not in ("scan_rescue", "scan_rescue_1p",
+                                   "two_pass", "two_pass_1p", "exact_hi",
+                                   "exact_hi2", "auto"):
+            # *_1p: single-scan-pass probe variants (experiments only)
             raise ValueError(f"unknown match_mode {self.match_mode!r}")
         if self.level_retries < 0:
             raise ValueError(

@@ -149,6 +149,20 @@ class FeatureSpec:
         """(fine_n,) float32 causal mask for the fine_filt block."""
         return causal_mask(self.fine_size)
 
+    def query_live_mask(self) -> np.ndarray:
+        """(F,) bool: dims that can be NONZERO in a query vector.
+
+        Query vectors zero the non-causal half of the fine_filt block by
+        construction (`written` masks to causal positions that were already
+        synthesized); every other block is fully live (static B features,
+        coarse B' windows, the temporal block).  The TPU backend's packed
+        scan kernel streams only live dims — dead dims reach the score
+        solely through the precomputed ||db||^2 term, EXACTLY (q is zero
+        there), so dropping them from the dot loses nothing."""
+        live = np.ones((self.total,), bool)
+        live[self.fine_filt_slice] = causal_mask(self.fine_size) > 0
+        return live
+
 
 def spec_for_level(params, level: int, levels: int, src_channels: int,
                    temporal: bool = False) -> FeatureSpec:

@@ -51,10 +51,11 @@ def _argmin_kernel(q_ref, db_ref, dbn_ref, idx_out, val_out,
     # Precision matters for fp32 inputs: the TPU MXU multiplies in bf16
     # passes, and the DEFAULT single pass gives ~1e-3 score error — enough to
     # flip argmin picks vs an exact fp32 re-score.  The wavefront (oracle
-    # parity) strategy therefore runs this kernel at HIGHEST (3 bf16 passes,
-    # fp32-grade scores, ~2x wall-clock); the approximate batched strategy
-    # keeps the fast DEFAULT pass.  bf16 inputs are unaffected either way:
-    # their single pass IS the operands' full precision.
+    # parity) strategy therefore runs this kernel at HIGHEST (bf16_6x: six
+    # bf16 passes, fp32-grade ~7e-7 score resolution, measured ~3.5x
+    # wall-clock); the approximate batched strategy keeps the fast DEFAULT
+    # pass.  bf16 inputs are unaffected either way: their single pass IS the
+    # operands' full precision.
     scores = dbn_ref[:] - 2.0 * jax.lax.dot_general(
         q_ref[:], db_ref[:],
         dimension_numbers=(((1,), (1,)), ((), ())),
@@ -78,6 +79,31 @@ def _argmin_kernel(q_ref, db_ref, dbn_ref, idx_out, val_out,
     def _flush():
         idx_out[:] = best_idx[:]
         val_out[:] = best_val[:]
+
+
+def bf16_split2(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Exact hi/lo bf16 decomposition of fp32 ``x`` that XLA cannot fold.
+
+    The naive ``hi = x.astype(bf16); lo = x - hi.astype(f32)`` is UNSAFE
+    under ``--xla_allow_excess_precision=true`` (set by this environment's
+    TPU compile service): XLA may delete the downcast/upcast pair, turning
+    ``lo`` into exact zero and silently degrading every split-based
+    multi-pass scheme to a single bf16 pass (measured round 3: the packed
+    scans all collapsed to 1-pass accuracy).  Masking the low 16 mantissa
+    bits instead produces the TRUNCATED bf16 (bf16 is by definition the
+    top 16 bits of an f32), the subtraction ``x - hi`` is then exact, and
+    bitwise ops are opaque to the precision folder."""
+    u = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    hi = jax.lax.bitcast_convert_type(u & np.uint32(0xFFFF0000), _F32)
+    return hi, x - hi
+
+
+def bf16_split3(x: jax.Array):
+    """(d1, d2, r2): x = d1 + d2 + r2 with d1/d2 exactly bf16-representable
+    fp32 (top-16-bit truncations) and |r2| <= 2^-16 |x|; see bf16_split2."""
+    d1, r1 = bf16_split2(x)
+    d2, r2 = bf16_split2(r1)
+    return d1, d2, r2
 
 
 def _lex_lt(va, ia, vb, ib):
@@ -194,10 +220,9 @@ def pallas_argmin2_l2_prepadded(
     tile_n = min(tile_n, npad)
     assert npad % tile_n == 0, (npad, tile_n)
     if q_split:
-        qf = q.astype(_F32)
-        qh = qf.astype(jnp.bfloat16)
-        ql = (qf - qh.astype(_F32)).astype(jnp.bfloat16)
-        q = jnp.concatenate([qh, ql], axis=0)  # (2Mp, Fp)
+        hi, lo = bf16_split2(q.astype(_F32))  # XLA-folding-safe split
+        q = jnp.concatenate([hi.astype(jnp.bfloat16),
+                             lo.astype(jnp.bfloat16)], axis=0)  # (2Mp, Fp)
     elif q.dtype != dbp.dtype:
         q = q.astype(dbp.dtype)
     qm = q.shape[0]
@@ -258,6 +283,241 @@ def prepadded_argmin2_queries(queries, dbp, dbn, *, tile_n: int,
         qp, dbp, dbn, tile_n=min(tile_n, dbp.shape[0]), precision=precision,
         q_split=q_split)
     return i1[:m], i2[:m], jnp.isfinite(v2[:m])
+
+
+def _pertile_kernel(q_ref, db_ref, dbnh_ref, val_out, idx_out, *,
+                    precision, fold2: bool):
+    """Per-tile champion kernel — the VPU-minimal scan pass.
+
+    The top-1/top-2 kernels spend more time in VPU reductions than in MXU
+    passes (measured: top-1 HIGHEST 5.2 ms vs a 1.34 ms 3-pass MXU roofline
+    at M=344, Na=1M — experiments/step_cost_probe.py): iota masking, the
+    running-scratch merge, and argmin cascades all cost full passes over the
+    (M, tile_n) scores.  This kernel strips the per-element work to the
+    minimum:
+
+        s2[m, n] = q[m] . db[n] - 0.5 ||db[n]||^2     (one fused sub)
+        val[m]   = max_n s2                           (bigger s2 = smaller
+        idx[m]   = argmax_n s2  (+ tile offset)        L2 distance)
+
+    and writes each tile's champion straight to its own output column — no
+    cross-tile scratch, no merge, no padding mask (padding rows carry
+    ``dbnh = +inf`` so s2 = -inf loses every max).  Cross-tile selection,
+    re-scoring, and tie-breaking happen OUTSIDE in XLA (backends/tpu.py
+    `make_anchor_fn`): take the top-T tile champions by scan score, re-score
+    those rows in exact fp32, pick the (distance, index)-lexicographic min.
+
+    In-tile ties: ``jnp.argmax`` returns the first occurrence, so bf16-equal
+    scores (identical rows quantize identically) keep lowest-index-first.
+    """
+    t = pl.program_id(0)
+    dots = jax.lax.dot_general(
+        q_ref[:], db_ref[:],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=_F32,
+        precision=precision,
+    )
+    if fold2:  # (2M, TILE_N): two row-blocks per query, dots summed in fp32
+        m = dots.shape[0] // 2
+        dots = dots[:m] + dots[m:]
+    s2 = dots - dbnh_ref[:]
+    # the (ntiles, M) outputs stay VMEM-resident across the sequential grid;
+    # each tile stores its champion ROW at its own (dynamic) sublane offset
+    # — Mosaic supports dynamic sublane stores but not dynamic LANE-column
+    # stores, hence the tile-major layout (callers transpose, it's tiny)
+    val_out[pl.dslice(t, 1), :] = jnp.max(s2, axis=1)[None, :]
+    idx_out[pl.dslice(t, 1), :] = (
+        jnp.argmax(s2, axis=1).astype(jnp.int32)[None, :]
+        + t * s2.shape[1])
+
+
+@functools.partial(jax.jit, static_argnames=("tile_n", "interpret",
+                                             "precision", "q_split"))
+def pallas_pertile_champions(
+    q: jax.Array,  # (Mp, Fp) tile-aligned, fp32 or bf16
+    dbp: jax.Array,  # (Npad, Fp) tile-aligned (zero feature padding)
+    dbnh: jax.Array,  # (1, Npad) fp32 HALF squared norms, +inf on padding
+    *,
+    tile_n: int,
+    interpret: bool = False,
+    precision=jax.lax.Precision.DEFAULT,
+    q_split: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-tile champion entry: returns (vals (ntiles, Mp) fp32 scan scores
+    s2 = q.db - ||db||^2/2 [bigger = closer], idx (ntiles, Mp) int32 global
+    row of each tile's best) in TILE-MAJOR layout (see `_pertile_kernel` on
+    why).  See `pertile_champions_queries` for the (M, ntiles) wrapper."""
+    npad = dbp.shape[0]
+    tile_n = min(tile_n, npad)
+    assert npad % tile_n == 0, (npad, tile_n)
+    if q_split:
+        hi, lo = bf16_split2(q.astype(_F32))  # XLA-folding-safe split
+        q = jnp.concatenate([hi.astype(jnp.bfloat16),
+                             lo.astype(jnp.bfloat16)], axis=0)  # (2Mp, Fp)
+    elif q.dtype != dbp.dtype:
+        q = q.astype(dbp.dtype)
+    qm, fp = q.shape
+    mp = qm // 2 if q_split else qm
+
+    grid = npad // tile_n
+    kernel = functools.partial(_pertile_kernel, precision=precision,
+                               fold2=q_split)
+    vals, idx = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((qm, fp), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_n, fp), lambda t: (t, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile_n), lambda t: (0, t),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((grid, mp), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((grid, mp), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((grid, mp), _F32),
+            jax.ShapeDtypeStruct((grid, mp), jnp.int32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * qm * fp * npad,
+            bytes_accessed=npad * fp * dbp.dtype.itemsize
+            + qm * fp * q.dtype.itemsize + mp * grid * 8,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(q, dbp, dbnh)
+    return vals, idx
+
+
+def pertile_champions_queries(queries, dbp, dbnh, *, tile_n: int,
+                              precision=jax.lax.Precision.DEFAULT,
+                              q_split: bool = False,
+                              interpret: bool = False):
+    """Raw-query wrapper for `pallas_pertile_champions`: lane-pad + row-align
+    the (M, F) fp32 queries, run the kernel, return (vals (M, ntiles),
+    idx (M, ntiles)).  Scores are scan-space (q.db - ||db||^2/2, BIGGER =
+    closer); callers re-score candidates in exact fp32 anyway."""
+    m, f = queries.shape
+    fp = dbp.shape[1]
+    mp = _round_up(max(m, 8), 16 if dbp.dtype == jnp.bfloat16 else 8)
+    qp = jnp.zeros((mp, fp), queries.dtype).at[:m, :f].set(queries)
+    vals, idx = pallas_pertile_champions(
+        qp, dbp, dbnh, tile_n=min(tile_n, dbp.shape[0]), precision=precision,
+        q_split=q_split, interpret=interpret)
+    return vals.T[:m], idx.T[:m]
+
+
+def _packed3_kernel(qa_ref, qc_ref, w1_ref, w2_ref, dbnh_ref, val_out,
+                    idx_out):
+    """Per-tile champion kernel for the 3-pass packed fp32-grade scan.
+
+    ``qa_ref`` (2M, K) holds row-blocks A = [q1|q1] and B = [q2|q2] dotted
+    against W1 = [d1|d2]; ``qc_ref`` (M, K) holds C = [q1|q3] dotted
+    against W2 = [d3|d1].  Summing the three dot rows per query yields
+
+        q1.d1 + (q1.d2 + q2.d1) + (q1.d3 + q2.d2 + q3.d1)
+
+    — exactly the bf16_6x (jax HIGHEST) product set, whose dropped terms
+    carry coefficients <= 2^-24.  Three K=128 MXU passes instead of
+    HIGHEST's six, over bf16 streams instead of fp32, because only the
+    L ~ 55 query-LIVE dims are packed (see FeatureSpec.query_live_mask);
+    dead dims reach scores exactly via the precomputed half-norm term."""
+    t = pl.program_id(0)
+    dots_a = jax.lax.dot_general(
+        qa_ref[:], w1_ref[:],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=_F32)
+    dots_c = jax.lax.dot_general(
+        qc_ref[:], w2_ref[:],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=_F32)
+    m = dots_c.shape[0]
+    s2 = dots_a[:m] + dots_a[m:] + dots_c - dbnh_ref[:]
+    val_out[pl.dslice(t, 1), :] = jnp.max(s2, axis=1)[None, :]
+    idx_out[pl.dslice(t, 1), :] = (
+        jnp.argmax(s2, axis=1).astype(jnp.int32)[None, :]
+        + t * s2.shape[1])
+
+
+@functools.partial(jax.jit, static_argnames=("tile_n", "interpret"))
+def pallas_packed3_champions(
+    qa: jax.Array,  # (2Mp, Kp) bf16: row-blocks [A; B]
+    qc: jax.Array,  # (Mp, Kp) bf16: row-block C
+    w1: jax.Array,  # (Npad, Kp) bf16: [d1 | d2]
+    w2: jax.Array,  # (Npad, Kp) bf16: [d3 | d1]
+    dbnh: jax.Array,  # (1, Npad) fp32 half norms, +inf on padding
+    *,
+    tile_n: int,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Entry for `_packed3_kernel`; returns tile-major (ntiles, Mp) pairs."""
+    mp, kp = qc.shape
+    npad = w1.shape[0]
+    tile_n = min(tile_n, npad)
+    assert npad % tile_n == 0, (npad, tile_n)
+    assert qa.shape == (2 * mp, kp), (qa.shape, qc.shape)
+    grid = npad // tile_n
+    vals, idx = pl.pallas_call(
+        _packed3_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((2 * mp, kp), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((mp, kp), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_n, kp), lambda t: (t, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_n, kp), lambda t: (t, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile_n), lambda t: (0, t),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((grid, mp), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((grid, mp), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((grid, mp), _F32),
+            jax.ShapeDtypeStruct((grid, mp), jnp.int32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * 3 * mp * kp * npad,
+            bytes_accessed=2 * npad * kp * 2 + 3 * mp * kp * 2
+            + mp * grid * 8,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(qa, qc, w1, w2, dbnh)
+    return vals, idx
+
+
+def packed3_champions(q1, q2, q3, w1, w2, dbnh, *, tile_n: int,
+                      interpret: bool = False):
+    """Raw wrapper for the 3-pass packed scan: ``q1``/``q2``/``q3`` are the
+    (M, L) bf16 hi/mid/lo query splits on LIVE dims (q = q1+q2+q3 to
+    ~2^-24); builds the packed row-blocks, runs the kernel, returns
+    (vals (M, ntiles), idx (M, ntiles))."""
+    m, l = q1.shape
+    kp = w1.shape[1]
+    mp = _round_up(max(m, 8), 16)
+    z = jnp.zeros((mp, kp), jnp.bfloat16)
+
+    def pack(left, right):
+        return z.at[:m, :l].set(left).at[:m, l:2 * l].set(right)
+
+    qa = jnp.concatenate([pack(q1, q1), pack(q2, q2)], axis=0)
+    qc = pack(q1, q3)
+    vals, idx = pallas_packed3_champions(
+        qa, qc, w1, w2, dbnh, tile_n=min(tile_n, w1.shape[0]),
+        interpret=interpret)
+    return vals.T[:m], idx.T[:m]
 
 
 @functools.partial(jax.jit, static_argnames=("tile_n", "interpret", "bf16",
