@@ -91,10 +91,16 @@ def main() -> int:
     na, f = db.shape
     a_filt_flat = a_filt_pyr[lv].reshape(-1).astype(np.float32)
 
-    # production pad/tile geometry (backends/tpu.py build_features)
-    pad_tile = min(8192, max((na + 255) // 256 * 256, 256))
+    # production pad/tile geometry (backends/tpu.py build_features): the
+    # build pad tile caps at _tile_rows(spec.total) and the scan tile is
+    # chosen from the PADDED feature width, exactly like the backend
+    from image_analogies_tpu.backends.tpu import _tile_rows
+
+    fp = max((f + 127) // 128 * 128, 128)
+    pad_tile = min(_tile_rows(spec.total),
+                   max((na + 255) // 256 * 256, 256))
     npad = (na + pad_tile - 1) // pad_tile * pad_tile
-    tile = _scan_tile(npad, 128)
+    tile = _scan_tile(npad, fp)
     ntiles = npad // tile
 
     dbj = jnp.asarray(db)

@@ -63,6 +63,7 @@ from image_analogies_tpu.ops.pallas_match import (
     _round_up,
     argmin_l2,
     bf16_split3,
+    packed2_champions,
     packed3_champions,
     pertile_champions_queries,
     prepadded_argmin2_queries,
@@ -337,7 +338,7 @@ def _prepare_level_arrays(
                 :n, :f].set(srcc.astype(jnp.bfloat16))
             out["dbn_pad"] = jnp.full((1, npad), jnp.inf, _F32).at[
                 0, :n].set(nrm)
-        elif pad_mode == "packed":
+        elif pad_mode in ("packed", "packed2"):
             # exact_hi2: live-dim hi/mid/lo lane packing (3-way bf16 split
             # covers ~24 mantissa bits; the 3-pass kernel's product set ==
             # jax HIGHEST's bf16_6x — see ops/pallas_match._packed3_kernel).
@@ -365,7 +366,10 @@ def _prepare_level_arrays(
 
             out["feat_mean"] = jnp.zeros((fp,), _F32).at[:f].set(shift)
             out["db_pad"] = pack(d1, d2)
-            out["db_pad2"] = pack(d3, d1)
+            # packed (exact_hi2, 3 passes): W2 = [d3|d1];
+            # packed2 (exact_hi2_2p, 2 passes): W2 = [d1|d3]
+            out["db_pad2"] = (pack(d3, d1) if pad_mode == "packed"
+                              else pack(d1, d3))
             # the EXACT index array the DB lanes were packed by — the
             # anchor's query packing reuses it, one derivation total
             out["live_idx"] = jnp.asarray(live, jnp.int32)
@@ -872,7 +876,8 @@ def make_anchor_fn(db: TpuLevelDB):
 
         return anchor
 
-    if (db.match_mode == "exact_hi2" and db.db_pad is not None
+    if (db.match_mode in ("exact_hi2", "exact_hi2_2p")
+            and db.db_pad is not None
             and db.db_pad2 is not None and db.dbnh_pad is not None
             and db.live_idx is not None):
         # Packed fp32-grade scan (the fast PARITY kernel).  jax HIGHEST on
@@ -888,19 +893,30 @@ def make_anchor_fn(db: TpuLevelDB):
         # W2 = [d3|d1] (row [q1|q3]) — 2x fewer passes than HIGHEST over
         # bf16 streams instead of fp32, at the same score-resolution
         # class.  Dead dims enter scores exactly via the norm term.
+        #
+        # exact_hi2_2p drops the set's two smallest members (q2.d2, q3.d1,
+        # both ~2^-16 coefficient): rows [q1|q1].W1 + [q2|q1].[d1|d3] — 2
+        # passes.  Its per-decision index drift vs HIGHEST is ~2x
+        # exact_hi2's (8.6% vs 4.0% at 512^2 level 0, ALL value-equal
+        # near-ties), end-to-end parity evidence in BENCH_r03.
         live_idx = db.live_idx  # the derivation the DB lanes were packed by
         npad, pk = db.db_pad.shape
         tile = _scan_tile(npad, pk)
         na = db.db.shape[0]
+        two_pass = db.match_mode == "exact_hi2_2p"
 
         def anchor(queries):
             qc = queries - db.feat_mean[None, :queries.shape[1]]
             g1, g2, gr = bf16_split3(qc[:, live_idx])  # (M, L)
             q1 = g1.astype(jnp.bfloat16)
             q2 = g2.astype(jnp.bfloat16)
-            q3 = gr.astype(jnp.bfloat16)
-            vals, idx = packed3_champions(
-                q1, q2, q3, db.db_pad, db.db_pad2, db.dbnh_pad, tile_n=tile)
+            if two_pass:
+                vals, idx = packed2_champions(
+                    q1, q2, db.db_pad, db.db_pad2, db.dbnh_pad, tile_n=tile)
+            else:
+                vals, idx = packed3_champions(
+                    q1, q2, gr.astype(jnp.bfloat16), db.db_pad, db.db_pad2,
+                    db.dbnh_pad, tile_n=tile)
             k = jnp.argmax(vals, axis=1)
             p = jnp.minimum(
                 jnp.take_along_axis(idx, k[:, None], axis=1)[:, 0], na - 1)
@@ -1088,14 +1104,21 @@ class TpuMatcher(Matcher):
             # splitting/packing, champion selection over ~256 tiles), so
             # small levels stay on the merged HIGHEST kernel — measured
             # crossover ~1e5 DB rows (256^2 levels: exact_hi faster;
-            # 512^2 level 0: exact_hi2 faster).
-            mode = "exact_hi2" if ha * wa >= 131072 else "exact_hi"
+            # 512^2 level 0: packed faster).  Large levels use the 2-pass
+            # variant: its only delta vs exact_hi2 is dropping the two
+            # ~2^-16-coefficient products, and the oracle audit stays
+            # fully tie-explained (256^2: explained=1.0, unexplained=0,
+            # max band 6.3e-7; 1024^2 evidence in BENCH_r03) at ~1.2x
+            # less wall-clock.
+            mode = "exact_hi2_2p" if ha * wa >= 131072 else "exact_hi"
         if sharded:
             mode = "exact_hi"
         if strategy != "wavefront":
             pad_mode = "f32"
         elif mode == "exact_hi2":
             pad_mode = "packed"
+        elif mode == "exact_hi2_2p":
+            pad_mode = "packed2"
         elif mode in ("two_pass", "two_pass_1p", "scan_rescue",
                       "scan_rescue_1p"):
             pad_mode = "bf16"

@@ -48,13 +48,13 @@ def _add_engine_flags(p: argparse.ArgumentParser) -> None:
                         "sequential VALIDATION seams, ~100-1000x slower — "
                         "never for production runs")
     p.add_argument("--match-mode",
-                   choices=("auto", "exact_hi", "exact_hi2",
+                   choices=("auto", "exact_hi", "exact_hi2", "exact_hi2_2p",
                             "scan_rescue", "scan_rescue_1p",
                             "two_pass", "two_pass_1p"),
                    default=None,
                    help="wavefront anchor scheme (auto = the parity "
-                        "hybrid: exact_hi2's packed fp32-grade scan on "
-                        "large levels, exact_hi's merged kernel below "
+                        "hybrid: exact_hi2_2p's packed fp32-grade scan "
+                        "on large levels, exact_hi's merged kernel below "
                         "the measured crossover; scan_rescue/two_pass* "
                         "are approximate A/B points — see "
                         "config.AnalogyParams)")

@@ -82,14 +82,19 @@ class AnalogyParams:
     # How the wavefront strategy's full-DB argmin gets its pick
     # (single-chip Pallas path; the CPU oracle and the XLA fallback are
     # always exact fp32, and the mesh-sharded step scans at HIGHEST):
-    #   "exact_hi2" - the fast PARITY mode: live-dim hi/mid/lo (3-way
-    #                bf16) lane-packed scan computing exactly jax
-    #                HIGHEST's bf16_6x product set (six products with
-    #                coefficient > 2^-24) in THREE stacked K=128 MXU
-    #                passes over two bf16 HBM streams, via the per-tile
-    #                champion kernel (backends/tpu.py make_anchor_fn
-    #                documents the packing).  Same score-resolution class
-    #                as exact_hi at ~2x fewer MXU passes.
+    #   "exact_hi2_2p" - the fast PARITY mode (auto's large-level pick):
+    #                live-dim hi/mid bf16 lane-packed scan computing the
+    #                four largest bf16_6x products (q1d1 + q1d2 + q2d1 +
+    #                q1d3) in TWO stacked K=128 MXU passes over two bf16
+    #                HBM streams.  The dropped ~2^-16-coefficient terms
+    #                stay inside the tie-audit's fp-resolution band
+    #                (explained=1.0, max band 6.3e-7 at 256^2; 1024^2
+    #                evidence in BENCH_r03).
+    #   "exact_hi2" - the conservative packed mode: full bf16_6x product
+    #                set (every term with coefficient > 2^-24) in THREE
+    #                stacked passes — exactly jax HIGHEST's resolution,
+    #                ~1.2x slower than exact_hi2_2p (backends/tpu.py
+    #                make_anchor_fn documents both packings).
     #   "exact_hi" - fp32-grade scores (HIGHEST = 3 bf16 MXU passes)
     #                inside the merged top-1 scan kernel + exact fp32
     #                re-score.  The round-2 parity baseline and the
@@ -106,8 +111,8 @@ class AnalogyParams:
     #                shallower rescue; measured A/B point only.
     #   "scan_rescue_1p" / "two_pass_1p" - single-scan-pass probe variants
     #                without the hi/lo query split.  Experiments only.
-    #   "auto"     - per level: exact_hi2 when the DB has >= 131072 rows
-    #                (the measured crossover), exact_hi below.
+    #   "auto"     - per level: exact_hi2_2p when the DB has >= 131072
+    #                rows (the measured crossover), exact_hi below.
     match_mode: str = "auto"
 
     # Use the cKDTree index for the CPU approximate match (the reference's ANN
@@ -157,7 +162,7 @@ class AnalogyParams:
             raise ValueError(f"unknown strategy {self.strategy!r}")
         if self.match_mode not in ("scan_rescue", "scan_rescue_1p",
                                    "two_pass", "two_pass_1p", "exact_hi",
-                                   "exact_hi2", "auto"):
+                                   "exact_hi2", "exact_hi2_2p", "auto"):
             # *_1p: single-scan-pass probe variants (experiments only)
             raise ValueError(f"unknown match_mode {self.match_mode!r}")
         if self.level_retries < 0:

@@ -412,61 +412,73 @@ def pertile_champions_queries(queries, dbp, dbnh, *, tile_n: int,
     return vals.T[:m], idx.T[:m]
 
 
-def _packed3_kernel(qa_ref, qc_ref, w1_ref, w2_ref, dbnh_ref, val_out,
-                    idx_out):
-    """Per-tile champion kernel for the 3-pass packed fp32-grade scan.
+def _packed_kernel(qa_ref, qb_ref, w1_ref, w2_ref, dbnh_ref, val_out,
+                   idx_out, *, fold_a: bool):
+    """Per-tile champion kernel for the packed fp32-grade scans.
 
-    ``qa_ref`` (2M, K) holds row-blocks A = [q1|q1] and B = [q2|q2] dotted
-    against W1 = [d1|d2]; ``qc_ref`` (M, K) holds C = [q1|q3] dotted
-    against W2 = [d3|d1].  Summing the three dot rows per query yields
+    ``qa_ref`` row-blocks dot against W1 and ``qb_ref`` against W2; with
+    ``fold_a`` qa is (2M, K) and its two row-blocks are summed.  The two
+    lane packings served (backends/tpu.py make_anchor_fn):
 
-        q1.d1 + (q1.d2 + q2.d1) + (q1.d3 + q2.d2 + q3.d1)
+    - 3-pass (exact_hi2): qa = [[q1|q1]; [q2|q2]] . W1=[d1|d2],
+      qb = [q1|q3] . W2=[d3|d1] — sums to  q1.d1 + (q1.d2 + q2.d1) +
+      (q1.d3 + q2.d2 + q3.d1), exactly the bf16_6x (jax HIGHEST) product
+      set; dropped terms carry coefficients <= 2^-24.
+    - 2-pass (exact_hi2_2p): qa = [q1|q1] . W1=[d1|d2],
+      qb = [q2|q1] . W2=[d1|d3] — the same set minus its two smallest
+      members (q2.d2, q3.d1, both ~2^-16 coefficient); with live-dim
+      centering the dropped mass is ~1e-6 absolute on real features,
+      inside the tie-audit's fp-resolution band (BENCH_r03).
 
-    — exactly the bf16_6x (jax HIGHEST) product set, whose dropped terms
-    carry coefficients <= 2^-24.  Three K=128 MXU passes instead of
-    HIGHEST's six, over bf16 streams instead of fp32, because only the
-    L ~ 55 query-LIVE dims are packed (see FeatureSpec.query_live_mask);
-    dead dims reach scores exactly via the precomputed half-norm term."""
+    K=128 passes over bf16 streams instead of HIGHEST's six fp32-stream
+    passes, because only the L ~ 55 query-LIVE dims are packed (see
+    FeatureSpec.query_live_mask); dead dims reach scores exactly via the
+    precomputed half-norm term."""
     t = pl.program_id(0)
     dots_a = jax.lax.dot_general(
         qa_ref[:], w1_ref[:],
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=_F32)
-    dots_c = jax.lax.dot_general(
-        qc_ref[:], w2_ref[:],
+    dots_b = jax.lax.dot_general(
+        qb_ref[:], w2_ref[:],
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=_F32)
-    m = dots_c.shape[0]
-    s2 = dots_a[:m] + dots_a[m:] + dots_c - dbnh_ref[:]
+    if fold_a:
+        m = dots_a.shape[0] // 2
+        dots_a = dots_a[:m] + dots_a[m:]
+    s2 = dots_a + dots_b - dbnh_ref[:]
     val_out[pl.dslice(t, 1), :] = jnp.max(s2, axis=1)[None, :]
     idx_out[pl.dslice(t, 1), :] = (
         jnp.argmax(s2, axis=1).astype(jnp.int32)[None, :]
         + t * s2.shape[1])
 
 
-@functools.partial(jax.jit, static_argnames=("tile_n", "interpret"))
-def pallas_packed3_champions(
-    qa: jax.Array,  # (2Mp, Kp) bf16: row-blocks [A; B]
-    qc: jax.Array,  # (Mp, Kp) bf16: row-block C
-    w1: jax.Array,  # (Npad, Kp) bf16: [d1 | d2]
-    w2: jax.Array,  # (Npad, Kp) bf16: [d3 | d1]
+@functools.partial(jax.jit, static_argnames=("tile_n", "fold_a", "interpret"))
+def pallas_packed_champions(
+    qa: jax.Array,  # (Mp or 2Mp, Kp) bf16 row-blocks against W1
+    qb: jax.Array,  # (Mp, Kp) bf16 row-block against W2
+    w1: jax.Array,  # (Npad, Kp) bf16
+    w2: jax.Array,  # (Npad, Kp) bf16
     dbnh: jax.Array,  # (1, Npad) fp32 half norms, +inf on padding
     *,
     tile_n: int,
+    fold_a: bool,
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Entry for `_packed3_kernel`; returns tile-major (ntiles, Mp) pairs."""
-    mp, kp = qc.shape
+    """Entry for `_packed_kernel`; returns tile-major (ntiles, Mp) pairs."""
+    mp, kp = qb.shape
     npad = w1.shape[0]
     tile_n = min(tile_n, npad)
     assert npad % tile_n == 0, (npad, tile_n)
-    assert qa.shape == (2 * mp, kp), (qa.shape, qc.shape)
+    assert qa.shape == ((2 * mp if fold_a else mp), kp), (qa.shape, qb.shape)
+    qm = qa.shape[0]
     grid = npad // tile_n
+    passes = (2 if fold_a else 1) + 1
     vals, idx = pl.pallas_call(
-        _packed3_kernel,
+        functools.partial(_packed_kernel, fold_a=fold_a),
         grid=(grid,),
         in_specs=[
-            pl.BlockSpec((2 * mp, kp), lambda t: (0, 0),
+            pl.BlockSpec((qm, kp), lambda t: (0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((mp, kp), lambda t: (0, 0),
                          memory_space=pltpu.VMEM),
@@ -488,35 +500,54 @@ def pallas_packed3_champions(
             jax.ShapeDtypeStruct((grid, mp), jnp.int32),
         ],
         cost_estimate=pl.CostEstimate(
-            flops=2 * 3 * mp * kp * npad,
-            bytes_accessed=2 * npad * kp * 2 + 3 * mp * kp * 2
+            flops=2 * passes * mp * kp * npad,
+            bytes_accessed=2 * npad * kp * 2 + (qm + mp) * kp * 2
             + mp * grid * 8,
             transcendentals=0,
         ),
         interpret=interpret,
-    )(qa, qc, w1, w2, dbnh)
+    )(qa, qb, w1, w2, dbnh)
     return vals, idx
+
+
+def _pack_rows(left, right, m, l, kp):
+    z = jnp.zeros((m, kp), jnp.bfloat16)
+    return z.at[:, :l].set(left).at[:, l:2 * l].set(right)
+
+
+def packed2_champions(q1, q2, w1, w2, dbnh, *, tile_n: int,
+                      interpret: bool = False):
+    """Raw wrapper for the 2-pass packed scan: ``q1``/``q2`` are the (M, L)
+    bf16 hi/mid query splits on LIVE dims; W1 = [d1|d2], W2 = [d1|d3].
+    Returns (vals (M, ntiles), idx (M, ntiles))."""
+    m, l = q1.shape
+    kp = w1.shape[1]
+    mp = _round_up(max(m, 8), 16)
+    pad = lambda x: jnp.zeros((mp, l), jnp.bfloat16).at[:m].set(x)
+    q1, q2 = pad(q1), pad(q2)
+    vals, idx = pallas_packed_champions(
+        _pack_rows(q1, q1, mp, l, kp), _pack_rows(q2, q1, mp, l, kp),
+        w1, w2, dbnh, tile_n=min(tile_n, w1.shape[0]), fold_a=False,
+        interpret=interpret)
+    return vals.T[:m], idx.T[:m]
 
 
 def packed3_champions(q1, q2, q3, w1, w2, dbnh, *, tile_n: int,
                       interpret: bool = False):
     """Raw wrapper for the 3-pass packed scan: ``q1``/``q2``/``q3`` are the
     (M, L) bf16 hi/mid/lo query splits on LIVE dims (q = q1+q2+q3 to
-    ~2^-24); builds the packed row-blocks, runs the kernel, returns
-    (vals (M, ntiles), idx (M, ntiles))."""
+    ~2^-24); W1 = [d1|d2], W2 = [d3|d1].  Returns (vals (M, ntiles),
+    idx (M, ntiles))."""
     m, l = q1.shape
     kp = w1.shape[1]
     mp = _round_up(max(m, 8), 16)
-    z = jnp.zeros((mp, kp), jnp.bfloat16)
-
-    def pack(left, right):
-        return z.at[:m, :l].set(left).at[:m, l:2 * l].set(right)
-
-    qa = jnp.concatenate([pack(q1, q1), pack(q2, q2)], axis=0)
-    qc = pack(q1, q3)
-    vals, idx = pallas_packed3_champions(
-        qa, qc, w1, w2, dbnh, tile_n=min(tile_n, w1.shape[0]),
-        interpret=interpret)
+    pad = lambda x: jnp.zeros((mp, l), jnp.bfloat16).at[:m].set(x)
+    q1, q2, q3 = pad(q1), pad(q2), pad(q3)
+    qa = jnp.concatenate([_pack_rows(q1, q1, mp, l, kp),
+                          _pack_rows(q2, q2, mp, l, kp)], axis=0)
+    vals, idx = pallas_packed_champions(
+        qa, _pack_rows(q1, q3, mp, l, kp), w1, w2, dbnh,
+        tile_n=min(tile_n, w1.shape[0]), fold_a=True, interpret=interpret)
     return vals.T[:m], idx.T[:m]
 
 

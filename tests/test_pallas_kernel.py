@@ -400,3 +400,53 @@ def test_exact_hi2_level_build_and_anchor_shapes():
             tile = _scan_tile(npad, pk)
             assert npad % tile == 0, (na, npad, tile)
             assert tile >= 128  # the halving loop may stop one below 256
+
+
+def test_packed2_reproduces_fourterm_product_set():
+    # the 2-pass packed scan (auto's large-level default) must match the
+    # explicit 4-product NumPy sum q1d1 + q1d2 + q2d1 + q1d3 with
+    # W1=[d1|d2], W2=[d1|d3] — the lane arrangement differs from packed3's
+    # W2=[d3|d1], exactly the asymmetry this test pins down
+    from image_analogies_tpu.ops.pallas_match import (
+        bf16_split3,
+        packed2_champions,
+    )
+
+    rng = np.random.default_rng(9)
+    n, L, m, tile, npad, pk = 700, 55, 17, 128, 1024, 128
+    x = rng.standard_normal((n, L)).astype(np.float32)
+    x[300] = x[100]
+    q = rng.standard_normal((m, L)).astype(np.float32)
+    q[3] = x[100]
+
+    def np_split3(a):
+        d1, d2, r2 = (np.asarray(v) for v in bf16_split3(jnp.asarray(a)))
+        return (d1, d2,
+                np.asarray(jnp.asarray(r2, jnp.bfloat16), np.float32))
+
+    d1, d2, d3 = np_split3(x)
+    q1, q2, _ = np_split3(q)
+
+    def pack(left, right):
+        w = jnp.zeros((npad, pk), jnp.bfloat16)
+        return w.at[:n, :L].set(jnp.asarray(left, jnp.bfloat16)).at[
+            :n, L:2 * L].set(jnp.asarray(right, jnp.bfloat16))
+
+    nrm = (x ** 2).sum(1)
+    dbnh = jnp.full((1, npad), jnp.inf, jnp.float32).at[0, :n].set(0.5 * nrm)
+    vals, idx = packed2_champions(
+        jnp.asarray(q1, jnp.bfloat16), jnp.asarray(q2, jnp.bfloat16),
+        pack(d1, d2), pack(d1, d3), dbnh, tile_n=tile, interpret=True)
+    vals, idx = np.asarray(vals), np.asarray(idx)
+    dots = q1 @ d1.T + q1 @ d2.T + q2 @ d1.T + q1 @ d3.T
+    s2 = dots - 0.5 * nrm[None, :]
+    for t in range(npad // tile):
+        sl = slice(t * tile, min((t + 1) * tile, n))
+        if sl.start >= n:
+            continue
+        np.testing.assert_allclose(s2[:, sl].max(1), vals[:, t], atol=2e-5)
+        np.testing.assert_array_equal(s2[:, sl].argmax(1) + t * tile,
+                                      idx[:, t])
+    # exact-hit duplicate pair resolves lowest-index after champion argmax
+    pick = idx[np.arange(m), vals.argmax(1)]
+    assert pick[3] == 100
