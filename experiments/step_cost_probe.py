@@ -29,7 +29,7 @@ from image_analogies_tpu.ops.pallas_match import (
     bf16_split3,
     pallas_argmin2_l2_prepadded,
     pallas_argmin_l2_prepadded,
-    pallas_packed3_champions,
+    pallas_packed_champions,
     pallas_pertile_champions,
 )
 
@@ -43,8 +43,8 @@ def _packed3(q, db16, dn, tile):
     qa = jnp.concatenate([g1.astype(jnp.bfloat16),
                           g2.astype(jnp.bfloat16)], axis=0)
     qc = gr.astype(jnp.bfloat16)
-    return pallas_packed3_champions(qa, qc, db16, db16, dn,
-                                    tile_n=tile)[1][0]
+    return pallas_packed_champions(qa, qc, db16, db16, dn, tile_n=tile,
+                                   fold_a=True)[1][0]
 
 HI = jax.lax.Precision.HIGHEST
 DEF = jax.lax.Precision.DEFAULT

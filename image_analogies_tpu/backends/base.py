@@ -86,8 +86,15 @@ class Matcher(abc.ABC):
     @abc.abstractmethod
     def synthesize_level(
         self, db: Any, job: LevelJob
-    ) -> Tuple[np.ndarray, np.ndarray, Dict[str, Any]]:
+    ) -> Tuple[Any, Any, Dict[str, Any]]:
         """Raster-scan synthesis of one level.
 
         Returns (bp (H,W) float32, s (H,W) int32 flat indices into A, stats).
-        """
+
+        Residency contract: bp/s may be HOST np.ndarrays (CPU backend) or
+        DEVICE-RESIDENT jax.Arrays (TPU backend — the driver chains levels
+        through them to avoid per-level PJRT transfers; see
+        TpuMatcher.synthesize_level).  Consumers must treat them as
+        read-only array-likes and call np.asarray() where a host copy is
+        required.  Stats may defer device scalars under "_n_coh"/"_n_ref";
+        models.analogy._finalize_stats resolves them."""

@@ -1220,7 +1220,19 @@ class TpuMatcher(Matcher):
         return p_app, d_app, False
 
     def synthesize_level(self, db: TpuLevelDB, job: LevelJob
-                         ) -> Tuple[np.ndarray, np.ndarray, Dict[str, Any]]:
+                         ) -> Tuple[jax.Array, jax.Array, Dict[str, Any]]:
+        """Returns DEVICE-RESIDENT (bp (hb, wb), s (hb, wb)) plus stats.
+
+        Device residency matters on this box: the PJRT tunnel moves ~9 MB/s
+        with ~0.1 s per-fetch latency (measured round 3), so the old
+        per-level np.asarray of bp+s cost ~1.3 s of the 1024^2 north star
+        and each stats scalar another ~0.1 s.  The driver
+        (models/analogy.py) chains levels through the device arrays
+        (b_filt_coarse consumes bp directly) and fetches host copies only
+        where a host consumer exists (final output, checkpoints,
+        save-levels, keep_levels) — stats carry the coherence count as a
+        device scalar under "_n_coh" for the driver's single batched fetch.
+        """
         t0 = time.perf_counter()
         n_ref = None
         if db.mesh is not None:
@@ -1233,20 +1245,21 @@ class TpuMatcher(Matcher):
             bp, s, n_coh = bp[0], s[0], n_coh[0]
         elif db.strategy == "batched":
             bp, s, counts = _run_batched(db, jnp.float32(job.kappa_mult))
-            n_coh, n_ref = int(counts[0]), int(counts[1])
+            n_coh, n_ref = counts[0], counts[1]
         else:
             runner = _RUNNERS[db.strategy]
             bp, s, n_coh = runner(db, jnp.float32(job.kappa_mult))
-        bp = np.asarray(bp, np.float32)  # forces device completion
-        s = np.asarray(s, np.int32)
-        dt = time.perf_counter() - t0
         hb, wb = job.b_shape
+        bp = bp.reshape(hb, wb)
+        s = s.reshape(hb, wb)
+        jax.block_until_ready((bp, s))  # completion WITHOUT a host fetch
+        dt = time.perf_counter() - t0
         n = hb * wb
         stats = {
             "level": job.level,
             "db_rows": db.ha * db.wa,
             "pixels": n,
-            "coherence_ratio": float(n_coh) / max(n, 1),
+            "_n_coh": n_coh,  # device scalar; driver batch-fetches
             "pixels_per_s": n / max(dt, 1e-9),
             "ms": dt * 1e3,
             "backend": "tpu",
@@ -1256,5 +1269,5 @@ class TpuMatcher(Matcher):
             # picks the left-propagation refinement switched to a same-row
             # coherence candidate — reported separately so coherence_ratio
             # stays comparable with the CPU oracle's.
-            stats["refined_ratio"] = n_ref / max(n, 1)
-        return bp.reshape(hb, wb), s.reshape(hb, wb), stats
+            stats["_n_ref"] = n_ref
+        return bp, s, stats

@@ -90,6 +90,22 @@ def _prep_planes(a, ap, b, params, remap_anchor=None):
     return a_src, b_src, a_filt, ap, b_yiq
 
 
+def _finalize_stats(st: Dict[str, Any]) -> Dict[str, Any]:
+    """Resolve deferred device scalars in a level-stats record.
+
+    The TPU backend reports the coherence count as a device scalar
+    (`_n_coh`) so the hot loop never blocks on a ~0.1 s PJRT tunnel fetch;
+    this converts it (and `_n_ref`) into the documented
+    coherence_ratio/refined_ratio fields.  CPU-backend records pass through
+    untouched."""
+    if "_n_coh" in st:
+        n = max(st.get("pixels", 1), 1)
+        st["coherence_ratio"] = float(st.pop("_n_coh")) / n
+        if "_n_ref" in st:
+            st["refined_ratio"] = float(st.pop("_n_ref")) / n
+    return st
+
+
 def create_image_analogy(
     a: np.ndarray,
     ap: np.ndarray,
@@ -186,12 +202,23 @@ def create_image_analogy(
                 _level, retries=params.level_retries,
                 context={"level": level}, log_path=params.log_path)
             st["total_ms"] = (time.perf_counter() - t0) * 1e3
+            # bp/s may be DEVICE arrays (TPU backend): levels chain through
+            # them without host round-trips (the tunnel moves ~9 MB/s);
+            # host copies are fetched only for opt-in host consumers below
+            # and for the final result.
             bp_pyr[level], s_pyr[level] = bp, s
+            if params.log_path or "_n_coh" not in st:
+                # stream the record now: always when a log file is
+                # configured (observability opt-in pays the ~0.1 s scalar
+                # fetch), and always for records with no deferred device
+                # scalars (CPU backend — deferral would only delay logs)
+                ialog.emit(_finalize_stats(st), params.log_path)
+                st["_emitted"] = True
             stats.append(st)
-            ialog.emit(st, params.log_path)
             if params.checkpoint_dir:
-                ckpt.save_level(params.checkpoint_dir, level, bp, s,
-                                digest=digest)
+                ckpt.save_level(params.checkpoint_dir, level,
+                                np.asarray(bp, np.float32),
+                                np.asarray(s, np.int32), digest=digest)
             if params.save_levels_dir:
                 from image_analogies_tpu.utils.imageio import save_image
                 import os
@@ -199,10 +226,24 @@ def create_image_analogy(
                 os.makedirs(params.save_levels_dir, exist_ok=True)
                 save_image(os.path.join(params.save_levels_dir,
                                         f"level_{level:02d}.png"),
-                           np.clip(bp, 0.0, 1.0))
+                           np.clip(np.asarray(bp, np.float32), 0.0, 1.0))
 
-    bp_y = bp_pyr[0]
-    s_map = s_pyr[0]
+    # ONE batched fetch for all deferred device scalars (each individual
+    # fetch costs ~0.1 s of tunnel latency), then finalize + emit
+    dev = [(st, k) for st in stats for k in ("_n_coh", "_n_ref")
+           if k in st and not isinstance(st[k], (int, float, np.number))]
+    if dev:
+        import jax.numpy as jnp
+
+        vals = np.asarray(jnp.stack([st[k] for st, k in dev]))
+        for (st, k), v in zip(dev, vals):
+            st[k] = float(v)
+    for st in stats:
+        _finalize_stats(st)  # no-op where the streaming path already did
+        if not st.pop("_emitted", False):
+            ialog.emit(st, params.log_path)
+    bp_y = np.asarray(bp_pyr[0], np.float32)
+    s_map = np.asarray(s_pyr[0], np.int32)
     if params.color_mode == "source_rgb":
         ap_flat = ap_rgb.reshape(-1, ap_rgb.shape[-1]) if ap_rgb.ndim == 3 \
             else ap_rgb.reshape(-1)
@@ -215,4 +256,7 @@ def create_image_analogy(
         out = np.clip(bp_y, 0.0, 1.0)
     return AnalogyResult(
         bp=out, bp_y=bp_y, source_map=s_map, stats=stats,
-        levels=(list(zip(bp_pyr, s_pyr)) if keep_levels else None))
+        levels=(list(zip(
+            [np.asarray(x, np.float32) for x in bp_pyr],
+            [np.asarray(x, np.int32) for x in s_pyr]))
+            if keep_levels else None))

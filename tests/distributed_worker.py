@@ -1,0 +1,73 @@
+"""Worker process for the REAL two-process jax.distributed smoke test
+(round-3 VERDICT item 5; SURVEY.md §5.8).
+
+Run by tests/test_sharded.py::test_two_process_distributed_smoke as TWO
+localhost subprocesses:
+
+    python tests/distributed_worker.py <port> <process_id>
+
+Each process owns ONE CPU device; `initialize_distributed` performs the
+actual coordination-service handshake (un-mocked), after which
+`jax.devices()` spans both processes and the db_shards=2 mesh lays the
+exemplar DB across them — one shard per PROCESS, so the min+argmin
+all-reduce and psum row-gathers of parallel/step.py cross a real process
+boundary via gloo CPU collectives.  Process 0 also synthesizes the serial
+(db_shards=1, local-device) result and asserts the sharded output matches
+it exactly; success prints DISTRIBUTED_SMOKE_OK.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    port, pid = sys.argv[1], int(sys.argv[2])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("XLA_FLAGS", None)  # exactly one local device
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    from image_analogies_tpu.parallel.distributed import (
+        initialize_distributed,
+    )
+
+    try:
+        assert initialize_distributed(f"127.0.0.1:{port}", 2, pid)
+    except (RuntimeError, ValueError) as e:
+        # environment lacks the distributed runtime / gloo collectives —
+        # the PRECISE sentinel test_sharded.py skips on (anything past
+        # this point is a real failure and must FAIL the test)
+        print(f"DISTRIBUTED_SMOKE_UNSUPPORTED: {e}", flush=True)
+        return 0
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 2, jax.devices()
+    assert jax.local_device_count() == 1
+
+    import numpy as np
+
+    from image_analogies_tpu.config import AnalogyParams
+    from image_analogies_tpu.models.analogy import create_image_analogy
+
+    rng = np.random.default_rng(11)
+    a = rng.uniform(0, 1, (24, 24)).astype(np.float32)
+    ap = (np.round(a * 5) / 5).astype(np.float32)
+    b = rng.uniform(0, 1, (24, 24)).astype(np.float32)
+    base = dict(levels=2, kappa=2.0, strategy="wavefront", backend="tpu")
+
+    sharded = create_image_analogy(a, ap, b,
+                                   AnalogyParams(db_shards=2, **base))
+    if pid == 0:
+        solo = create_image_analogy(a, ap, b, AnalogyParams(**base))
+        np.testing.assert_array_equal(solo.source_map, sharded.source_map)
+        np.testing.assert_allclose(solo.bp_y, sharded.bp_y, atol=1e-6)
+    print("DISTRIBUTED_SMOKE_OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,13 +24,18 @@ GOLDEN_DIR = os.path.join(os.path.dirname(os.path.dirname(
 
 # (config name, golden output keys, SSIM floor vs committed golden,
 #  SSIM floor vs the CPU oracle).  Golden floors allow 8-bit PNG
-# quantization; oracle floors allow residual exact-tie divergence.
+# quantization.  Oracle floors: round 2 carried loose tbn (0.90) and video
+# (0.95) floors for exact-tie divergence; the round-3 lexicographic
+# (distance, index) anchors resolve every tie to the lowest index on both
+# backends, and ALL five configs now measure SSIM 1.0 / 100% bit-equal
+# TPU-vs-oracle at these sizes — 0.99 everywhere leaves margin only for
+# platform fp drift (round-3 VERDICT item 6).
 CONFIGS = [
-    ("tbn", ["out"], 0.98, 0.90),
-    ("oil", ["out"], 0.98, 0.98),
-    ("superres", ["out"], 0.98, 0.98),
-    ("npr", ["out"], 0.98, 0.98),
-    ("video", ["f0", "f1", "f2"], 0.98, 0.95),
+    ("tbn", ["out"], 0.98, 0.99),
+    ("oil", ["out"], 0.98, 0.99),
+    ("superres", ["out"], 0.98, 0.99),
+    ("npr", ["out"], 0.98, 0.99),
+    ("video", ["f0", "f1", "f2"], 0.98, 0.99),
 ]
 
 

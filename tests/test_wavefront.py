@@ -91,3 +91,23 @@ def test_wavefront_a_b_different_sizes():
     assert wf.bp_y.shape == (20, 24)
     mismatch = (wf.source_map != oracle.source_map).mean()
     assert mismatch < 0.02, f"{mismatch:.2%}"
+
+
+@pytest.mark.slow
+def test_wavefront_sharded_matches_unsharded_128():
+    """Round-3 VERDICT item 7: sharded wavefront at REALISTIC size.
+
+    At 24^2 the diagonal schedule has a handful of narrow segments and the
+    shard padding geometry is trivial; 128^2 exercises width-bucketed
+    segments (plateau M ~ 43) against db_shards=4 shard padding on the
+    8-device virtual mesh — the interaction the small tests can't see."""
+    rng = np.random.default_rng(31)
+    a = rng.uniform(0, 1, (128, 128)).astype(np.float32)
+    ap = (np.round(a * 6) / 6).astype(np.float32)
+    b = rng.uniform(0, 1, (128, 128)).astype(np.float32)
+    base = dict(levels=2, kappa=3.0, strategy="wavefront", backend="tpu")
+    solo = create_image_analogy(a, ap, b, AnalogyParams(**base))
+    sharded = create_image_analogy(a, ap, b,
+                                   AnalogyParams(db_shards=4, **base))
+    np.testing.assert_array_equal(solo.source_map, sharded.source_map)
+    np.testing.assert_allclose(solo.bp_y, sharded.bp_y, atol=1e-6)
