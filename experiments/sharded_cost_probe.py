@@ -7,8 +7,8 @@ mesh program's STRUCTURE is real: the same shard_map with the min+argmin
 all-gather, two psum row-gathers per step, shard padding, and the
 HIGHEST-precision shard scan.  Comparing per-level wall-clock of
 
-  (a) the normal single-chip path (match_mode=exact_hi — the same scan
-      precision the mesh step uses), and
+  (a) the normal single-chip path (auto: the packed 2-pass parity scan
+      on the big level — the same kernel the mesh step runs per shard), and
   (b) the REAL mesh path on a 1-chip ('data' x 'db') mesh
       (build_sharded_db + multichip_level_step, exactly what db_shards>1
       dispatches),
@@ -61,8 +61,11 @@ def main() -> int:
     size = args.size
     levels = 3
     a, ap, b = make_structured(size)
+    # auto resolves the big level to exact_hi2_2p — the SAME packed scan
+    # the real-TPU mesh step now runs per shard, so solo-vs-mesh compares
+    # identical kernels and the delta isolates the mesh structure
     params = AnalogyParams(levels=levels, kappa=5.0, backend="tpu",
-                           strategy="wavefront", match_mode="exact_hi")
+                           strategy="wavefront")
 
     # (a) normal single-chip path at the mesh step's scan precision —
     # timed at the runner level (block_until_ready, no host fetch), warm,
@@ -106,10 +109,13 @@ def main() -> int:
     mesh = make_mesh(db_shards=1)
     to_j = lambda x: None if x is None else jnp.asarray(x, jnp.float32)
     template = make_level_template(params, job, "wavefront")
-    dbp, dbnp, afp = build_sharded_db(
+    dbp, dbnp, afp, w1, w2, dbnh, shift = build_sharded_db(
         spec, to_j(job.a_src), to_j(job.a_filt), to_j(job.a_src_coarse),
         to_j(job.a_filt_coarse), None, template.rowsafe, mesh, True,
-        _tile_rows(spec.total))
+        _tile_rows(spec.total), packed=True)
+    import dataclasses
+
+    template = dataclasses.replace(template, feat_mean=shift)
     static_q = _prepare_query_arrays(
         spec, to_j(job.b_src), to_j(job.b_src_coarse),
         to_j(job.b_filt_coarse), None)
@@ -117,7 +123,8 @@ def main() -> int:
     def run_mesh():
         bp, s, n = multichip_level_step(
             mesh, static_q[None], dbp, dbnp, afp, template,
-            job.kappa_mult, force_xla=False)
+            job.kappa_mult, force_xla=False,
+            w1_shard=w1, w2_shard=w2, dbnh_shard=dbnh)
         jax.block_until_ready((bp, s))
 
     run_mesh()  # warm/compile

@@ -267,6 +267,38 @@ def _gather_maps_device(h: int, w: int, p: int):
             jax.device_put(valid), jax.device_put(written))
 
 
+def _packed_weight_arrays(src, spec, npad: int, mode2p: bool):
+    """THE packed-scan build shared by the single-chip pad and the sharded
+    builder — one derivation of the live-dim shift, the bf16 hi/mid/lo
+    split, and the lane layout, so the solo-vs-mesh bit-identical parity
+    can never drift between the two paths.
+
+    Returns (w1, w2, dbnh_row (npad,), shift (f,), live_idx).  ``mode2p``
+    selects W2 = [d1|d3] (exact_hi2_2p, the 2-pass product set) vs
+    [d3|d1] (exact_hi2, the full bf16_6x set)."""
+    n, f = src.shape
+    live = np.nonzero(spec.query_live_mask())[0]
+    lw = live.size
+    shift = jnp.zeros((f,), _F32).at[live].set(
+        jnp.mean(src[:, live], axis=0))
+    srcc = src - shift[None, :]
+    nrm = jnp.sum(srcc * srcc, axis=1)
+    # bitmask split — the dtype-round-trip split is folded away under
+    # --xla_allow_excess_precision (see bf16_split3)
+    h1, h2, r2 = bf16_split3(srcc[:, live])
+    d1, d2, d3 = (x.astype(jnp.bfloat16) for x in (h1, h2, r2))
+    pk = max((2 * lw + 127) // 128 * 128, 128)
+
+    def pack(left, right):
+        return jnp.zeros((npad, pk), jnp.bfloat16).at[
+            :n, :lw].set(left).at[:n, lw:2 * lw].set(right)
+
+    w1 = pack(d1, d2)
+    w2 = pack(d1, d3) if mode2p else pack(d3, d1)
+    dbnh = jnp.full((npad,), jnp.inf, _F32).at[:n].set(0.5 * nrm)
+    return w1, w2, dbnh, shift, jnp.asarray(live, jnp.int32)
+
+
 @functools.partial(jax.jit, static_argnames=("spec", "pad_tile", "pad_full",
                                              "pad_mode"))
 def _prepare_level_arrays(
@@ -339,40 +371,22 @@ def _prepare_level_arrays(
             out["dbn_pad"] = jnp.full((1, npad), jnp.inf, _F32).at[
                 0, :n].set(nrm)
         elif pad_mode in ("packed", "packed2"):
-            # exact_hi2: live-dim hi/mid/lo lane packing (3-way bf16 split
-            # covers ~24 mantissa bits; the 3-pass kernel's product set ==
-            # jax HIGHEST's bf16_6x — see ops/pallas_match._packed3_kernel).
-            # The shift vector is the live-masked column mean — dead dims
-            # stay RAW (queries are identically zero there, so shifting
-            # them would break the distance-shift invariance); centering
-            # shrinks |q||db| and with it every dropped-term error.
-            live = np.nonzero(spec.query_live_mask())[0]
-            lw = live.size
-            shift = jnp.zeros((f,), _F32).at[live].set(
-                jnp.mean(src[:, live], axis=0))
-            srcc = src - shift[None, :]
-            nrm = jnp.sum(srcc * srcc, axis=1)  # centered-live + raw-dead
-            # bitmask split — the dtype-round-trip split is folded away
-            # under --xla_allow_excess_precision (see bf16_split3)
-            h1, h2, r2 = bf16_split3(srcc[:, live])
-            d1 = h1.astype(jnp.bfloat16)
-            d2 = h2.astype(jnp.bfloat16)
-            d3 = r2.astype(jnp.bfloat16)
-            pk = max((2 * lw + 127) // 128 * 128, 128)
-
-            def pack(left, right):
-                return jnp.zeros((npad, pk), jnp.bfloat16).at[
-                    :n, :lw].set(left).at[:n, lw:2 * lw].set(right)
-
+            # exact_hi2 family: live-dim hi/mid/lo lane packing (3-way bf16
+            # split covers ~24 mantissa bits; product sets documented in
+            # ops/pallas_match._packed_kernel).  The shift vector is the
+            # live-masked column mean — dead dims stay RAW (queries are
+            # identically zero there, so shifting them would break the
+            # distance-shift invariance); centering shrinks |q||db| and
+            # with it every dropped-term error.  The build itself is
+            # `_packed_weight_arrays`, SHARED with the sharded builder.
+            w1, w2, dbnh_row, shift, live_idx = _packed_weight_arrays(
+                src, spec, npad, mode2p=pad_mode == "packed2")
             out["feat_mean"] = jnp.zeros((fp,), _F32).at[:f].set(shift)
-            out["db_pad"] = pack(d1, d2)
-            # packed (exact_hi2, 3 passes): W2 = [d3|d1];
-            # packed2 (exact_hi2_2p, 2 passes): W2 = [d1|d3]
-            out["db_pad2"] = (pack(d3, d1) if pad_mode == "packed"
-                              else pack(d1, d3))
-            # the EXACT index array the DB lanes were packed by — the
-            # anchor's query packing reuses it, one derivation total
-            out["live_idx"] = jnp.asarray(live, jnp.int32)
+            out["db_pad"] = w1
+            out["db_pad2"] = w2
+            out["live_idx"] = live_idx
+            out["dbnh_pad"] = dbnh_row[None, :]
+            nrm = None  # dbnh_pad already set; skip the shared tail
         else:
             out["db_pad"] = jnp.zeros((npad, fp), _F32).at[:n, :f].set(src)
             out["dbn_pad"] = jnp.full((1, npad), jnp.inf, _F32).at[
@@ -387,17 +401,26 @@ def _prepare_level_arrays(
 
 @functools.lru_cache(maxsize=None)
 def _cached_sharded_db_builder(mesh, spec, pad_full: bool, npad: int,
-                               fp: int):
+                               fp: int, packed: bool):
     """Jit that builds a level's scoring DB DIRECTLY sharded over the mesh's
     'db' axis (out_shardings): GSPMD partitions the window-gather feature
     build by output rows, so each chip materializes only ITS shard — the
     full (Na, F) DB never exists on any single device, closing the
     transient-build memory bound that `shard_level_db`'s
-    device_put-after-build path had."""
+    device_put-after-build path had.
+
+    With ``packed`` (the wavefront mesh scan on real TPUs) the builder also
+    emits the exact_hi2_2p lane-packed weight shards W1=[d1|d2],
+    W2=[d1|d3], the half-norm row, and the (replicated) live-dim centering
+    shift — the shift reduces over the FULL row set (GSPMD inserts the
+    cross-shard mean), so scan scores are globally comparable and the
+    cross-shard tie-break stays lowest-global-index
+    (parallel/sharded_match.packed_champion_allreduce)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     sh_db = NamedSharding(mesh, P("db", None))
     sh_row = NamedSharding(mesh, P("db"))
+    sh_rep = NamedSharding(mesh, P())
 
     def build(a_src, a_filt, a_src_coarse, a_filt_coarse, a_temporal,
               rowsafe):
@@ -411,9 +434,20 @@ def _cached_sharded_db_builder(mesh, spec, pad_full: bool, npad: int,
         dbnp = jnp.full((npad,), jnp.inf, _F32).at[:n].set(dbn)
         afp = jnp.zeros((npad,), _F32).at[:n].set(
             a_filt.reshape(-1).astype(_F32))
-        return dbp, dbnp, afp
+        if not packed:
+            return dbp, dbnp, afp
+        # SAME build as the single-chip exact_hi2_2p pad (shared helper) —
+        # GSPMD turns the helper's full-row mean into the cross-shard
+        # collective, keeping scan scores globally comparable
+        w1, w2, dbnh, shift, _ = _packed_weight_arrays(db, spec, npad,
+                                                       mode2p=True)
+        shiftp = jnp.zeros((fp,), _F32).at[:f].set(shift)
+        return (dbp, dbnp, afp, w1, w2, dbnh, shiftp)
 
-    return jax.jit(build, out_shardings=(sh_db, sh_row, sh_row))
+    outs = (sh_db, sh_row, sh_row)
+    if packed:
+        outs = outs + (sh_db, sh_db, sh_row, sh_rep)
+    return jax.jit(build, out_shardings=outs)
 
 
 @functools.partial(jax.jit, static_argnames=("spec",))
@@ -427,20 +461,24 @@ def _prepare_query_arrays(spec, b_src, b_src_coarse, b_filt_coarse,
 
 
 def build_sharded_db(spec, a_src, a_filt, a_src_coarse, a_filt_coarse,
-                     a_temporal, rowsafe, mesh, pad_full: bool, tile: int):
-    """Build the level's (dbp, dbnp, afiltp) laid out sharded over the
-    mesh's 'db' axis without any chip holding the full DB (see
-    `_cached_sharded_db_builder`).  Used by the single-image sharded path
-    and the sharded video phase."""
+                     a_temporal, rowsafe, mesh, pad_full: bool, tile: int,
+                     packed: bool = False):
+    """Build the level's sharded scoring arrays over the mesh's 'db' axis
+    without any chip holding the full DB (see `_cached_sharded_db_builder`).
+    Used by the single-image sharded path and the sharded video phase.
+
+    Returns a 7-tuple (dbp, dbnp, afiltp, w1, w2, dbnh, shift); the last
+    four are None unless ``packed`` (the exact_hi2_2p mesh scan)."""
     from image_analogies_tpu.parallel.sharded_match import \
         sharded_pad_geometry
 
     ha, wa = a_filt.shape[:2]
     npad, fp = sharded_pad_geometry(ha * wa, spec.total, mesh.shape["db"],
                                     tile)
-    fn = _cached_sharded_db_builder(mesh, spec, pad_full, npad, fp)
-    return fn(a_src, a_filt, a_src_coarse, a_filt_coarse, a_temporal,
-              rowsafe)
+    fn = _cached_sharded_db_builder(mesh, spec, pad_full, npad, fp, packed)
+    out = fn(a_src, a_filt, a_src_coarse, a_filt_coarse, a_temporal,
+             rowsafe)
+    return out if packed else out + (None, None, None, None)
 
 
 def make_level_template(params, job: LevelJob, strategy: str,
@@ -462,6 +500,10 @@ def make_level_template(params, job: LevelJob, strategy: str,
         valid = written = jnp.zeros((1, spec.fine_n), _F32)
     else:
         flat_idx, valid, written = _gather_maps_device(hb, wb, spec.fine_size)
+    # live columns always ride the template (tiny): the packed anchors —
+    # single-chip AND the mesh step — read them from here, so the lane
+    # layout derivation stays spec.query_live_mask() everywhere
+    live_idx = jnp.asarray(np.nonzero(spec.query_live_mask())[0], jnp.int32)
     off = window_offsets(spec.fine_size)
     rowsafe = ((off[:, 0] < 0).astype(np.float32)
                * causal_mask(spec.fine_size))
@@ -478,7 +520,7 @@ def make_level_template(params, job: LevelJob, strategy: str,
         off=jnp.asarray(off), db_sharded=None, dbn_sharded=None,
         afilt_sharded=None, diag=diag, db_pad=None, db_pad2=None,
         dbn_pad=None,
-        dbnh_pad=None, feat_mean=None, live_idx=None,
+        dbnh_pad=None, feat_mean=None, live_idx=live_idx,
         ha=ha, wa=wa, hb=hb, wb=wb, fine_start=fsl.start,
         n_rowsafe=(spec.fine_size // 2) * spec.fine_size,
         strategy=strategy, refine_passes=params.refine_passes,
@@ -510,8 +552,7 @@ def slim_for_mesh(db: TpuLevelDB, keep_sharded: bool = False) -> TpuLevelDB:
     return dataclasses.replace(
         db, db=z2, db_sqnorm=z1, db_rowsafe=z2, db_rowsafe_sqnorm=z1,
         static_q=z2, a_filt_flat=z1, db_pad=None, db_pad2=None,
-        dbn_pad=None,
-        dbnh_pad=None, feat_mean=None, live_idx=None, **kw)
+        dbn_pad=None, dbnh_pad=None, **kw)
 
 
 # --------------------------------------------------------------- exact scan
@@ -1147,13 +1188,24 @@ class TpuMatcher(Matcher):
             from image_analogies_tpu.parallel.mesh import make_mesh
 
             mesh = make_mesh(db_shards=self.params.db_shards)
-            tile = (_tile_rows(spec.total)
-                    if jax.default_backend() == "tpu" else 1)
-            db_sharded, dbn_sharded, afilt_sharded = build_sharded_db(
+            on_tpu = jax.default_backend() == "tpu"
+            tile = _tile_rows(spec.total) if on_tpu else 1
+            # real-TPU wavefront meshes scan with the packed 2-pass
+            # kernel per shard (the same exact_hi2_2p parity scan as the
+            # single chip); CPU/virtual meshes keep the exact XLA path.
+            # match_mode steering is honored: explicit exact_hi* pins the
+            # HIGHEST merged scan, and auto applies the same per-level
+            # DB-size crossover as the single-chip hybrid.
+            mm = self.params.match_mode
+            packed = (on_tpu and strategy == "wavefront"
+                      and mm in ("auto", "exact_hi2", "exact_hi2_2p")
+                      and (mm != "auto" or ha * wa >= 131072))
+            (db_sharded, dbn_sharded, afilt_sharded, w1, w2, dbnh,
+             shift) = build_sharded_db(
                 spec, to_j(job.a_src), to_j(job.a_filt),
                 to_j(job.a_src_coarse), to_j(job.a_filt_coarse),
                 to_j(job.a_temporal), template.rowsafe, mesh, pad_full,
-                tile)
+                tile, packed=packed)
             # query side in its own program — the DB never materializes
             # unsharded anywhere
             static_q = _prepare_query_arrays(
@@ -1162,6 +1214,7 @@ class TpuMatcher(Matcher):
             return dataclasses.replace(
                 template, static_q=static_q, db_sharded=db_sharded,
                 dbn_sharded=dbn_sharded, afilt_sharded=afilt_sharded,
+                db_pad=w1, db_pad2=w2, dbnh_pad=dbnh, feat_mean=shift,
                 mesh=mesh)
 
         arrs = _prepare_level_arrays(
@@ -1241,7 +1294,9 @@ class TpuMatcher(Matcher):
             bp, s, n_coh = multichip_level_step(
                 db.mesh, db.static_q[None], db.db_sharded, db.dbn_sharded,
                 db.afilt_sharded, slim_for_mesh(db), job.kappa_mult,
-                force_xla=jax.default_backend() != "tpu")
+                force_xla=jax.default_backend() != "tpu",
+                w1_shard=db.db_pad, w2_shard=db.db_pad2,
+                dbnh_shard=db.dbnh_pad)
             bp, s, n_coh = bp[0], s[0], n_coh[0]
         elif db.strategy == "batched":
             bp, s, counts = _run_batched(db, jnp.float32(job.kappa_mult))

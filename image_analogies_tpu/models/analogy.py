@@ -254,9 +254,13 @@ def create_image_analogy(
             np.stack([bp_y, b_yiq[..., 1], b_yiq[..., 2]], axis=-1))
     else:
         out = np.clip(bp_y, 0.0, 1.0)
+    if keep_levels:
+        # reuse the already-fetched finest planes; only the coarser levels
+        # (a quarter of the data, shrinking geometrically) transfer here
+        levels_np = [(bp_y, s_map)] + [
+            (np.asarray(bp_pyr[lv], np.float32),
+             np.asarray(s_pyr[lv], np.int32))
+            for lv in range(1, levels)]
     return AnalogyResult(
         bp=out, bp_y=bp_y, source_map=s_map, stats=stats,
-        levels=(list(zip(
-            [np.asarray(x, np.float32) for x in bp_pyr],
-            [np.asarray(x, np.int32) for x in s_pyr]))
-            if keep_levels else None))
+        levels=(levels_np if keep_levels else None))

@@ -177,11 +177,19 @@ def _sharded_phase(a, ap, frames, params: AnalogyParams, mesh,
                                                                 jnp.float32)
             template = make_level_template(params, job0, strategy)
             tile = _tile_rows(spec.total) if not force_xla else 1
-            dbp, dbnp, afp = build_sharded_db(
+            # real-TPU wavefront meshes scan with the packed 2-pass kernel
+            # per shard (same parity class as exact_hi2_2p, ~2x fewer MXU
+            # passes); CPU/virtual meshes keep the exact XLA path
+            packed = strategy == "wavefront" and not force_xla
+            dbp, dbnp, afp, w1, w2, dbnh, _shift = build_sharded_db(
                 spec, to_j(job0.a_src), to_j(job0.a_filt),
                 to_j(job0.a_src_coarse), to_j(job0.a_filt_coarse),
                 to_j(job0.a_temporal), template.rowsafe, mesh,
-                strategy == "wavefront", tile)
+                strategy == "wavefront", tile, packed=packed)
+            if packed:
+                import dataclasses
+
+                template = dataclasses.replace(template, feat_mean=_shift)
             static_qs = []
             for i in range(t_pad):
                 j = job_for(i)
@@ -191,7 +199,8 @@ def _sharded_phase(a, ap, frames, params: AnalogyParams, mesh,
             frame_static_q = jnp.stack(static_qs)
             return multichip_level_step(
                 mesh, frame_static_q, dbp, dbnp, afp, template,
-                job0.kappa_mult, force_xla=force_xla)
+                job0.kappa_mult, force_xla=force_xla,
+                w1_shard=w1, w2_shard=w2, dbnh_shard=dbnh)
 
         bp, s, n_coh = failure.run_with_retry(
             _level, retries=params.level_retries,

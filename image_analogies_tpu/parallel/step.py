@@ -38,22 +38,32 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from image_analogies_tpu.backends.tpu import (
     TpuLevelDB,
+    _scan_tile,
     _tile_rows,
     batched_scan_core,
     wavefront_scan_core,
 )
+from image_analogies_tpu.ops.pallas_match import bf16_split3
 from image_analogies_tpu.parallel.mesh import shard_map
-from image_analogies_tpu.parallel.sharded_match import local_argmin_allreduce
+from image_analogies_tpu.parallel.sharded_match import (
+    local_argmin_allreduce,
+    packed_champion_allreduce,
+)
 
 
 @functools.lru_cache(maxsize=None)
 def _cached_multichip_step(mesh: Mesh, strategy: str, force_xla: bool,
-                           precision):
+                           precision, packed: bool,
+                           packed_interpret: bool = False):
     """Build the shard_map'd multi-frame level step once per
-    (mesh, strategy, force_xla, precision); jit caching then keys on shapes."""
+    (mesh, strategy, force_xla, precision, packed); jit caching then keys
+    on shapes.  ``packed`` switches the wavefront anchor's scan from the
+    HIGHEST merged kernel to the exact_hi2_2p packed champion kernel per
+    shard (same parity class, ~2x fewer MXU passes) — real-TPU meshes
+    only; the signature grows by (w1, w2, dbnh) shard inputs."""
 
-    def local_step(static_q_loc, db_loc, dbn_loc, af_loc, tmpl: TpuLevelDB,
-                   km):
+    def local_step(static_q_loc, db_loc, dbn_loc, af_loc, w1_loc, w2_loc,
+                   dbnh_loc, tmpl: TpuLevelDB, km):
         rows = db_loc.shape[0]
         f = tmpl.static_q.shape[1]
 
@@ -67,9 +77,19 @@ def _cached_multichip_step(mesh: Mesh, strategy: str, force_xla: bool,
         def anchor_fn(queries):
             # wavefront anchor contract (see backends.tpu.make_anchor_fn):
             # globally-reduced pick + exact fp32 re-score through the
-            # psum row-gather.  The mesh scan stays at HIGHEST (exact_hi);
-            # the bf16 two-pass scheme is the single-chip fast path.
-            p, _ = approx_fn(queries)
+            # psum row-gather — the kappa rule's d_app never comes from
+            # scan space on any path.
+            if packed:
+                qc = (queries
+                      - tmpl.feat_mean[None, :queries.shape[1]])
+                g1, g2, _ = bf16_split3(qc[:, tmpl.live_idx])
+                p, _ = packed_champion_allreduce(
+                    g1.astype(jnp.bfloat16), g2.astype(jnp.bfloat16),
+                    w1_loc, w2_loc, dbnh_loc, "db",
+                    tile_n=_scan_tile(w1_loc.shape[0], w1_loc.shape[1]),
+                    interpret=packed_interpret)
+            else:
+                p, _ = approx_fn(queries)
             return p, jnp.sum((row_fn(p) - queries) ** 2, axis=1)
 
         def _local(idx):
@@ -108,7 +128,7 @@ def _cached_multichip_step(mesh: Mesh, strategy: str, force_xla: bool,
         local_step,
         mesh=mesh,
         in_specs=(P("data", None, None), P("db", None), P("db"), P("db"),
-                  P(), P()),
+                  P("db", None), P("db", None), P("db"), P(), P()),
         out_specs=(P("data", None), P("data", None), P("data")),
         check_rep=False,
     )
@@ -124,6 +144,11 @@ def multichip_level_step(
     template: TpuLevelDB,  # single-frame LevelDB carrying shared arrays/meta
     kappa_mult: float,
     force_xla: bool = False,
+    w1_shard: jax.Array = None,  # packed-scan shards (build_sharded_db
+    w2_shard: jax.Array = None,  # with packed=True); None -> HIGHEST
+    dbnh_shard: jax.Array = None,  # merged-kernel scan
+    packed_interpret: bool = False,  # tests: packed scan via the Pallas
+    # interpreter on CPU meshes (overrides the force_xla packed gate)
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Whole-level scan for T frames on the ('data','db') mesh.  Returns
     (bp (T, Nb), s (T, Nb), n_coherence (T,)).
@@ -148,7 +173,17 @@ def multichip_level_step(
     precision = (jax.lax.Precision.HIGHEST
                  if template.strategy == "wavefront"
                  else jax.lax.Precision.DEFAULT)
+    packed = (w1_shard is not None and template.strategy == "wavefront"
+              and (not force_xla or packed_interpret))
+    if not packed:
+        # tiny placeholder shards keep ONE shard_map signature; the
+        # non-packed anchor never reads them
+        z = jnp.zeros((db_shards, 1), jnp.bfloat16)
+        w1_shard, w2_shard = z, z
+        dbnh_shard = jnp.zeros((db_shards,), jnp.float32)
     step = _cached_multichip_step(mesh, template.strategy, force_xla,
-                                  precision)
+                                  precision, packed,
+                                  packed and packed_interpret)
     return step(frame_static_q, db_shard_src, dbn_shard_src,
-                afilt_shard_src, template, jnp.float32(kappa_mult))
+                afilt_shard_src, w1_shard, w2_shard, dbnh_shard, template,
+                jnp.float32(kappa_mult))

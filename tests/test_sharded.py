@@ -208,3 +208,147 @@ def test_two_process_distributed_smoke():
             pytest.skip(f"distributed runtime unavailable: {out[-400:]}")
         assert p.returncode == 0, f"worker {i} failed:\n{out[-4000:]}"
         assert "DISTRIBUTED_SMOKE_OK" in out, out[-4000:]
+
+
+def test_packed_champion_allreduce_matches_global(rng):
+    """The packed sharded scan's cross-shard resolution must reproduce the
+    single-array packed champion pick, including lowest-GLOBAL-index ties
+    for duplicate rows planted in DIFFERENT shards (the invariant the
+    real-TPU mesh wavefront now rides — interpret-mode kernel inside the
+    virtual shard_map)."""
+    from jax.sharding import PartitionSpec as P
+
+    from image_analogies_tpu.ops.pallas_match import (
+        bf16_split3,
+        packed2_champions,
+    )
+    from image_analogies_tpu.parallel.mesh import shard_map
+    from image_analogies_tpu.parallel.sharded_match import (
+        packed_champion_allreduce,
+    )
+
+    n, L, m, shards, tile = 512, 55, 16, 4, 128
+    x = rng.standard_normal((n, L)).astype(np.float32)
+    q = rng.standard_normal((m, L)).astype(np.float32)
+    # duplicates across shard boundaries: rows 70 (shard 0) and 400
+    # (shard 3) equal query 0 -> exact tie, global-lowest 70 must win
+    x[70] = q[0]
+    x[400] = q[0]
+
+    shift = np.zeros((L,), np.float32)
+    shift[:] = x.mean(0)
+    xc = jnp.asarray(x - shift[None, :])
+    d1, d2, r2 = bf16_split3(xc)
+    d1, d2 = d1.astype(jnp.bfloat16), d2.astype(jnp.bfloat16)
+    d3 = r2.astype(jnp.bfloat16)
+    kp = 128
+
+    def pack(left, right):
+        return jnp.zeros((n, kp), jnp.bfloat16).at[:, :L].set(left).at[
+            :, L:2 * L].set(right)
+
+    w1, w2 = pack(d1, d2), pack(d1, d3)
+    dbnh = 0.5 * jnp.sum(xc * xc, axis=1)
+    qc = jnp.asarray(q - shift[None, :])
+    g1, g2, _ = bf16_split3(qc)
+    q1, q2 = g1.astype(jnp.bfloat16), g2.astype(jnp.bfloat16)
+
+    # global reference: single packed2 call over the whole array
+    vals, idx = packed2_champions(q1, q2, w1, w2, dbnh[None, :],
+                                  tile_n=tile, interpret=True)
+    k = jnp.argmax(vals, axis=1)
+    ref = np.asarray(jnp.take_along_axis(idx, k[:, None], 1)[:, 0])
+    assert ref[0] == 70  # the planted tie resolves to the lowest index
+
+    mesh = make_mesh(db_shards=shards)
+    sharded = shard_map(
+        lambda qq1, qq2, w1s, w2s, dh: packed_champion_allreduce(
+            qq1, qq2, w1s, w2s, dh, "db", tile_n=tile, interpret=True),
+        mesh=mesh,
+        in_specs=(P(), P(), P("db", None), P("db", None), P("db")),
+        out_specs=(P(), P()),
+        check_rep=False,
+    )
+    gi, gv = jax.jit(sharded)(q1, q2, w1, w2, dbnh)
+    np.testing.assert_array_equal(np.asarray(gi), ref)
+
+
+def test_packed_mesh_level_matches_solo_interpret(rng):
+    """End-to-end coverage of the PRODUCTION packed mesh wavefront (the
+    real-TPU scan) on CI hardware: the packed kernel runs through the
+    Pallas interpreter inside the virtual db_shards=4 shard_map, driven by
+    the same build_sharded_db(packed=True) the TPU path uses, and the
+    level output must bit-match the solo CPU wavefront (the interpreter's
+    scan is fp32, so picks are exact)."""
+    import dataclasses
+
+    from image_analogies_tpu.backends.base import LevelJob
+    from image_analogies_tpu.backends.tpu import (
+        _prepare_query_arrays,
+        build_sharded_db,
+        make_level_template,
+    )
+    from image_analogies_tpu.ops import color
+    from image_analogies_tpu.ops.features import spec_for_level
+    from image_analogies_tpu.parallel.step import multichip_level_step
+
+    from image_analogies_tpu.models.analogy import _prep_planes
+
+    a, ap, b = make_pair(24, 24, seed=21)
+    params = AnalogyParams(levels=1, kappa=3.0, backend="tpu",
+                           strategy="wavefront")
+    solo = create_image_analogy(a, ap, b, params)
+
+    # the same remapped planes the solo run synthesized from
+    a_src, b_src, a_filt, _, _ = _prep_planes(a, ap, b, params)
+    spec = spec_for_level(params, 0, 1, 1)
+    job = LevelJob(level=0, spec=spec,
+                   kappa_mult=params.kappa_factor(0) ** 2,
+                   a_src=a_src, a_filt=a_filt, b_src=b_src)
+    mesh = make_mesh(db_shards=4)
+    to_j = lambda x: None if x is None else jnp.asarray(x, jnp.float32)
+    template = make_level_template(params, job, "wavefront")
+    dbp, dbnp, afp, w1, w2, dbnh, shift = build_sharded_db(
+        spec, to_j(job.a_src), to_j(job.a_filt), None, None, None,
+        template.rowsafe, mesh, True, 1, packed=True)
+    template = dataclasses.replace(template, feat_mean=shift)
+    static_q = _prepare_query_arrays(spec, to_j(job.b_src), None, None,
+                                     None)
+    bp, s, _ = multichip_level_step(
+        mesh, static_q[None], dbp, dbnp, afp, template, job.kappa_mult,
+        force_xla=True, w1_shard=w1, w2_shard=w2, dbnh_shard=dbnh,
+        packed_interpret=True)
+    s_mesh = np.asarray(s[0]).reshape(24, 24)
+    # the packed score formula rounds differently than the solo XLA score
+    # (qc.dbc - ||dbc||^2/2 vs ||db||^2 - 2 q.db), so near-tied rows of this
+    # posterized data may legally resolve to different picks, which then
+    # cascade; the check is tie-aware: the FIRST scan-order divergence must
+    # be a genuine fp-band tie of the anchor decision (everything after is
+    # its deterministic consequence — the same argument utils/parity.py
+    # makes for oracle parity)
+    mism = np.nonzero(s_mesh.reshape(-1) != solo.source_map.reshape(-1))[0]
+    if mism.size:
+        from image_analogies_tpu.ops.features import build_features_np
+
+        db_rows = build_features_np(spec, a_src, a_filt, None, None)
+        # scan-order-first mismatch (wavefront order: t = j + 3*i)
+        ii, jj = mism // 24, mism % 24
+        q0 = mism[np.argmin(jj + 3 * ii)]
+        p_mesh = int(s_mesh.reshape(-1)[q0])
+        p_solo = int(solo.source_map.reshape(-1)[q0])
+        # both runs saw the same context at the first divergence: re-score
+        # both picks against the solo run's query vector
+        from image_analogies_tpu.ops.features import fine_gather_maps
+
+        flat_idx, _, written = fine_gather_maps(24, 24, spec.fine_size)
+        fsl = spec.fine_filt_slice
+        qv = build_features_np(spec, b_src, None, None, None)[q0].copy()
+        qv[fsl] = (solo.bp_y.reshape(-1)[flat_idx[q0]] * written[q0]
+                   * spec.sqrt_weights()[fsl])
+        d = ((db_rows[[p_mesh, p_solo]].astype(np.float64)
+              - qv.astype(np.float64)) ** 2).sum(1)
+        scale = (qv.astype(np.float64) ** 2).sum() + max(
+            (db_rows[p_mesh].astype(np.float64) ** 2).sum(),
+            (db_rows[p_solo].astype(np.float64) ** 2).sum())
+        assert abs(d[0] - d[1]) <= 2e-6 * scale, (
+            f"first divergence at {q0} is not a tie: {d}")

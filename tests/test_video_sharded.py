@@ -73,7 +73,7 @@ def test_sharded_video_does_not_retrace():
     video_analogy(a, ap, frames, p)
     mesh = make_mesh(db_shards=2, data_shards=2)
     step = _cached_multichip_step(mesh, "batched", True,
-                                  jax.lax.Precision.DEFAULT)
+                                  jax.lax.Precision.DEFAULT, False, False)
     before = step._cache_size()
     assert before > 0  # the run above used this cached jit
     video_analogy(a, ap, frames, p)
