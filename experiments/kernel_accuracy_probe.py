@@ -84,7 +84,7 @@ def main() -> int:
                 qc = qj[c0:c0 + 256] - db.feat_mean[None, :qj.shape[1]]
                 outs.append(prepadded_argmin2_queries(
                     qc, db.db_pad, db.dbn_pad,
-                    tile_n=_tile_rows(qj.shape[1], 2), q_split=q_split))
+                    tile_n=_tile_rows(qj.shape[1]) // 2, q_split=q_split))
             i1 = jnp.concatenate([o[0] for o in outs])
             i2 = jnp.concatenate([o[1] for o in outs])
             ok2 = jnp.concatenate([o[2] for o in outs])

@@ -58,7 +58,7 @@ def main() -> int:
     ap_args.add_argument("--oracle-max", type=int, default=256,
                          help="run the live CPU oracle up to this size")
     ap_args.add_argument("--modes",
-                         default="two_pass,two_pass_1p,exact_hi")
+                         default="auto,exact_hi2_2p,exact_hi")
     args = ap_args.parse_args()
 
     import jax

@@ -1193,12 +1193,14 @@ class TpuMatcher(Matcher):
             # real-TPU wavefront meshes scan with the packed 2-pass
             # kernel per shard (the same exact_hi2_2p parity scan as the
             # single chip); CPU/virtual meshes keep the exact XLA path.
-            # match_mode steering is honored: explicit exact_hi* pins the
-            # HIGHEST merged scan, and auto applies the same per-level
-            # DB-size crossover as the single-chip hybrid.
+            # match_mode steering is honored: only auto (above the
+            # single-chip DB-size crossover) and explicit exact_hi2_2p
+            # pack — every other mode, including exact_hi2 (whose 3-pass
+            # product set has no mesh kernel), pins the HIGHEST merged
+            # scan.
             mm = self.params.match_mode
             packed = (on_tpu and strategy == "wavefront"
-                      and mm in ("auto", "exact_hi2", "exact_hi2_2p")
+                      and mm in ("auto", "exact_hi2_2p")
                       and (mm != "auto" or ha * wa >= 131072))
             (db_sharded, dbn_sharded, afilt_sharded, w1, w2, dbnh,
              shift) = build_sharded_db(
