@@ -64,13 +64,25 @@ def input_digest(a, ap, b) -> str:
     return h.hexdigest()[:16]
 
 
-def _run_tpu(a, ap, b, params, keep_levels=False):
+def _run_tpu(a, ap, b, params, keep_levels=False, reps=3):
+    """Warm once, time ``reps`` runs, report the MINIMUM (the schedulable
+    floor).  The PJRT tunnel on this box shows +-35% run-to-run wall-clock
+    variance on IDENTICAL compiled programs (measured round 3: 7.5 s and
+    11.3 s for the same north-star binary within the hour), so a single
+    draw measures the infrastructure's mood, not the program; min-of-N is
+    the same provenance rule the cached oracle numbers use
+    (experiments/oracle_1024.py).  All parity fields come from the last
+    run's output (every run computes the same planes)."""
     from image_analogies_tpu.models.analogy import create_image_analogy
 
     create_image_analogy(a, ap, b, params)  # compile warm-up
-    t0 = time.perf_counter()
-    res = create_image_analogy(a, ap, b, params, keep_levels=keep_levels)
-    return res, time.perf_counter() - t0
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        res = create_image_analogy(a, ap, b, params,
+                                   keep_levels=keep_levels)
+        best = min(best, time.perf_counter() - t0)
+    return res, best
 
 
 def main() -> int:
@@ -117,10 +129,15 @@ def main() -> int:
     p = AnalogyParams(levels=3, kappa=5.0, backend="tpu",
                       strategy="wavefront")
     res_tpu, tpu_s = _run_tpu(a, ap, b, p, keep_levels=True)
-    t0 = time.perf_counter()
-    res_cpu = create_image_analogy(a, ap, b, p.replace(backend="cpu"),
-                                   keep_levels=True)
-    cpu_s = time.perf_counter() - t0
+    # the live oracle gets the same min-of-N floor treatment as the TPU
+    # side (review round 3: a single slow CPU draw against a best-of-3 TPU
+    # time would inflate the speedup)
+    cpu_s = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        res_cpu = create_image_analogy(a, ap, b, p.replace(backend="cpu"),
+                                       keep_levels=True)
+        cpu_s = min(cpu_s, time.perf_counter() - t0)
     configs["oil_256"] = {
         "tpu_s": round(tpu_s, 3),
         "cpu_oracle_s": round(cpu_s, 1),

@@ -831,6 +831,18 @@ def _run_batched(db: TpuLevelDB, kappa_mult):
 _RESCUE_T = 8
 
 
+def packed_scan_eligible(match_mode: str, na_rows: int) -> bool:
+    """THE steering predicate for the packed 2-pass parity scan, shared by
+    the single-chip auto resolution and BOTH sharded paths (image and
+    video) so the eligible-mode set and the measured ~131072-row DB-size
+    crossover can never drift between them: auto packs above the
+    crossover; explicit exact_hi2_2p always packs; every other mode
+    (including exact_hi2, whose 3-pass set has no mesh kernel) pins the
+    HIGHEST merged scan on meshes."""
+    return (match_mode in ("auto", "exact_hi2_2p")
+            and (match_mode != "auto" or na_rows >= 131072))
+
+
 def _scan_tile(npad: int, fp: int) -> int:
     """Tile rows for the per-tile champion scans over an (npad, fp) padded
     DB: the largest power of two that (a) divides npad, (b) fits the VMEM
@@ -1126,9 +1138,11 @@ class TpuMatcher(Matcher):
         pad_full = strategy == "wavefront"
         sharded = (self.params.db_shards > 1
                    and strategy in ("batched", "wavefront"))
-        # anchor mode (wavefront only): the sharded mesh step always scans
-        # at HIGHEST (parallel/step.py), so two_pass resolves only for the
-        # single-chip Pallas path.
+        # anchor mode (wavefront only).  The sharded mesh step picks its
+        # OWN scan via the `packed` gate below (packed 2-pass when
+        # packed_scan_eligible, HIGHEST merged otherwise) — the template's
+        # match_mode is forced to exact_hi there only so the single-chip
+        # pad machinery stays off.
         mode = self.params.match_mode
         if mode == "auto":
             # Per-level choice between the two fp32-grade PARITY scans.
@@ -1193,15 +1207,10 @@ class TpuMatcher(Matcher):
             # real-TPU wavefront meshes scan with the packed 2-pass
             # kernel per shard (the same exact_hi2_2p parity scan as the
             # single chip); CPU/virtual meshes keep the exact XLA path.
-            # match_mode steering is honored: only auto (above the
-            # single-chip DB-size crossover) and explicit exact_hi2_2p
-            # pack — every other mode, including exact_hi2 (whose 3-pass
-            # product set has no mesh kernel), pins the HIGHEST merged
-            # scan.
-            mm = self.params.match_mode
+            # One steering predicate shared with the video mesh path.
             packed = (on_tpu and strategy == "wavefront"
-                      and mm in ("auto", "exact_hi2_2p")
-                      and (mm != "auto" or ha * wa >= 131072))
+                      and packed_scan_eligible(self.params.match_mode,
+                                               ha * wa))
             (db_sharded, dbn_sharded, afilt_sharded, w1, w2, dbnh,
              shift) = build_sharded_db(
                 spec, to_j(job.a_src), to_j(job.a_filt),

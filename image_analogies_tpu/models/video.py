@@ -179,14 +179,15 @@ def _sharded_phase(a, ap, frames, params: AnalogyParams, mesh,
             tile = _tile_rows(spec.total) if not force_xla else 1
             # real-TPU wavefront meshes scan with the packed 2-pass kernel
             # per shard (same parity class as exact_hi2_2p, ~2x fewer MXU
-            # passes); CPU/virtual meshes keep the exact XLA path.  Same
-            # steering rule as the sharded image path: only auto (above
-            # the DB-size crossover) and explicit exact_hi2_2p pack.
-            na_rows = job0.a_shape[0] * job0.a_shape[1]
+            # passes); CPU/virtual meshes keep the exact XLA path.  ONE
+            # steering predicate shared with the sharded image path.
+            from image_analogies_tpu.backends.tpu import \
+                packed_scan_eligible
+
             packed = (strategy == "wavefront" and not force_xla
-                      and params.match_mode in ("auto", "exact_hi2_2p")
-                      and (params.match_mode != "auto"
-                           or na_rows >= 131072))
+                      and packed_scan_eligible(
+                          params.match_mode,
+                          job0.a_shape[0] * job0.a_shape[1]))
             dbp, dbnp, afp, w1, w2, dbnh, _shift = build_sharded_db(
                 spec, to_j(job0.a_src), to_j(job0.a_filt),
                 to_j(job0.a_src_coarse), to_j(job0.a_filt_coarse),
