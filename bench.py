@@ -65,24 +65,26 @@ def input_digest(a, ap, b) -> str:
 
 
 def _run_tpu(a, ap, b, params, keep_levels=False, reps=3):
-    """Warm once, time ``reps`` runs, report the MINIMUM (the schedulable
-    floor).  The PJRT tunnel on this box shows +-35% run-to-run wall-clock
-    variance on IDENTICAL compiled programs (measured round 3: 7.5 s and
-    11.3 s for the same north-star binary within the hour), so a single
-    draw measures the infrastructure's mood, not the program; min-of-N is
-    the same provenance rule the cached oracle numbers use
-    (experiments/oracle_1024.py).  All parity fields come from the last
-    run's output (every run computes the same planes)."""
+    """Warm once, time ``reps`` runs, report (min, median).  The PJRT
+    tunnel on this box shows +-35% run-to-run wall-clock variance on
+    IDENTICAL compiled programs (measured round 3: 7.5 s and 11.3 s for
+    the same north-star binary within the hour), so a single draw measures
+    the infrastructure's mood, not the program.  The MINIMUM (the
+    schedulable floor, same provenance rule as the cached oracle numbers —
+    experiments/oracle_1024.py) stays the headline; the MEDIAN rides along
+    so the draw spread is visible in the one-line JSON (round-3 VERDICT
+    item 4).  All parity fields come from the last run's output (every run
+    computes the same planes)."""
     from image_analogies_tpu.models.analogy import create_image_analogy
 
     create_image_analogy(a, ap, b, params)  # compile warm-up
-    best = float("inf")
+    times = []
     for _ in range(reps):
         t0 = time.perf_counter()
         res = create_image_analogy(a, ap, b, params,
                                    keep_levels=keep_levels)
-        best = min(best, time.perf_counter() - t0)
-    return res, best
+        times.append(time.perf_counter() - t0)
+    return res, min(times), float(np.median(times))
 
 
 def main() -> int:
@@ -128,7 +130,7 @@ def main() -> int:
     a, ap, b = make_structured(256)
     p = AnalogyParams(levels=3, kappa=5.0, backend="tpu",
                       strategy="wavefront")
-    res_tpu, tpu_s = _run_tpu(a, ap, b, p, keep_levels=True)
+    res_tpu, tpu_s, tpu_s_med = _run_tpu(a, ap, b, p, keep_levels=True)
     # the live oracle gets the same min-of-N floor treatment as the TPU
     # side (review round 3: a single slow CPU draw against a best-of-3 TPU
     # time would inflate the speedup)
@@ -140,6 +142,7 @@ def main() -> int:
         cpu_s = min(cpu_s, time.perf_counter() - t0)
     configs["oil_256"] = {
         "tpu_s": round(tpu_s, 3),
+        "tpu_s_median": round(tpu_s_med, 3),
         "cpu_oracle_s": round(cpu_s, 1),
         "speedup": round(cpu_s / tpu_s, 1),
         **_parity_fields(res_tpu, res_cpu.bp_y, res_cpu.source_map),
@@ -183,10 +186,11 @@ def main() -> int:
         p = AnalogyParams(levels=ocfg["config"]["levels"],
                           kappa=ocfg["config"]["kappa"], backend="tpu",
                           strategy="wavefront")
-        res_ns, ns_s = _run_tpu(a, ap, b, p, keep_levels=True)
+        res_ns, ns_s, ns_s_med = _run_tpu(a, ap, b, p, keep_levels=True)
         oracle_s = float(ocfg["wall_s"])
         rec = {
             "tpu_s": round(ns_s, 3),
+            "tpu_s_median": round(ns_s_med, 3),
             "cpu_oracle_s": oracle_s,
             "speedup": round(oracle_s / ns_s, 1),
             **_parity_fields(res_ns, oz["bp_y"], oz["source_map"]),
@@ -199,8 +203,8 @@ def main() -> int:
             rec.update(_audit_fields(a, ap, b, p, res_ns, o_levels))
         configs[f"north_star_1024_seed{seed}"] = rec
         if ns_headline is None:
-            ns_headline = (ns_s, oracle_s, rec)
-    ns_s, oracle_s, ns_rec = ns_headline
+            ns_headline = (ns_s, ns_s_med, oracle_s, rec)
+    ns_s, ns_s_med, oracle_s, ns_rec = ns_headline
     ns_ssim = ns_rec["ssim_vs_oracle"]
     ns_match = ns_rec["value_match"]
 
@@ -209,6 +213,7 @@ def main() -> int:
                   "kappa=5 (north-star config), wavefront oracle-parity "
                   f"strategy on {dev}",
         "value": round(ns_s, 3),
+        "value_median": round(ns_s_med, 3),
         "unit": "s",
         "vs_baseline": round(oracle_s / ns_s, 1),
         "ssim_vs_oracle": round(ns_ssim, 4),

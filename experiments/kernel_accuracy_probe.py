@@ -16,6 +16,10 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# this probe exists to measure the gated non-parity modes; override even
+# an inherited falsey value — the gate guards users, not measurement
+os.environ["IA_EXPERIMENTAL"] = "1"
+
 import numpy as np
 
 import jax

@@ -1,0 +1,254 @@
+"""Decompose the production wavefront STEP at north-star scale (round-4
+VERDICT item 1 / item 8 groundwork): time every piece of one anti-diagonal
+step — query build, anchor packing, the packed scan kernel (and the round-4
+fusion candidates), champion select, fp32 re-score, coherence block,
+scatter — each as a loop-carried on-chip fori_loop, so the sum can be
+compared against the real per-step cost and against the HBM roofline.
+
+Fusion candidates measured against the shipping exact_hi2_2p scan:
+  packed2            - shipping kernel: per-tile champions + XLA select
+  packed2_best       - champion folded into kernel scratch (no (M, ntiles)
+                       projection table, no XLA select)
+  packed1w_best      - single-weight-stream variant: HALF the HBM bytes
+                       (product set drops the ~2^-16 q1.d3 term; parity
+                       adjudicated separately by the tie-audit)
+
+    python experiments/step_decompose_probe.py [--size 1024] [--iters 100]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from examples.make_assets import make_structured
+from image_analogies_tpu.backends.base import LevelJob
+from image_analogies_tpu.backends.tpu import (
+    TpuMatcher,
+    _batched_coherence,
+    _scan_tile,
+    make_anchor_fn,
+)
+from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.ops import color
+from image_analogies_tpu.ops.features import spec_for_level
+from image_analogies_tpu.ops.pallas_match import (
+    bf16_split3,
+    packed1w_best,
+    packed2_best,
+    packed2_champions,
+)
+
+_F32 = jnp.float32
+
+
+def bench_loop(body, carry_init, args_tuple, iters, reps=3):
+    """Time `body` inside one on-device fori_loop (one dispatch per rep —
+    the PJRT tunnel costs ~100 ms per dispatch, so per-call costs must be
+    amortized over >= ~100 in-loop iterations).  `body(i, carry, *args)`
+    returns the new carry; arrays ride as jit ARGUMENTS (closure constants
+    blow the remote-compile payload limit)."""
+
+    def run(carry0, *arrs):
+        def f(i, c):
+            return body(i, c, *arrs)
+
+        return jax.lax.fori_loop(0, iters, f, carry0)
+
+    jrun = jax.jit(run)
+    jax.block_until_ready(jrun(carry_init, *args_tuple))  # compile
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(jrun(carry_init, *args_tuple))
+        ts.append(time.perf_counter() - t0)
+    return min(ts) / iters
+
+
+def main() -> int:
+    pa = argparse.ArgumentParser()
+    pa.add_argument("--size", type=int, default=1024)
+    pa.add_argument("--iters", type=int, default=100)
+    pa.add_argument("--cases", default="all")
+    args = pa.parse_args()
+
+    print(f"# backend={jax.default_backend()} "
+          f"dev={jax.devices()[0].device_kind}", file=sys.stderr)
+
+    a, ap, b = make_structured(args.size)
+    params = AnalogyParams(levels=1, backend="tpu", strategy="wavefront",
+                           match_mode="exact_hi2_2p")
+    spec = spec_for_level(params, 0, 1, 1)
+    a_src, a_filt, b_src = (color.luminance(a), color.luminance(ap),
+                            color.luminance(b))
+    a_src, a_filt = color.remap_pair(a_src, a_filt, b_src)
+    job = LevelJob(level=0, spec=spec,
+                   kappa_mult=params.kappa_factor(0) ** 2,
+                   a_src=a_src, a_filt=a_filt, b_src=b_src)
+    db = TpuMatcher(params).build_features(job)
+    km = jnp.float32(job.kappa_mult)
+
+    hb, wb = db.hb, db.wb
+    ha, wa = db.ha, db.wa
+    na = ha * wa
+    nb = hb * wb
+    nf = int(db.off.shape[0])
+    c = spec.fine_size // 2 + 1
+    m = min(hb, (wb + c - 1) // c)  # plateau diagonal width
+    m = (m + 7) // 8 * 8
+    f = int(db.static_q.shape[1])
+    npad, kp = db.db_pad.shape
+    tile = _scan_tile(npad, kp)
+    ntiles = npad // tile
+    live = int(db.live_idx.shape[0])
+
+    rng = np.random.default_rng(0)
+    # a mid-scan state snapshot: random but realistic shapes/values
+    pix = jnp.asarray(
+        np.sort(rng.choice(nb, size=m, replace=False)).astype(np.int32))
+    bp0 = jnp.asarray(rng.random(nb, dtype=np.float32))
+    s0 = jnp.asarray(rng.integers(0, na, nb).astype(np.int32))
+    q0 = jnp.asarray(rng.random((m, f), dtype=np.float32) * 0.3)
+    p0 = jnp.asarray(rng.integers(0, na, m).astype(np.int32))
+    tv0 = jnp.asarray(rng.random((m, ntiles), dtype=np.float32))
+    ti0 = jnp.asarray(
+        rng.integers(0, npad, (m, ntiles)).astype(np.int32))
+
+    off_i = db.off[:, 0][None, :]
+    off_j = db.off[:, 1][None, :]
+    causal_off = (off_i < 0) | ((off_i == 0) & (off_j < 0))
+
+    dep = lambda x: (x.reshape(-1)[0].astype(_F32) * 1e-30)
+
+    def qbuild(i, carry, static_q, bp, sqrtw):
+        """Window-index iota math + bp gather + static_q gather + splice."""
+        q, acc = carry
+        pixc = pix + (acc % 2)  # loop-carried dependency
+        qi = pixc // wb
+        qj = pixc - qi * wb
+        wi = qi[:, None] + off_i
+        wj = qj[:, None] + off_j
+        idx = (jnp.clip(wi, 0, hb - 1) * wb + jnp.clip(wj, 0, wb - 1))
+        written = (causal_off & (idx < pixc[:, None])).astype(_F32)
+        dyn = bp[idx] * written * sqrtw[None, :]
+        queries = jax.lax.dynamic_update_slice(
+            static_q[pixc], dyn, (0, db.fine_start))
+        return queries, acc + queries.reshape(-1)[0].astype(jnp.int32) % 1
+
+    def pack(i, carry, feat_mean, live_idx):
+        q, acc = carry
+        qc = q - feat_mean[None, :f]
+        g1, g2, _ = bf16_split3(qc[:, live_idx])
+        q1 = g1.astype(jnp.bfloat16)
+        q2 = g2.astype(jnp.bfloat16)
+        out = q1[0, 0].astype(_F32) + q2[0, 0].astype(_F32)
+        return q.at[0, 0].add(out * 1e-30), acc
+
+    def mk_kernel_case(fn):
+        def body(i, carry, w1, w2, dbnh, feat_mean, live_idx):
+            q, acc = carry
+            qc = q - feat_mean[None, :f]
+            g1, g2, _ = bf16_split3(qc[:, live_idx])
+            out = fn(g1.astype(jnp.bfloat16), g2.astype(jnp.bfloat16),
+                     w1, w2, dbnh)
+            return q.at[0, 0].add(dep(out)), acc
+        return body
+
+    def champ_select(i, carry, tv, ti):
+        q, acc = carry
+        vals = tv + q[0, 0] * 1e-30
+        k = jnp.argmax(vals, axis=1)
+        p = jnp.minimum(
+            jnp.take_along_axis(ti, k[:, None], axis=1)[:, 0], na - 1)
+        return q.at[0, 0].add(dep(p)), acc
+
+    def rescore(i, carry, dbf):
+        q, acc = carry
+        p = (p0 + acc) % na
+        d = jnp.sum((dbf[p] - q) ** 2, axis=1)
+        return q.at[0, 0].add(dep(d)), acc
+
+    def coherence(i, carry, dbf, s):
+        q, acc = carry
+        pixc = pix
+        qi = pixc // wb
+        qj = pixc - qi * wb
+        wi = qi[:, None] + off_i
+        wj = qj[:, None] + off_j
+        inb = (wi >= 0) & (wi < hb) & (wj >= 0) & (wj < wb)
+        idx = (jnp.clip(wi, 0, hb - 1) * wb + jnp.clip(wj, 0, wb - 1))
+        qq = q + acc.astype(_F32) * 1e-30
+        p_coh, d_coh, has = _batched_coherence(
+            db, s, qq, idx, inb & causal_off, nf, lambda i_: dbf[i_])
+        return q.at[0, 0].add(dep(d_coh)), acc
+
+    def scatter(i, carry, afilt):
+        bp, acc = carry
+        p = (p0 + acc) % na
+        bp = bp.at[pix].set(afilt[p], mode="drop")
+        return bp, acc + 1
+
+    def anchor_full(i, carry, *arrs):
+        q, acc = carry
+        p, d = anchor_fn(q + acc.astype(_F32) * 0.0)
+        return q.at[0, 0].add(dep(d)), acc
+
+    anchor_fn = make_anchor_fn(db)
+
+    t2 = _scan_tile(npad, kp)
+    cases = {
+        "qbuild": (qbuild, (q0, jnp.int32(0)),
+                   (db.static_q, bp0, db.fine_sqrtw)),
+        "pack": (pack, (q0, jnp.int32(0)), (db.feat_mean, db.live_idx)),
+        "packed2": (mk_kernel_case(
+            lambda q1, q2, w1, w2, dn: packed2_champions(
+                q1, q2, w1, w2, dn, tile_n=t2)[0]),
+            (q0, jnp.int32(0)),
+            (db.db_pad, db.db_pad2, db.dbnh_pad, db.feat_mean, db.live_idx)),
+        "packed2_best": (mk_kernel_case(
+            lambda q1, q2, w1, w2, dn: packed2_best(
+                q1, q2, w1, w2, dn, tile_n=t2)[0]),
+            (q0, jnp.int32(0)),
+            (db.db_pad, db.db_pad2, db.dbnh_pad, db.feat_mean, db.live_idx)),
+        "packed1w_best": (mk_kernel_case(
+            lambda q1, q2, w1, w2, dn: packed1w_best(
+                q1, q2, w1, dn, tile_n=t2)[0]),
+            (q0, jnp.int32(0)),
+            (db.db_pad, db.db_pad2, db.dbnh_pad, db.feat_mean, db.live_idx)),
+        "champ_select": (champ_select, (q0, jnp.int32(0)), (tv0, ti0)),
+        "rescore": (rescore, (q0, jnp.int32(0)), (db.db,)),
+        "coherence": (coherence, (q0, jnp.int32(0)), (db.db, s0)),
+        "scatter": (scatter, (bp0, jnp.int32(0)), (db.a_filt_flat,)),
+        "anchor_full": (anchor_full, (q0, jnp.int32(0)), ()),
+    }
+    rec = {"size": args.size, "m": m, "na": na, "npad": npad, "kp": kp,
+           "tile": tile, "ntiles": ntiles, "live": live,
+           "iters": args.iters}
+    # rooflines (v5e-class numbers: ~820 GB/s HBM, ~394 TF/s bf16)
+    bytes_2stream = 2 * npad * kp * 2
+    rec["scan_bytes_2stream_mb"] = round(bytes_2stream / 1e6, 1)
+    rec["roofline_2stream_us"] = round(bytes_2stream / 820e9 * 1e6, 1)
+    rec["roofline_1stream_us"] = round(bytes_2stream / 2 / 820e9 * 1e6, 1)
+
+    names = (list(cases) if args.cases == "all" else args.cases.split(","))
+    for name in names:
+        body, carry, arrs = cases[name]
+        us = bench_loop(body, carry, arrs, args.iters) * 1e6
+        rec[name + "_us"] = round(us, 1)
+        print(f"# {name}: {us:.1f} us/step", file=sys.stderr, flush=True)
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -66,6 +66,10 @@ def main() -> int:
     print(f"# backend={jax.default_backend()} "
           f"dev={jax.devices()[0].device_kind}", file=sys.stderr)
 
+    # a user-supplied --modes list may name the gated experimental probes;
+    # override even an inherited falsey value (the gate guards users, not
+    # measurement)
+    os.environ["IA_EXPERIMENTAL"] = "1"
     modes = args.modes.split(",")
     for size in [int(s) for s in args.sizes.split(",")]:
         levels = 5 if size >= 1024 else 3

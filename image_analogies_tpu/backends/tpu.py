@@ -830,17 +830,26 @@ def _run_batched(db: TpuLevelDB, kappa_mult):
 # the top-T tile champions by scan score.
 _RESCUE_T = 8
 
+# match_mode="auto" DB-size crossover between the two parity scans: packed
+# 2-pass (exact_hi2_2p) at or above this many DB rows, merged HIGHEST
+# (exact_hi) below — measured round 3 (256^2 levels: exact_hi faster;
+# 512^2 level 0: packed faster).  The ONE definition read by the
+# single-chip auto resolution AND packed_scan_eligible (round-3 ADVICE:
+# the two sites must not carry separate literals).
+_PACKED_CROSSOVER_ROWS = 131072
+
 
 def packed_scan_eligible(match_mode: str, na_rows: int) -> bool:
     """THE steering predicate for the packed 2-pass parity scan, shared by
     the single-chip auto resolution and BOTH sharded paths (image and
-    video) so the eligible-mode set and the measured ~131072-row DB-size
-    crossover can never drift between them: auto packs above the
-    crossover; explicit exact_hi2_2p always packs; every other mode
-    (including exact_hi2, whose 3-pass set has no mesh kernel) pins the
-    HIGHEST merged scan on meshes."""
+    video) so the eligible-mode set and the measured DB-size crossover
+    (`_PACKED_CROSSOVER_ROWS`) can never drift between them: auto packs
+    above the crossover; explicit exact_hi2_2p always packs; every other
+    mode (including exact_hi2, whose 3-pass set has no mesh kernel) pins
+    the HIGHEST merged scan on meshes."""
     return (match_mode in ("auto", "exact_hi2_2p")
-            and (match_mode != "auto" or na_rows >= 131072))
+            and (match_mode != "auto"
+                 or na_rows >= _PACKED_CROSSOVER_ROWS))
 
 
 def _scan_tile(npad: int, fp: int) -> int:
@@ -855,9 +864,12 @@ def _scan_tile(npad: int, fp: int) -> int:
     DB), and the VMEM cap for wide packed features (_tile_rows(fp)//2) need
     not be a power of two — so both are snapped down to powers of two
     before taking the min, which then always divides npad."""
-    p2_npad = npad & (-npad)  # largest power of 2 dividing npad (>= 256:
-    # build pads are multiples of 256 — _tile_rows and the small-DB round
-    # in build_features both guarantee it)
+    p2_npad = npad & (-npad)  # largest power of 2 dividing npad.  On the
+    # single-chip TPU geometries this is >= 256 (build pads are multiples
+    # of 256 — _tile_rows and the small-DB round in build_features); mesh
+    # geometries (sharded_pad_geometry caps at round_up(per_shard, 128))
+    # and CPU-test tile=1 pads can leave only 128 or less — the final tile
+    # then simply equals p2_npad, which always divides npad.
     cap = max(_tile_rows(fp) // 2, 256)
     cap = 1 << (cap.bit_length() - 1)  # snap down to a power of 2
     tile = min(cap, p2_npad, npad)
@@ -1145,6 +1157,8 @@ class TpuMatcher(Matcher):
         # pad machinery stays off.
         mode = self.params.match_mode
         if mode == "auto":
+            # (crossover constant: _PACKED_CROSSOVER_ROWS — shared with
+            # packed_scan_eligible, the mesh paths' steering predicate)
             # Per-level choice between the two fp32-grade PARITY scans.
             # Only fp32-grade holds index-level oracle parity: measured
             # (experiments/rescue_probe.py), every bf16-resolution scheme
@@ -1165,7 +1179,8 @@ class TpuMatcher(Matcher):
             # fully tie-explained (256^2: explained=1.0, unexplained=0,
             # max band 6.3e-7; 1024^2 evidence in BENCH_r03) at ~1.2x
             # less wall-clock.
-            mode = "exact_hi2_2p" if ha * wa >= 131072 else "exact_hi"
+            mode = ("exact_hi2_2p"
+                    if ha * wa >= _PACKED_CROSSOVER_ROWS else "exact_hi")
         if sharded:
             mode = "exact_hi"
         if strategy != "wavefront":

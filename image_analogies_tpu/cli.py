@@ -47,17 +47,24 @@ def _add_engine_flags(p: argparse.ArgumentParser) -> None:
                         "approximate (non-parity) synthesis.  exact/rowwise: "
                         "sequential VALIDATION seams, ~100-1000x slower — "
                         "never for production runs")
+    from image_analogies_tpu.config import (
+        EXPERIMENTAL_MATCH_MODES,
+        PARITY_MATCH_MODES,
+        experimental_enabled,
+    )
+
+    mm_choices = PARITY_MATCH_MODES
+    if experimental_enabled():
+        mm_choices = mm_choices + EXPERIMENTAL_MATCH_MODES
     p.add_argument("--match-mode",
-                   choices=("auto", "exact_hi", "exact_hi2", "exact_hi2_2p",
-                            "scan_rescue", "scan_rescue_1p",
-                            "two_pass", "two_pass_1p"),
+                   choices=mm_choices,
                    default=None,
                    help="wavefront anchor scheme (auto = the parity "
                         "hybrid: exact_hi2_2p's packed fp32-grade scan "
                         "on large levels, exact_hi's merged kernel below "
-                        "the measured crossover; scan_rescue/two_pass* "
-                        "are approximate A/B points — see "
-                        "config.AnalogyParams)")
+                        "the measured crossover).  All listed modes hold "
+                        "oracle parity; non-parity A/B probes appear only "
+                        "with IA_EXPERIMENTAL=1 — see config.AnalogyParams")
     p.add_argument("--db-shards", type=int, default=None)
     p.add_argument("--data-shards", type=int, default=None,
                    help="video mode: shard frames over this many mesh "

@@ -205,7 +205,15 @@ def create_image_analogy(
             # bp/s may be DEVICE arrays (TPU backend): levels chain through
             # them without host round-trips (the tunnel moves ~9 MB/s);
             # host copies are fetched only for opt-in host consumers below
-            # and for the final result.
+            # and for the final result.  EXCEPT with level retries armed:
+            # the §5.3 fault model promises a retried level rebuilds from
+            # buffers that survive a device reset, and the coarser plane
+            # chained on-device could be invalidated by the very fault
+            # being retried — so fault-recovery runs keep the pre-chaining
+            # host copies (round-3 ADVICE item 1).
+            if params.level_retries > 0:
+                bp, s = (np.asarray(bp, np.float32),
+                         np.asarray(s, np.int32))
             bp_pyr[level], s_pyr[level] = bp, s
             if params.log_path or "_n_coh" not in st:
                 # stream the record now: always when a log file is

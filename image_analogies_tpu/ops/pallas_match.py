@@ -510,9 +510,161 @@ def pallas_packed_champions(
     return vals, idx
 
 
+def _packed_best_kernel(qa_ref, qb_ref, w1_ref, w2_ref, dbnh_ref, idx_out,
+                        val_out, best_val, best_idx, *, tile_n: int,
+                        fold_a: bool, one_stream: bool):
+    """Running-champion variant of `_packed_kernel`: the same packed MXU
+    product sets, but the cross-tile champion is folded into VMEM scratch
+    inside the kernel (strict > on the scan score keeps ties lowest-index,
+    matching `jnp.argmax`-then-first-occurrence semantics of the per-tile
+    variant), so the kernel emits the FINAL (idx, val) per query — no
+    (ntiles, M) projection table, no XLA champion select over ~128-256
+    tiles after it (round-4 fusion work, VERDICT item 1).
+
+    ``one_stream``: read only W1 and score qa against it (qb_ref/w2_ref
+    are ignored 1-row stubs) — the single-weight-stream product set
+    q1.d1 + q1.d2 + q2.d1 via row-blocks [q1|q1], [q2|0] against
+    W = [d1|d2], HALF the HBM bytes of the two-stream scan."""
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        best_val[:] = jnp.full_like(best_val, -jnp.inf)
+        best_idx[:] = jnp.zeros_like(best_idx)
+
+    dots = jax.lax.dot_general(
+        qa_ref[:], w1_ref[:],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=_F32)
+    if fold_a:
+        m = dots.shape[0] // 2
+        dots = dots[:m] + dots[m:]
+    if not one_stream:
+        dots = dots + jax.lax.dot_general(
+            qb_ref[:], w2_ref[:],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=_F32)
+    s2 = dots - dbnh_ref[:]
+    part_val = jnp.max(s2, axis=1, keepdims=True)
+    part_idx = (jnp.argmax(s2, axis=1).astype(jnp.int32)[:, None]
+                + t * s2.shape[1])
+    improve = part_val > best_val[:]  # strict: earlier tile wins ties
+    best_idx[:] = jnp.where(improve, part_idx, best_idx[:])
+    best_val[:] = jnp.where(improve, part_val, best_val[:])
+
+    @pl.when(t == pl.num_programs(0) - 1)
+    def _flush():
+        idx_out[:] = best_idx[:]
+        val_out[:] = best_val[:]
+
+
+@functools.partial(jax.jit, static_argnames=("tile_n", "fold_a",
+                                             "one_stream", "interpret"))
+def pallas_packed_best(
+    qa: jax.Array,  # (Mp or 2Mp, Kp) bf16 row-blocks against W1
+    qb: jax.Array,  # (Mp, Kp) bf16 against W2 (1-row stub if one_stream)
+    w1: jax.Array,  # (Npad, Kp) bf16
+    w2: jax.Array,  # (Npad, Kp) bf16 (1-row stub if one_stream)
+    dbnh: jax.Array,  # (1, Npad) fp32 half norms, +inf on padding
+    *,
+    tile_n: int,
+    fold_a: bool,
+    one_stream: bool = False,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Entry for `_packed_best_kernel`; returns (idx (Mp,), val (Mp,)) —
+    the global scan champion per query, ties lowest-index."""
+    npad, kp = w1.shape
+    tile_n = min(tile_n, npad)
+    assert npad % tile_n == 0, (npad, tile_n)
+    qm, mp = qa.shape[0], (qa.shape[0] // 2 if fold_a else qa.shape[0])
+    grid = npad // tile_n
+    qb_spec = (pl.BlockSpec((qb.shape[0], qb.shape[1]), lambda t: (0, 0),
+                            memory_space=pltpu.VMEM))
+    w2_spec = (pl.BlockSpec((1, kp), lambda t: (0, 0),
+                            memory_space=pltpu.VMEM) if one_stream else
+               pl.BlockSpec((tile_n, kp), lambda t: (t, 0),
+                            memory_space=pltpu.VMEM))
+    passes = (2 if fold_a else 1) + (0 if one_stream else 1)
+    streams = 1 if one_stream else 2
+    idx, val = pl.pallas_call(
+        functools.partial(_packed_best_kernel, tile_n=tile_n, fold_a=fold_a,
+                          one_stream=one_stream),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((qm, kp), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            qb_spec,
+            pl.BlockSpec((tile_n, kp), lambda t: (t, 0),
+                         memory_space=pltpu.VMEM),
+            w2_spec,
+            pl.BlockSpec((1, tile_n), lambda t: (0, t),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[pl.BlockSpec((mp, 1), lambda t: (0, 0),
+                                memory_space=pltpu.VMEM)] * 2,
+        out_shape=[
+            jax.ShapeDtypeStruct((mp, 1), jnp.int32),
+            jax.ShapeDtypeStruct((mp, 1), _F32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((mp, 1), _F32),
+            pltpu.VMEM((mp, 1), jnp.int32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * passes * mp * kp * npad,
+            bytes_accessed=streams * npad * kp * 2 + (qm + qb.shape[0]) * kp * 2
+            + mp * 8,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(qa, qb, w1, w2, dbnh)
+    return idx[:, 0], val[:, 0]
+
+
 def _pack_rows(left, right, m, l, kp):
     z = jnp.zeros((m, kp), jnp.bfloat16)
     return z.at[:, :l].set(left).at[:, l:2 * l].set(right)
+
+
+def packed2_best(q1, q2, w1, w2, dbnh, *, tile_n: int,
+                 interpret: bool = False):
+    """Champion-in-kernel twin of `packed2_champions` (same 2-pass product
+    set q1.d1 + q1.d2 + q2.d1 + q1.d3): returns the FINAL (idx (M,),
+    val (M,)) global scan champion — no (M, ntiles) projection table."""
+    m, l = q1.shape
+    kp = w1.shape[1]
+    mp = _round_up(max(m, 8), 16)
+    pad = lambda x: jnp.zeros((mp, l), jnp.bfloat16).at[:m].set(x)
+    q1, q2 = pad(q1), pad(q2)
+    idx, val = pallas_packed_best(
+        _pack_rows(q1, q1, mp, l, kp), _pack_rows(q2, q1, mp, l, kp),
+        w1, w2, dbnh, tile_n=min(tile_n, w1.shape[0]), fold_a=False,
+        interpret=interpret)
+    return idx[:m], val[:m]
+
+
+def packed1w_best(q1, q2, w1, dbnh, *, tile_n: int,
+                  interpret: bool = False):
+    """Single-weight-stream champion scan: product set
+    q1.d1 + q1.d2 + q2.d1 over ONE packed array W1 = [d1|d2] via folded
+    row-blocks [q1|q1] and [q2|0] — half the HBM bytes of the two-stream
+    scans (the one dropped ~2^-16 term vs packed2 is q1.d3; parity
+    adjudicated by the tie-audit before this mode is ever steered to).
+    Returns (idx (M,), val (M,))."""
+    m, l = q1.shape
+    kp = w1.shape[1]
+    mp = _round_up(max(m, 8), 16)
+    pad = lambda x: jnp.zeros((mp, l), jnp.bfloat16).at[:m].set(x)
+    q1, q2 = pad(q1), pad(q2)
+    qa = jnp.concatenate([_pack_rows(q1, q1, mp, l, kp),
+                          _pack_rows(q2, jnp.zeros_like(q2), mp, l, kp)],
+                         axis=0)
+    stub16 = jnp.zeros((1, kp), jnp.bfloat16)
+    idx, val = pallas_packed_best(
+        qa, stub16, w1, stub16, dbnh, tile_n=min(tile_n, w1.shape[0]),
+        fold_a=True, one_stream=True, interpret=interpret)
+    return idx[:m], val[:m]
 
 
 def packed2_champions(q1, q2, w1, w2, dbnh, *, tile_n: int,

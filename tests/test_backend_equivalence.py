@@ -145,3 +145,68 @@ def test_single_level_texture_by_numbers_tpu(rng):
     res = create_image_analogy(lab_a, tex, lab_b, p)
     assert res.bp.shape == (16, 16, 3)
     assert res.bp[:8].mean() < 0.5 < res.bp[8:].mean()
+
+
+def test_auto_match_mode_crossover():
+    """match_mode="auto" must resolve exact_hi2_2p at/above the measured
+    DB-size crossover and exact_hi below it, and the resolution must agree
+    with `packed_scan_eligible` — the steering predicate the mesh paths
+    share (round-3 ADVICE: the auto branch needs a committed test)."""
+    from image_analogies_tpu.backends.tpu import (
+        _PACKED_CROSSOVER_ROWS,
+        TpuMatcher,
+        packed_scan_eligible,
+    )
+
+    r = np.random.default_rng(3)
+    b = r.random((16, 16), dtype=np.float32)
+    p = AnalogyParams(levels=1, backend="tpu", strategy="wavefront",
+                      match_mode="auto")
+    # 256*512 = 131072 sits exactly ON the crossover (>= packs);
+    # 255*512 = 130560 sits below it
+    for (h, w), want in [((256, 512), "exact_hi2_2p"),
+                         ((255, 512), "exact_hi")]:
+        assert (h * w >= _PACKED_CROSSOVER_ROWS) == (want == "exact_hi2_2p")
+        a = r.random((h, w), dtype=np.float32)
+        ap = r.random((h, w), dtype=np.float32)
+        db = TpuMatcher(p).build_features(_job(a, ap, b, p))
+        assert db.match_mode == want, (h, w)
+        assert packed_scan_eligible("auto", h * w) == (want == "exact_hi2_2p")
+
+
+def test_experimental_match_modes_gated(monkeypatch):
+    """Non-parity A/B probe modes must not be selectable from the
+    production config surface (round-3 VERDICT item 7)."""
+    from image_analogies_tpu.config import EXPERIMENTAL_MATCH_MODES
+
+    monkeypatch.delenv("IA_EXPERIMENTAL", raising=False)
+    for mode in EXPERIMENTAL_MATCH_MODES:
+        with pytest.raises(ValueError, match="IA_EXPERIMENTAL"):
+            AnalogyParams(match_mode=mode)
+    # explicit falsey spellings keep the gate CLOSED
+    for off in ("0", "false", "no"):
+        monkeypatch.setenv("IA_EXPERIMENTAL", off)
+        with pytest.raises(ValueError, match="IA_EXPERIMENTAL"):
+            AnalogyParams(match_mode="two_pass")
+    monkeypatch.setenv("IA_EXPERIMENTAL", "1")
+    assert AnalogyParams(match_mode="two_pass").match_mode == "two_pass"
+
+
+def test_experimental_match_modes_hidden_from_cli(monkeypatch):
+    """--match-mode lists only parity modes unless IA_EXPERIMENTAL=1."""
+    from image_analogies_tpu.cli import build_parser
+
+    monkeypatch.delenv("IA_EXPERIMENTAL", raising=False)
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(
+            ["run", "--ap", "x.png", "--out", "y.png",
+             "--match-mode", "scan_rescue"])
+    ok = build_parser().parse_args(
+        ["run", "--ap", "x.png", "--out", "y.png",
+         "--match-mode", "exact_hi2_2p"])
+    assert ok.match_mode == "exact_hi2_2p"
+    monkeypatch.setenv("IA_EXPERIMENTAL", "1")
+    gated = build_parser().parse_args(
+        ["run", "--ap", "x.png", "--out", "y.png",
+         "--match-mode", "scan_rescue"])
+    assert gated.match_mode == "scan_rescue"

@@ -464,3 +464,62 @@ def test_packed2_reproduces_fourterm_product_set():
     # exact-hit duplicate pair resolves lowest-index after champion argmax
     pick = idx[np.arange(m), vals.argmax(1)]
     assert pick[3] == 100
+
+
+# ------------------------- round-4: champion-in-kernel + 1-stream variants
+
+
+def test_packed_best_matches_champion_select():
+    """`packed2_best` (champion folded into kernel scratch) must reproduce
+    the shipping per-tile-champions + XLA-select pipeline exactly,
+    including lowest-index ties; `packed1w_best` must compute exactly its
+    documented single-stream product set q1.d1 + q1.d2 + q2.d1."""
+    import jax.numpy as jnp
+
+    from image_analogies_tpu.ops.pallas_match import (
+        _round_up,
+        bf16_split3,
+        packed1w_best,
+        packed2_best,
+        packed2_champions,
+    )
+
+    rng = np.random.default_rng(0)
+    m, l, n, tile = 13, 55, 1024, 256
+    kp = _round_up(2 * l, 128)
+    x = rng.standard_normal((n, l)).astype(np.float32) * 0.1
+    x[5] = x[3]  # exact duplicate rows: ties must stay lowest-index
+    q = rng.standard_normal((m, l)).astype(np.float32) * 0.1
+    q[2] = x[3]
+    d1, d2, d3 = bf16_split3(jnp.asarray(x))
+
+    def pack(a, b):
+        z = jnp.zeros((n, kp), jnp.bfloat16)
+        return (z.at[:, :l].set(a.astype(jnp.bfloat16))
+                .at[:, l:2 * l].set(b.astype(jnp.bfloat16)))
+
+    w1, w2 = pack(d1, d2), pack(d1, d3)
+    nrm = jnp.sum(jnp.asarray(x) ** 2, axis=1)
+    dbnh = (0.5 * nrm)[None, :]
+    g1, g2, _ = bf16_split3(jnp.asarray(q))
+    q1 = g1.astype(jnp.bfloat16)
+    q2 = g2.astype(jnp.bfloat16)
+
+    vals, idx = packed2_champions(q1, q2, w1, w2, dbnh, tile_n=tile,
+                                  interpret=True)
+    k = jnp.argmax(vals, axis=1)
+    ref_i = np.asarray(jnp.take_along_axis(idx, k[:, None], axis=1)[:, 0])
+    ref_v = np.asarray(jnp.take_along_axis(vals, k[:, None], axis=1)[:, 0])
+
+    bi, bv = packed2_best(q1, q2, w1, w2, dbnh, tile_n=tile, interpret=True)
+    np.testing.assert_array_equal(np.asarray(bi), ref_i)
+    np.testing.assert_array_equal(np.asarray(bv), ref_v)
+
+    d1f, d2f = np.asarray(d1, np.float32), np.asarray(d2, np.float32)
+    q1f, q2f = np.asarray(q1, np.float32), np.asarray(q2, np.float32)
+    s_ref = (q1f @ d1f.T + q1f @ d2f.T + q2f @ d1f.T
+             - np.asarray(0.5 * nrm)[None, :])
+    wi, wv = packed1w_best(q1, q2, w1, dbnh, tile_n=tile, interpret=True)
+    np.testing.assert_array_equal(np.asarray(wi), np.argmax(s_ref, axis=1))
+    np.testing.assert_allclose(np.asarray(wv), s_ref.max(axis=1), rtol=1e-6,
+                               atol=1e-6)

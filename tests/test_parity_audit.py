@@ -72,3 +72,48 @@ def test_audit_level_count_guard(runs):
     a, ap, b, p, x, y = runs
     with pytest.raises(ValueError, match="level count"):
         audit_source_map_mismatches(a, ap, b, p, x.levels[:1], y.levels)
+
+
+def test_committed_bench_record_backs_auto_default():
+    """The auto match-mode default steers 1024^2 levels onto the packed
+    2-pass scan; the parity claim behind that default must be verifiable
+    AT HEAD (round-3 ADVICE item 2): the newest committed BENCH_r*.json
+    must carry a north-star tie-audit with explained ~1.0."""
+    import glob
+    import json
+    import os
+    import re
+
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    benches = sorted(glob.glob(os.path.join(here, "BENCH_r*.json")))
+    records = []
+    for path in benches:
+        with open(path) as f:
+            raw = f.read()
+        data = json.loads(raw)
+        # the driver wraps bench.py's JSON line under "parsed"; when that
+        # is null (output overflowed), the record survives only in the
+        # raw "tail" text — scan the seed-7 span for the audit fields
+        parsed = data.get("parsed") or {}
+        rec = (parsed.get("configs") or {}).get("north_star_1024_seed7")
+        if rec is None:
+            span = raw.split('north_star_1024_seed7', 1)
+            if len(span) == 2:
+                span = span[1].split('north_star_1024_seed', 1)[0]
+                rec = {
+                    k: float(m.group(1)) for k in
+                    ("mismatch_explained_by_ties", "ssim_vs_oracle")
+                    if (m := re.search(
+                        rf'\\?"{k}\\?": ([0-9.]+)', span))
+                }
+        records.append((path, rec))
+    assert records, "no committed BENCH_r*.json file found"
+    # the NEWEST bench file must itself carry the audit — NO fallback to
+    # an older round's evidence, whatever the failure mode (missing run,
+    # truncated tail, audit-less record): stale evidence at HEAD is
+    # exactly the regression this test exists to catch (round-3 ADVICE)
+    path, rec = records[-1]
+    assert rec and "mismatch_explained_by_ties" in rec, (
+        f"{path}: newest bench file carries no north-star tie-audit")
+    assert rec["mismatch_explained_by_ties"] >= 0.9999, (path, rec)
+    assert rec["ssim_vs_oracle"] >= 0.99, (path, rec)
