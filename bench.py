@@ -73,17 +73,24 @@ def _run_tpu(a, ap, b, params, keep_levels=False, reps=3):
     schedulable floor, same provenance rule as the cached oracle numbers —
     experiments/oracle_1024.py) stays the headline; the MEDIAN rides along
     so the draw spread is visible in the one-line JSON (round-3 VERDICT
-    item 4).  All parity fields come from the last run's output (every run
-    computes the same planes)."""
+    item 4).
+
+    ``keep_levels`` (the tie-audit's per-level plane capture) is
+    INSTRUMENTATION, not synthesis: on this box's ~9 MB/s tunnel its
+    extra plane fetches cost ~0.5 s/run, so the timed reps run without it
+    and one final UNTIMED run captures the audit planes — the synthesis
+    is deterministic, so they are the same planes the timed runs
+    computed."""
     from image_analogies_tpu.models.analogy import create_image_analogy
 
     create_image_analogy(a, ap, b, params)  # compile warm-up
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        res = create_image_analogy(a, ap, b, params,
-                                   keep_levels=keep_levels)
+        res = create_image_analogy(a, ap, b, params)
         times.append(time.perf_counter() - t0)
+    if keep_levels:
+        res = create_image_analogy(a, ap, b, params, keep_levels=True)
     return res, min(times), float(np.median(times))
 
 

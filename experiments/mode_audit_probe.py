@@ -1,0 +1,83 @@
+"""On-chip match-mode parity audit: run one config on the REAL TPU with a
+forced wavefront match_mode, score it against a live CPU/cKDTree oracle
+run, and emit SSIM / value_match / the full tie-audit — the adjudication
+step every new scan variant must pass before `auto` may steer to it
+(round-3 memory: bf16-resolution scans LOOK fine on SSIM and still walk
+away from the oracle; only the audit separates tie-drift from real drift).
+
+    python experiments/mode_audit_probe.py --mode exact_hi2_2p --size 256
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# adjudication must be able to measure the gated non-parity modes too
+os.environ["IA_EXPERIMENTAL"] = "1"
+
+import numpy as np
+
+from examples.make_assets import make_structured
+from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.models.analogy import create_image_analogy
+from image_analogies_tpu.utils.parity import audit_source_map_mismatches
+from image_analogies_tpu.utils.ssim import ssim
+
+
+def main() -> int:
+    pa = argparse.ArgumentParser()
+    pa.add_argument("--mode", default="exact_hi2_2p")
+    pa.add_argument("--size", type=int, default=256)
+    pa.add_argument("--levels", type=int, default=3)
+    pa.add_argument("--kappa", type=float, default=5.0)
+    pa.add_argument("--seed", type=int, default=7)
+    pa.add_argument("--reps", type=int, default=3)
+    args = pa.parse_args()
+
+    import jax
+
+    a, ap, b = make_structured(args.size, args.seed)
+    p = AnalogyParams(levels=args.levels, kappa=args.kappa, backend="tpu",
+                      strategy="wavefront", match_mode=args.mode)
+    res = create_image_analogy(a, ap, b, p, keep_levels=True)  # warm
+    ts = []
+    for _ in range(args.reps):
+        t0 = time.perf_counter()
+        res = create_image_analogy(a, ap, b, p, keep_levels=True)
+        ts.append(time.perf_counter() - t0)
+
+    t0 = time.perf_counter()
+    orc = create_image_analogy(a, ap, b, p.replace(backend="cpu"),
+                               keep_levels=True)
+    cpu_s = time.perf_counter() - t0
+
+    audit = audit_source_map_mismatches(a, ap, b, p, res.levels, orc.levels)
+    print(json.dumps({
+        "mode": args.mode, "size": args.size, "levels": args.levels,
+        "seed": args.seed,
+        "backend": jax.default_backend(),
+        "tpu_s": round(min(ts), 3),
+        "tpu_s_median": round(float(np.median(ts)), 3),
+        "cpu_s": round(cpu_s, 1),
+        "ssim_vs_oracle": round(ssim(res.bp_y, orc.bp_y), 4),
+        "value_match": round(float((res.bp_y == orc.bp_y).mean()), 4),
+        "source_map_mismatch": round(float(
+            (res.source_map != orc.source_map).mean()), 6),
+        "mismatch_explained_by_ties": audit["mismatch_explained_by_ties"],
+        "unexplained": audit["unexplained"],
+        "first_divergence_is_tie": audit["first_divergence_is_tie"],
+        "classes": {k: audit[k] for k in
+                    ("mismatches", "ctx_diverged", "tie_exact", "tie_fp",
+                     "kappa_boundary")},
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

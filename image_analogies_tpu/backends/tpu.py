@@ -63,8 +63,8 @@ from image_analogies_tpu.ops.pallas_match import (
     _round_up,
     argmin_l2,
     bf16_split3,
-    packed2_champions,
-    packed3_champions,
+    packed2k_best,
+    packed3_best,
     pertile_champions_queries,
     prepadded_argmin2_queries,
     prepadded_argmin_queries,
@@ -145,6 +145,19 @@ class TpuLevelDB:
     # the ONE derivation shared by the packed-DB lane layout and the
     # anchor's query packing; only set for pad_mode="packed"
     live_idx: Optional[jax.Array]  # (L,) int32 or None
+    # live/dead-split scoring arrays (round-4, single-chip wavefront on
+    # TPU): queries are identically ZERO on dead dims, so the exact fp32
+    # distance decomposes as  d = sum_live (cf - q)^2 + dead_sqnorm[row]
+    # with dead_sqnorm a NON-NEGATIVE per-row sum (no cancellation, near-
+    # zero d stays accurate — unlike the norm trick).  Re-score + coherence
+    # gathers then move (M, nf, L) live columns instead of (M, nf, F) full
+    # rows: ~2x less gather traffic per step.  Summation order differs
+    # from the full-row form only like any XLA-vs-NumPy reordering —
+    # fp-band ties the audit explains (verified on-chip round 4:
+    # 256^2 explained=1.0; the 1024^2 record lands in the driver-written
+    # BENCH_r04.json at round end).
+    db_live: Optional[jax.Array]  # (Na, L) fp32 or None
+    dead_sqnorm: Optional[jax.Array]  # (Na,) fp32 or None
     ha: int = field(metadata=dict(static=True))
     wa: int = field(metadata=dict(static=True))
     hb: int = field(metadata=dict(static=True))
@@ -274,8 +287,22 @@ def _packed_weight_arrays(src, spec, npad: int, mode2p: bool):
     can never drift between the two paths.
 
     Returns (w1, w2, dbnh_row (npad,), shift (f,), live_idx).  ``mode2p``
-    selects W2 = [d1|d3] (exact_hi2_2p, the 2-pass product set) vs
-    [d3|d1] (exact_hi2, the full bf16_6x set)."""
+    builds the exact_hi2_2p K-wide single-array layout consumed by
+    `pallas_match.packed2k_best`:
+
+        w1 = [ d1 | d2 | norm lanes | d1 | d3 | 0pad ]   (4L + 3 lanes)
+
+    with w2 = None — the negative half-norms ride lanes [2L, 2L+3)
+    (`add_norm_lanes` rationale), d1 is laid down twice so the q1 and q2
+    row-blocks both meet it in ONE K~256 MXU dot, and the whole scan is
+    one weight stream with no dbnh input and no VPU add/subtract passes.
+    Non-2p: exact_hi2's W1=[d1|d2] / W2=[d3|d1] pair (subtract-based
+    3-pass kernel).  A narrow single-stream variant that dropped the
+    q1.d3 term was measured and REJECTED (256^2 tie-audit: explained
+    0.999873, first divergence not a tie); its kernels remain in
+    ops/pallas_match for the record but have no production build."""
+    from image_analogies_tpu.ops.pallas_match import add_norm_lanes
+
     n, f = src.shape
     live = np.nonzero(spec.query_live_mask())[0]
     lw = live.size
@@ -287,16 +314,29 @@ def _packed_weight_arrays(src, spec, npad: int, mode2p: bool):
     # --xla_allow_excess_precision (see bf16_split3)
     h1, h2, r2 = bf16_split3(srcc[:, live])
     d1, d2, d3 = (x.astype(jnp.bfloat16) for x in (h1, h2, r2))
+    dbnh = jnp.full((npad,), jnp.inf, _F32).at[:n].set(0.5 * nrm)
+    live_idx = jnp.asarray(live, jnp.int32)
+
+    if mode2p:
+        o2 = 2 * lw + 3
+        pk = max((o2 + 2 * lw + 127) // 128 * 128, 128)
+        wk = jnp.zeros((npad, pk), jnp.bfloat16)
+        ins = lambda w, x, col: jax.lax.dynamic_update_slice(
+            w, jnp.zeros((npad, lw), jnp.bfloat16).at[:n].set(x), (0, col))
+        wk = ins(wk, d1, 0)
+        wk = ins(wk, d2, lw)
+        wk = add_norm_lanes(wk, dbnh, lw)  # lanes [2lw, 2lw+3)
+        wk = ins(wk, d1, o2)
+        wk = ins(wk, d3, o2 + lw)
+        return wk, None, dbnh, shift, live_idx
+
     pk = max((2 * lw + 127) // 128 * 128, 128)
 
     def pack(left, right):
         return jnp.zeros((npad, pk), jnp.bfloat16).at[
             :n, :lw].set(left).at[:n, lw:2 * lw].set(right)
 
-    w1 = pack(d1, d2)
-    w2 = pack(d1, d3) if mode2p else pack(d3, d1)
-    dbnh = jnp.full((npad,), jnp.inf, _F32).at[:n].set(0.5 * nrm)
-    return w1, w2, dbnh, shift, jnp.asarray(live, jnp.int32)
+    return pack(d1, d2), pack(d3, d1), dbnh, shift, live_idx
 
 
 @functools.partial(jax.jit, static_argnames=("spec", "pad_tile", "pad_full",
@@ -352,7 +392,17 @@ def _prepare_level_arrays(
         "dbnh_pad": None,
         "feat_mean": None,
         "live_idx": None,
+        "db_live": None,
+        "dead_sqnorm": None,
     }
+    if pad_full and pad_tile and pad_mode.startswith("packed"):
+        # live/dead-split scoring arrays (see TpuLevelDB) — TPU wavefront
+        # packed modes only: the CPU/XLA test paths keep full-row scoring
+        # so their exact-equality fixtures stay byte-stable
+        live_np = np.nonzero(spec.query_live_mask())[0]
+        dead_np = np.setdiff1d(np.arange(spec.total), live_np)
+        out["db_live"] = db[:, live_np]
+        out["dead_sqnorm"] = jnp.sum(db[:, dead_np] ** 2, axis=1)
     if pad_tile:
         src = db if pad_full else db_rowsafe
         srcn = out["db_sqnorm"] if pad_full else out["db_rowsafe_sqnorm"]
@@ -383,7 +433,7 @@ def _prepare_level_arrays(
                 src, spec, npad, mode2p=pad_mode == "packed2")
             out["feat_mean"] = jnp.zeros((fp,), _F32).at[:f].set(shift)
             out["db_pad"] = w1
-            out["db_pad2"] = w2
+            out["db_pad2"] = w2  # None for packed1w (norms ride W1)
             out["live_idx"] = live_idx
             out["dbnh_pad"] = dbnh_row[None, :]
             nrm = None  # dbnh_pad already set; skip the shared tail
@@ -410,12 +460,13 @@ def _cached_sharded_db_builder(mesh, spec, pad_full: bool, npad: int,
     device_put-after-build path had.
 
     With ``packed`` (the wavefront mesh scan on real TPUs) the builder also
-    emits the exact_hi2_2p lane-packed weight shards W1=[d1|d2],
-    W2=[d1|d3], the half-norm row, and the (replicated) live-dim centering
-    shift — the shift reduces over the FULL row set (GSPMD inserts the
-    cross-shard mean), so scan scores are globally comparable and the
-    cross-shard tie-break stays lowest-global-index
-    (parallel/sharded_match.packed_champion_allreduce)."""
+    emits the exact_hi2_2p K-wide weight shards (the round-4 single-array
+    layout [d1|d2|norms|d1|d3] — see `_packed_weight_arrays`) and the
+    (replicated) live-dim centering shift — the shift reduces over the
+    FULL row set (GSPMD inserts the cross-shard mean), so scan scores are
+    globally comparable and the cross-shard tie-break stays
+    lowest-global-index (parallel/sharded_match.packed_champion_allreduce).
+    """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     sh_db = NamedSharding(mesh, P("db", None))
@@ -439,14 +490,14 @@ def _cached_sharded_db_builder(mesh, spec, pad_full: bool, npad: int,
         # SAME build as the single-chip exact_hi2_2p pad (shared helper) —
         # GSPMD turns the helper's full-row mean into the cross-shard
         # collective, keeping scan scores globally comparable
-        w1, w2, dbnh, shift, _ = _packed_weight_arrays(db, spec, npad,
-                                                       mode2p=True)
+        wk, _, _, shift, _ = _packed_weight_arrays(db, spec, npad,
+                                                   mode2p=True)
         shiftp = jnp.zeros((fp,), _F32).at[:f].set(shift)
-        return (dbp, dbnp, afp, w1, w2, dbnh, shiftp)
+        return (dbp, dbnp, afp, wk, shiftp)
 
     outs = (sh_db, sh_row, sh_row)
     if packed:
-        outs = outs + (sh_db, sh_db, sh_row, sh_rep)
+        outs = outs + (sh_db, sh_rep)
     return jax.jit(build, out_shardings=outs)
 
 
@@ -460,6 +511,20 @@ def _prepare_query_arrays(spec, b_src, b_src_coarse, b_filt_coarse,
                               b_filt_coarse, temporal_fine=b_temporal)
 
 
+@functools.partial(jax.jit, static_argnames=("spec",))
+def _prepare_query_arrays_batch(spec, b_src, b_src_coarse, b_filt_coarse,
+                                b_temporal):
+    """Stacked-over-frames twin of `_prepare_query_arrays` for the mesh
+    video path: ONE dispatch builds every frame's (Nb, F) query features
+    from (T, H, W) stacks — the old per-frame serial jit loop cost T
+    dispatches per level over a ~0.1 s-latency tunnel (round-3 VERDICT
+    weak item 5).  Optional inputs pass None (vmap treats the empty
+    pytree as unbatched)."""
+    fn = lambda bs, bsc, bfc, bt: build_features_jax(
+        spec, bs, None, bsc, bfc, temporal_fine=bt)
+    return jax.vmap(fn)(b_src, b_src_coarse, b_filt_coarse, b_temporal)
+
+
 def build_sharded_db(spec, a_src, a_filt, a_src_coarse, a_filt_coarse,
                      a_temporal, rowsafe, mesh, pad_full: bool, tile: int,
                      packed: bool = False):
@@ -467,8 +532,9 @@ def build_sharded_db(spec, a_src, a_filt, a_src_coarse, a_filt_coarse,
     without any chip holding the full DB (see `_cached_sharded_db_builder`).
     Used by the single-image sharded path and the sharded video phase.
 
-    Returns a 7-tuple (dbp, dbnp, afiltp, w1, w2, dbnh, shift); the last
-    four are None unless ``packed`` (the exact_hi2_2p mesh scan)."""
+    Returns a 5-tuple (dbp, dbnp, afiltp, wk, shift); the last two are
+    None unless ``packed`` (the exact_hi2_2p mesh scan — wk is the
+    round-4 K-wide weight array)."""
     from image_analogies_tpu.parallel.sharded_match import \
         sharded_pad_geometry
 
@@ -478,7 +544,7 @@ def build_sharded_db(spec, a_src, a_filt, a_src_coarse, a_filt_coarse,
     fn = _cached_sharded_db_builder(mesh, spec, pad_full, npad, fp, packed)
     out = fn(a_src, a_filt, a_src_coarse, a_filt_coarse, a_temporal,
              rowsafe)
-    return out if packed else out + (None, None, None, None)
+    return out if packed else out + (None, None)
 
 
 def make_level_template(params, job: LevelJob, strategy: str,
@@ -521,6 +587,7 @@ def make_level_template(params, job: LevelJob, strategy: str,
         afilt_sharded=None, diag=diag, db_pad=None, db_pad2=None,
         dbn_pad=None,
         dbnh_pad=None, feat_mean=None, live_idx=live_idx,
+        db_live=None, dead_sqnorm=None,
         ha=ha, wa=wa, hb=hb, wb=wb, fine_start=fsl.start,
         n_rowsafe=(spec.fine_size // 2) * spec.fine_size,
         strategy=strategy, refine_passes=params.refine_passes,
@@ -552,7 +619,7 @@ def slim_for_mesh(db: TpuLevelDB, keep_sharded: bool = False) -> TpuLevelDB:
     return dataclasses.replace(
         db, db=z2, db_sqnorm=z1, db_rowsafe=z2, db_rowsafe_sqnorm=z1,
         static_q=z2, a_filt_flat=z1, db_pad=None, db_pad2=None,
-        dbn_pad=None, dbnh_pad=None, **kw)
+        dbn_pad=None, dbnh_pad=None, db_live=None, dead_sqnorm=None, **kw)
 
 
 # --------------------------------------------------------------- exact scan
@@ -589,7 +656,7 @@ def _resolve_pixel(db: TpuLevelDB, q, bp, s, p_app, d_app_fn, kappa_mult):
 
 
 def _batched_coherence(db: TpuLevelDB, s, queries, idx_c, ok, n_cand: int,
-                       row_fn):
+                       row_fn, q_live=None):
     """Batched Ashikhmin candidates for M pixels at once (Hertzmann §3.2):
     for each query m the candidates are {s(r) + (q - r)} over its first
     ``n_cand`` causal window positions r (idx_c (M, n_cand) flat positions,
@@ -598,6 +665,11 @@ def _batched_coherence(db: TpuLevelDB, s, queries, idx_c, ok, n_cand: int,
     strategy, the full DB for wavefront; a psum-gather of the SHARDED DB on
     the mesh — see parallel/step.py).
 
+    With ``q_live`` (the queries' live columns, single-chip TPU wavefront)
+    the score uses the live/dead split instead:
+    d = sum_live (cf_live - q_live)^2 + dead_sqnorm[cand] — exact up to
+    summation order, ~2x less gather traffic (see TpuLevelDB.db_live).
+
     Returns (p_coh (M,), d_coh (M,), has_coh (M,))."""
     s_r = s[idx_c]  # (M, n_cand)
     ci = s_r // db.wa - db.off[None, :n_cand, 0]
@@ -605,8 +677,13 @@ def _batched_coherence(db: TpuLevelDB, s, queries, idx_c, ok, n_cand: int,
     ok = ok & (ci >= 0) & (ci < db.ha) & (cj >= 0) & (cj < db.wa)
     cand = (jnp.clip(ci, 0, db.ha - 1) * db.wa
             + jnp.clip(cj, 0, db.wa - 1))
-    cf = row_fn(cand)  # (M, n_cand, F)
-    dc = jnp.sum((cf - queries[:, None, :]) ** 2, axis=-1)
+    if q_live is not None:
+        cf = db.db_live[cand]  # (M, n_cand, L)
+        dc = (jnp.sum((cf - q_live[:, None, :]) ** 2, axis=-1)
+              + db.dead_sqnorm[cand])
+    else:
+        cf = row_fn(cand)  # (M, n_cand, F)
+        dc = jnp.sum((cf - queries[:, None, :]) ** 2, axis=-1)
     dc = jnp.where(ok, dc, jnp.inf)
     k = jnp.argmin(dc, axis=1)
     d_coh = jnp.take_along_axis(dc, k[:, None], axis=1)[:, 0]
@@ -852,11 +929,13 @@ def packed_scan_eligible(match_mode: str, na_rows: int) -> bool:
                  or na_rows >= _PACKED_CROSSOVER_ROWS))
 
 
-def _scan_tile(npad: int, fp: int) -> int:
+def _scan_tile(npad: int, fp: int, cap_rows: int = 0) -> int:
     """Tile rows for the per-tile champion scans over an (npad, fp) padded
     DB: the largest power of two that (a) divides npad, (b) fits the VMEM
     cap (~half the argmin tile — the fp32 multi-row-block dots must fit
-    scoped VMEM), then halved until the champion set spans >= 16 tiles.
+    scoped VMEM; ``cap_rows`` overrides for kernels whose VMEM budget
+    differs, e.g. the single-stream champion scan runs 8192-row tiles at
+    wavefront M), then halved until the champion set spans >= 16 tiles.
 
     Divisibility is the hard constraint (`pallas_*_champions` asserts
     npad % tile == 0): npad is a multiple of the build-time pad tile, which
@@ -870,7 +949,7 @@ def _scan_tile(npad: int, fp: int) -> int:
     # geometries (sharded_pad_geometry caps at round_up(per_shard, 128))
     # and CPU-test tile=1 pads can leave only 128 or less — the final tile
     # then simply equals p2_npad, which always divides npad.
-    cap = max(_tile_rows(fp) // 2, 256)
+    cap = max(cap_rows or _tile_rows(fp) // 2, 256)
     cap = 1 << (cap.bit_length() - 1)  # snap down to a power of 2
     tile = min(cap, p2_npad, npad)
     while npad // tile < 16 and tile >= 256:
@@ -942,9 +1021,10 @@ def make_anchor_fn(db: TpuLevelDB):
         return anchor
 
     if (db.match_mode in ("exact_hi2", "exact_hi2_2p")
-            and db.db_pad is not None
-            and db.db_pad2 is not None and db.dbnh_pad is not None
-            and db.live_idx is not None):
+            and db.db_pad is not None and db.dbnh_pad is not None
+            and db.live_idx is not None
+            and (db.db_pad2 is not None
+                 or db.match_mode == "exact_hi2_2p")):
         # Packed fp32-grade scan (the fast PARITY kernel).  jax HIGHEST on
         # fp32 operands is bf16_6x — SIX MXU passes (measured: the
         # per-pass cost fit is 898 = 1x445 + 450 fixed, 3123 = 6x445 + 450
@@ -966,7 +1046,10 @@ def make_anchor_fn(db: TpuLevelDB):
         # near-ties), end-to-end parity evidence in BENCH_r03.
         live_idx = db.live_idx  # the derivation the DB lanes were packed by
         npad, pk = db.db_pad.shape
-        tile = _scan_tile(npad, pk)
+        # the K-wide 2p array (pk ~ 256) carries the same bytes/tile at
+        # 4096 rows as the old two-array layout — keep the 4096-row cap
+        # rather than letting the wider pk halve it
+        tile = _scan_tile(npad, pk, cap_rows=4096)
         na = db.db.shape[0]
         two_pass = db.match_mode == "exact_hi2_2p"
 
@@ -975,16 +1058,34 @@ def make_anchor_fn(db: TpuLevelDB):
             g1, g2, gr = bf16_split3(qc[:, live_idx])  # (M, L)
             q1 = g1.astype(jnp.bfloat16)
             q2 = g2.astype(jnp.bfloat16)
+            # Round-4 fusions (step-cost decomposition in
+            # experiments/step_decompose_probe.py; the scan is
+            # VPU-reduction-bound, not HBM-bound):
+            # - in-kernel champion: the kernel's running scratch resolves
+            #   the global winner (strict improvement = earlier tile wins
+            #   ties, bit-equal to the old per-tile-champions +
+            #   XLA-argmax pipeline — locked by tests/test_pallas_kernel)
+            # - 2p only: the K-wide single-array layout (packed2k_best) —
+            #   norms ride W lanes, cross-block accumulation rides the
+            #   MXU accumulator; VPU work is down to max + argmax.  Norm
+            #   lanes perturb scores ~2^-24-relative — fp-band ties the
+            #   audit explains.
+            # A single-stream variant that also dropped the q1.d3 term
+            # was measured and REJECTED: explained 0.999873 < 0.9999 and
+            # first divergence not a tie at 256^2 (parity needs the full
+            # 2p product set, full stop).
             if two_pass:
-                vals, idx = packed2_champions(
-                    q1, q2, db.db_pad, db.db_pad2, db.dbnh_pad, tile_n=tile)
+                p, _ = packed2k_best(q1, q2, db.db_pad, tile_n=tile)
             else:
-                vals, idx = packed3_champions(
+                p, _ = packed3_best(
                     q1, q2, gr.astype(jnp.bfloat16), db.db_pad, db.db_pad2,
                     db.dbnh_pad, tile_n=tile)
-            k = jnp.argmax(vals, axis=1)
-            p = jnp.minimum(
-                jnp.take_along_axis(idx, k[:, None], axis=1)[:, 0], na - 1)
+            p = jnp.minimum(p, na - 1)
+            if db.db_live is not None:
+                # live/dead-split exact re-score (see TpuLevelDB.db_live)
+                d = (jnp.sum((db.db_live[p] - queries[:, live_idx]) ** 2,
+                             axis=1) + db.dead_sqnorm[p])
+                return p, d
             return p, jnp.sum((db.db[p] - queries) ** 2, axis=1)
 
         return anchor
@@ -1060,15 +1161,27 @@ def wavefront_scan_core(db: TpuLevelDB, kappa_mult, anchor_fn,
     """
     nb = db.hb * db.wb
     hb, wb = db.hb, db.wb
+    # live/dead-split coherence scoring (single-chip TPU path only — the
+    # mesh supplies its own row_fn and keeps full-row psum gathers)
+    use_live = (row_fn is None and db.db_live is not None
+                and db.live_idx is not None)
     if row_fn is None:
         row_fn = lambda i: db.db[i]
     if afilt_fn is None:
         afilt_fn = lambda i: db.a_filt_flat[i]
 
-    # causal-window invariants from the offset table (tiny, device-resident)
+    # causal-window invariants: window_offsets is raster-ordered, so the
+    # causal positions (strictly before center) are EXACTLY the first
+    # nc = (nf-1)/2 columns.  Row gathers on TPU cost per ROW (lane
+    # padding makes 37 and 128 columns the same fetch — trace-verified,
+    # BASELINE.md), so the bp window gather and the coherence candidate
+    # gathers slice to the causal prefix instead of gathering all nf
+    # positions and masking half of them to +inf: identical semantics
+    # (non-causal candidates could never win), ~2x fewer gathered rows.
+    nf = int(db.off.shape[0])
+    nc = (nf - 1) // 2
     off_i = db.off[:, 0][None, :]  # (1, nf)
     off_j = db.off[:, 1][None, :]
-    causal = (off_i < 0) | ((off_i == 0) & (off_j < 0))
 
     def make_step(seg):
         def step(t, state):
@@ -1078,22 +1191,26 @@ def wavefront_scan_core(db: TpuLevelDB, kappa_mult, anchor_fn,
             pixc = jnp.maximum(pix, 0)
             qi = pixc // wb
             qj = pixc - qi * wb
-            wi = qi[:, None] + off_i
-            wj = qj[:, None] + off_j
+            wi = qi[:, None] + off_i[:, :nc]
+            wj = qj[:, None] + off_j[:, :nc]
             inb = (wi >= 0) & (wi < hb) & (wj >= 0) & (wj < wb)
             idx = (jnp.clip(wi, 0, hb - 1) * wb
-                   + jnp.clip(wj, 0, wb - 1))  # (M, nf) edge-clamped
-            written = (causal & (idx < pixc[:, None])).astype(_F32)
-            dyn = bp[idx] * written * db.fine_sqrtw[None, :]
+                   + jnp.clip(wj, 0, wb - 1))  # (M, nc) edge-clamped
+            written = (idx < pixc[:, None]).astype(_F32)
+            dyn = bp[idx] * written * db.fine_sqrtw[None, :nc]
+            m = int(dyn.shape[0])
+            dyn_full = jnp.zeros((m, nf), _F32).at[:, :nc].set(dyn)
             queries = jax.lax.dynamic_update_slice(
-                db.static_q[pixc], dyn, (0, db.fine_start))
+                db.static_q[pixc], dyn_full, (0, db.fine_start))
             p_app, d_app = anchor_fn(queries)
 
-            # batched Ashikhmin coherence over the full causal window,
-            # scored against the FULL DB (the oracle's metric)
-            nf = int(db.off.shape[0])
+            # batched Ashikhmin coherence over the causal window, scored
+            # against the FULL DB (the oracle's metric; live/dead split
+            # on the single-chip TPU path — same metric, fewer gathered
+            # rows)
             p_coh, d_coh, has_coh = _batched_coherence(
-                db, s, queries, idx, inb & causal, nf, row_fn)
+                db, s, queries, idx, inb, nc, row_fn,
+                q_live=(queries[:, db.live_idx] if use_live else None))
 
             use_coh = has_coh & (d_coh <= d_app * kappa_mult)
             p = jnp.where(use_coh, p_coh, p_app).astype(jnp.int32)
@@ -1137,8 +1254,15 @@ class TpuMatcher(Matcher):
     def build_features(self, job: LevelJob) -> TpuLevelDB:
         import dataclasses
 
+        from image_analogies_tpu.utils.devcache import device_put_cached
+
         spec = job.spec
-        to_j = lambda x: None if x is None else jnp.asarray(x, _F32)
+        # content-hash upload memoization: identical input planes (the
+        # exemplar pair across frames/runs, the B pyramid across warm
+        # reps) upload ONCE per process — this tunnel moves ~9 MB/s, so
+        # re-uploading the north star's pyramids cost ~1.3 s/run
+        # (utils/devcache.py; a changed array hashes to a new key)
+        to_j = lambda x: device_put_cached(x, _F32)
         ha, wa = job.a_shape
 
         strategy = self.params.strategy
@@ -1226,7 +1350,7 @@ class TpuMatcher(Matcher):
             packed = (on_tpu and strategy == "wavefront"
                       and packed_scan_eligible(self.params.match_mode,
                                                ha * wa))
-            (db_sharded, dbn_sharded, afilt_sharded, w1, w2, dbnh,
+            (db_sharded, dbn_sharded, afilt_sharded, wk,
              shift) = build_sharded_db(
                 spec, to_j(job.a_src), to_j(job.a_filt),
                 to_j(job.a_src_coarse), to_j(job.a_filt_coarse),
@@ -1240,7 +1364,7 @@ class TpuMatcher(Matcher):
             return dataclasses.replace(
                 template, static_q=static_q, db_sharded=db_sharded,
                 dbn_sharded=dbn_sharded, afilt_sharded=afilt_sharded,
-                db_pad=w1, db_pad2=w2, dbnh_pad=dbnh, feat_mean=shift,
+                db_pad=wk, feat_mean=shift,
                 mesh=mesh)
 
         arrs = _prepare_level_arrays(
@@ -1263,7 +1387,9 @@ class TpuMatcher(Matcher):
             dbn_pad=arrs["dbn_pad"],
             dbnh_pad=arrs["dbnh_pad"],
             feat_mean=arrs["feat_mean"],
-            live_idx=arrs["live_idx"])
+            live_idx=arrs["live_idx"],
+            db_live=arrs["db_live"],
+            dead_sqnorm=arrs["dead_sqnorm"])
 
     # ------------------------------------------------------------- protocol
 
@@ -1321,8 +1447,7 @@ class TpuMatcher(Matcher):
                 db.mesh, db.static_q[None], db.db_sharded, db.dbn_sharded,
                 db.afilt_sharded, slim_for_mesh(db), job.kappa_mult,
                 force_xla=jax.default_backend() != "tpu",
-                w1_shard=db.db_pad, w2_shard=db.db_pad2,
-                dbnh_shard=db.dbnh_pad)
+                wk_shard=db.db_pad)
             bp, s, n_coh = bp[0], s[0], n_coh[0]
         elif db.strategy == "batched":
             bp, s, counts = _run_batched(db, jnp.float32(job.kappa_mult))

@@ -116,6 +116,13 @@ class AnalogyParams:
     #                stacked passes — exactly jax HIGHEST's resolution,
     #                ~1.2x slower than exact_hi2_2p (backends/tpu.py
     #                make_anchor_fn documents both packings).
+    #                Round 4 upgraded its kernel in place: champion
+    #                resolved in kernel scratch and norms folded into W1
+    #                lanes (~2^-24 perturbation, audit-explained as fp
+    #                ties).  A single-stream variant additionally
+    #                dropping the ~2^-16 q1d3 term was measured and
+    #                REJECTED (256^2 audit: explained 0.999873,
+    #                first divergence not a tie).
     #   "exact_hi" - fp32-grade scores (HIGHEST = 3 bf16 MXU passes)
     #                inside the merged top-1 scan kernel + exact fp32
     #                re-score.  The round-2 parity baseline and the

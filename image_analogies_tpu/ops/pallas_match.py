@@ -512,7 +512,8 @@ def pallas_packed_champions(
 
 def _packed_best_kernel(qa_ref, qb_ref, w1_ref, w2_ref, dbnh_ref, idx_out,
                         val_out, best_val, best_idx, *, tile_n: int,
-                        fold_a: bool, one_stream: bool):
+                        fold_a: bool, one_stream: bool,
+                        norm_in_w: bool = False):
     """Running-champion variant of `_packed_kernel`: the same packed MXU
     product sets, but the cross-tile champion is folded into VMEM scratch
     inside the kernel (strict > on the scan score keeps ties lowest-index,
@@ -524,7 +525,14 @@ def _packed_best_kernel(qa_ref, qb_ref, w1_ref, w2_ref, dbnh_ref, idx_out,
     ``one_stream``: read only W1 and score qa against it (qb_ref/w2_ref
     are ignored 1-row stubs) — the single-weight-stream product set
     q1.d1 + q1.d2 + q2.d1 via row-blocks [q1|q1], [q2|0] against
-    W = [d1|d2], HALF the HBM bytes of the two-stream scan."""
+    W = [d1|d2], HALF the HBM bytes of the two-stream scan.
+
+    ``norm_in_w``: the -||d||^2/2 term rides INSIDE W as three extra
+    bf16-split lanes (multiplied by constant-1 query lanes, accumulating
+    in the MXU's fp32 accumulator to ~2^-24 relative — the same class as
+    the dots' own fp32 rounding), so the kernel skips the dbnh stream AND
+    the (M, tile) subtract pass; dbnh_ref is a (1, 1) stub.  Padding rows
+    carry ~-3e38 norm lanes and lose every max."""
     t = pl.program_id(0)
 
     @pl.when(t == 0)
@@ -544,7 +552,7 @@ def _packed_best_kernel(qa_ref, qb_ref, w1_ref, w2_ref, dbnh_ref, idx_out,
             qb_ref[:], w2_ref[:],
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=_F32)
-    s2 = dots - dbnh_ref[:]
+    s2 = dots if norm_in_w else dots - dbnh_ref[:]
     part_val = jnp.max(s2, axis=1, keepdims=True)
     part_idx = (jnp.argmax(s2, axis=1).astype(jnp.int32)[:, None]
                 + t * s2.shape[1])
@@ -559,17 +567,20 @@ def _packed_best_kernel(qa_ref, qb_ref, w1_ref, w2_ref, dbnh_ref, idx_out,
 
 
 @functools.partial(jax.jit, static_argnames=("tile_n", "fold_a",
-                                             "one_stream", "interpret"))
+                                             "one_stream", "norm_in_w",
+                                             "interpret"))
 def pallas_packed_best(
     qa: jax.Array,  # (Mp or 2Mp, Kp) bf16 row-blocks against W1
     qb: jax.Array,  # (Mp, Kp) bf16 against W2 (1-row stub if one_stream)
     w1: jax.Array,  # (Npad, Kp) bf16
     w2: jax.Array,  # (Npad, Kp) bf16 (1-row stub if one_stream)
     dbnh: jax.Array,  # (1, Npad) fp32 half norms, +inf on padding
+    #                   ((1, 1) stub if norm_in_w)
     *,
     tile_n: int,
     fold_a: bool,
     one_stream: bool = False,
+    norm_in_w: bool = False,
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Entry for `_packed_best_kernel`; returns (idx (Mp,), val (Mp,)) —
@@ -585,11 +596,15 @@ def pallas_packed_best(
                             memory_space=pltpu.VMEM) if one_stream else
                pl.BlockSpec((tile_n, kp), lambda t: (t, 0),
                             memory_space=pltpu.VMEM))
+    dbnh_spec = (pl.BlockSpec((1, 1), lambda t: (0, 0),
+                              memory_space=pltpu.VMEM) if norm_in_w else
+                 pl.BlockSpec((1, tile_n), lambda t: (0, t),
+                              memory_space=pltpu.VMEM))
     passes = (2 if fold_a else 1) + (0 if one_stream else 1)
     streams = 1 if one_stream else 2
     idx, val = pl.pallas_call(
         functools.partial(_packed_best_kernel, tile_n=tile_n, fold_a=fold_a,
-                          one_stream=one_stream),
+                          one_stream=one_stream, norm_in_w=norm_in_w),
         grid=(grid,),
         in_specs=[
             pl.BlockSpec((qm, kp), lambda t: (0, 0),
@@ -598,8 +613,7 @@ def pallas_packed_best(
             pl.BlockSpec((tile_n, kp), lambda t: (t, 0),
                          memory_space=pltpu.VMEM),
             w2_spec,
-            pl.BlockSpec((1, tile_n), lambda t: (0, t),
-                         memory_space=pltpu.VMEM),
+            dbnh_spec,
         ],
         out_specs=[pl.BlockSpec((mp, 1), lambda t: (0, 0),
                                 memory_space=pltpu.VMEM)] * 2,
@@ -664,6 +678,165 @@ def packed1w_best(q1, q2, w1, dbnh, *, tile_n: int,
     idx, val = pallas_packed_best(
         qa, stub16, w1, stub16, dbnh, tile_n=min(tile_n, w1.shape[0]),
         fold_a=True, one_stream=True, interpret=interpret)
+    return idx[:m], val[:m]
+
+
+# score assigned to padding rows by the norm-in-W scheme: far below any
+# real score, finite (an inf lane would split to hi=-inf, lo=NaN and the
+# NaN would poison the max)
+_PAD_SCORE = -3.0e38
+
+
+def add_norm_lanes(w1, dbnh_row, l: int):
+    """Fold -||d||^2/2 into W as three bf16-split lanes at [2l, 2l+3).
+
+    Multiplied by constant-1.0 query lanes, the three products accumulate
+    in the MXU's fp32 accumulator to the exact half-norm up to ~2^-24
+    relative — the same resolution class as the fp32 accumulation of the
+    ~l feature products themselves, so scan scores keep fp32-grade
+    resolution with NO per-element norm subtract in the kernel (and no
+    (1, Npad) dbnh stream).  Identical DB rows get identical lanes, so
+    exact ties still break lowest-index.  Padding rows (+inf dbnh) become
+    finite `_PAD_SCORE` lanes and lose every max.
+
+    ``w1`` is (Npad, Kp) bf16 with lanes [0, 2l) in use; requires
+    2l + 3 <= Kp (callers check — see tpu.py packed steering)."""
+    npad, kp = w1.shape
+    assert 2 * l + 3 <= kp, (l, kp)
+    neg = jnp.where(jnp.isfinite(dbnh_row), -dbnh_row.astype(_F32),
+                    _PAD_SCORE)
+    n1, n2, n3 = bf16_split3(neg)
+    lanes = jnp.stack([x.astype(jnp.bfloat16) for x in (n1, n2, n3)],
+                      axis=1)  # (Npad, 3)
+    return jax.lax.dynamic_update_slice(w1, lanes, (0, 2 * l))
+
+
+def norm_query_rows(q1, q2, mp: int, l: int, kp: int):
+    """The qa row-blocks of the norm-in-W single-stream scan: rows [0, mp)
+    = [q1|q1|1,1,1] (products q1.d1 + q1.d2 + norm), rows [mp, 2mp) =
+    [q2|0|0] (product q2.d1), folded by the kernel."""
+    pad = lambda x: jnp.zeros((mp, l), jnp.bfloat16).at[:q1.shape[0]].set(x)
+    q1p, q2p = pad(q1), pad(q2)
+    row_a = _pack_rows(q1p, q1p, mp, l, kp)
+    ones = jnp.ones((mp, 3), jnp.bfloat16)
+    row_a = jax.lax.dynamic_update_slice(row_a, ones, (0, 2 * l))
+    row_b = _pack_rows(q2p, jnp.zeros_like(q2p), mp, l, kp)
+    return jnp.concatenate([row_a, row_b], axis=0)
+
+
+def packed2k_best(q1, q2, wk, *, tile_n: int, interpret: bool = False):
+    """The shipping exact_hi2_2p scan (round-4 final form): the FULL
+    2-pass product set q1.d1 + q1.d2 + q2.d1 + q1.d3 - ||d||^2/2 computed
+    by ONE wide dot_general per tile against a single (Npad, Kp~256)
+    weight array
+
+        wk = [ d1 | d2 | n1 n2 n3 | d1 | d3 | 0pad ]   (4L + 3 lanes)
+
+    with the matching query row [ q1 | q1 | 1 1 1 | q2 | q1 | 0 ].  Same
+    HBM bytes as the two-array layout (d1 is duplicated so q1 AND q2 can
+    meet it), but the cross-block accumulation now happens INSIDE the
+    MXU's fp32 accumulator (K = 256 is two systolic passes into one
+    output) — no VPU add pass, no dbnh subtract pass, champion in kernel
+    scratch: the per-element VPU work is down to max + argmax, the
+    measured bound of the scan (experiments/step_decompose_probe.py).
+    Norm lanes are bf16-split to ~2^-24 relative (`add_norm_lanes`
+    rationale); padding rows carry `_PAD_SCORE` lanes and lose every max.
+    Returns (idx (M,), val (M,))."""
+    m, l = q1.shape
+    kp = wk.shape[1]
+    o2 = 2 * l + 3
+    assert o2 + 2 * l <= kp, (l, kp)
+    mp = _round_up(max(m, 8), 16)
+    pad = lambda x: jnp.zeros((mp, l), jnp.bfloat16).at[:m].set(x)
+    q1p, q2p = pad(q1), pad(q2)
+    qa = jnp.zeros((mp, kp), jnp.bfloat16)
+    qa = jax.lax.dynamic_update_slice(qa, q1p, (0, 0))
+    qa = jax.lax.dynamic_update_slice(qa, q1p, (0, l))
+    qa = jax.lax.dynamic_update_slice(
+        qa, jnp.ones((mp, 3), jnp.bfloat16), (0, 2 * l))
+    qa = jax.lax.dynamic_update_slice(qa, q2p, (0, o2))
+    qa = jax.lax.dynamic_update_slice(qa, q1p, (0, o2 + l))
+    stub16 = jnp.zeros((1, kp), jnp.bfloat16)
+    stub_n = jnp.zeros((1, 1), _F32)
+    idx, val = pallas_packed_best(
+        qa, stub16, wk, stub16, stub_n, tile_n=min(tile_n, wk.shape[0]),
+        fold_a=False, one_stream=True, norm_in_w=True, interpret=interpret)
+    return idx[:m], val[:m]
+
+
+def packed2wn_best(q1, q2, w1n, w2, *, tile_n: int,
+                   interpret: bool = False):
+    """Two-array intermediate of the round-4 fusion work — SUPERSEDED in
+    production by `packed2k_best` (the K-wide single-array form two
+    functions down); kept with its test as the stepping stone that
+    validated the two fusions separately.  Computes the FULL 2-pass
+    product set q1.d1 + q1.d2 + q2.d1 + q1.d3 (unchanged — the
+    single-stream variant that dropped q1.d3 FAILED the 256^2 tie-audit:
+    explained 0.999873, first divergence not a tie), with two round-4
+    fusions that preserve it:
+
+    - champion folded into kernel scratch (no (M, ntiles) projection
+      table, no XLA select), and
+    - the -||d||^2/2 term riding W1's lanes [2L, 2L+3) as bf16-split
+      products against constant-1 query lanes (`add_norm_lanes`) — a
+      ~2^-24-relative perturbation, the same class as the fp32
+      accumulation of the dots themselves, which the tie-audit explains
+      as fp-band ties — killing the (1, Npad) dbnh stream and the
+      per-element subtract pass.
+
+    ``w1n`` = [d1|d2|norm lanes], ``w2`` = [d1|d3|0].  Row-blocks
+    [q1|q1|1]. W1 and [q2|q1|0]. W2.  Returns (idx (M,), val (M,))."""
+    m, l = q1.shape
+    kp = w1n.shape[1]
+    mp = _round_up(max(m, 8), 16)
+    pad = lambda x: jnp.zeros((mp, l), jnp.bfloat16).at[:m].set(x)
+    q1, q2 = pad(q1), pad(q2)
+    qa = jax.lax.dynamic_update_slice(  # [q1|q1|1,1,1]
+        _pack_rows(q1, q1, mp, l, kp),
+        jnp.ones((mp, 3), jnp.bfloat16), (0, 2 * l))
+    qb = _pack_rows(q2, q1, mp, l, kp)  # [q2|q1|0,0,0]
+    stub_n = jnp.zeros((1, 1), _F32)
+    idx, val = pallas_packed_best(
+        qa, qb, w1n, w2, stub_n, tile_n=min(tile_n, w1n.shape[0]),
+        fold_a=False, norm_in_w=True, interpret=interpret)
+    return idx[:m], val[:m]
+
+
+def packed1wn_best(q1, q2, w1n, *, tile_n: int, interpret: bool = False):
+    """Single-stream, norm-in-W champion scan (the round-4 fusion
+    candidate): ONE (Npad, Kp) bf16 weight stream carrying [d1|d2|norm
+    lanes] (see `add_norm_lanes`), folded query row-blocks
+    [q1|q1|1], [q2|0|0], champion resolved in kernel scratch.  Product
+    set q1.d1 + q1.d2 + q2.d1 - ||d||^2/2: vs the shipping exact_hi2_2p
+    this drops only the ~2^-16-coefficient q1.d3 term (parity adjudicated
+    by the tie-audit before steering ever selects it).  Returns
+    (idx (M,), val (M,))."""
+    m, l = q1.shape
+    kp = w1n.shape[1]
+    mp = _round_up(max(m, 8), 16)
+    qa = norm_query_rows(q1, q2, mp, l, kp)
+    stub16 = jnp.zeros((1, kp), jnp.bfloat16)
+    stub_n = jnp.zeros((1, 1), _F32)
+    idx, val = pallas_packed_best(
+        qa, stub16, w1n, stub16, stub_n, tile_n=min(tile_n, w1n.shape[0]),
+        fold_a=True, one_stream=True, norm_in_w=True, interpret=interpret)
+    return idx[:m], val[:m]
+
+
+def packed3_best(q1, q2, q3, w1, w2, dbnh, *, tile_n: int,
+                 interpret: bool = False):
+    """Champion-in-kernel twin of `packed3_champions` (the full bf16_6x
+    product set of exact_hi2): returns (idx (M,), val (M,))."""
+    m, l = q1.shape
+    kp = w1.shape[1]
+    mp = _round_up(max(m, 8), 16)
+    pad = lambda x: jnp.zeros((mp, l), jnp.bfloat16).at[:m].set(x)
+    q1, q2, q3 = pad(q1), pad(q2), pad(q3)
+    qa = jnp.concatenate([_pack_rows(q1, q1, mp, l, kp),
+                          _pack_rows(q2, q2, mp, l, kp)], axis=0)
+    idx, val = pallas_packed_best(
+        qa, _pack_rows(q1, q3, mp, l, kp), w1, w2, dbnh,
+        tile_n=min(tile_n, w1.shape[0]), fold_a=True, interpret=interpret)
     return idx[:m], val[:m]
 
 

@@ -24,7 +24,7 @@ from image_analogies_tpu.parallel.mesh import shard_map
 from image_analogies_tpu.ops.pallas_match import (
     _round_up,
     argmin_l2,
-    packed2_champions,
+    packed2k_best,
     prepadded_argmin_queries,
     xla_argmin_l2,
 )
@@ -84,30 +84,29 @@ def local_argmin_allreduce(queries, db_shard, dbn_shard, axis: str,
     return i.astype(jnp.int32), d
 
 
-def packed_champion_allreduce(q1, q2, w1_shard, w2_shard, dbnh_shard,
-                              axis: str, tile_n: int,
+def packed_champion_allreduce(q1, q2, wk_shard, axis: str, tile_n: int,
                               interpret: bool = False):
     """Sharded twin of the single-chip exact_hi2_2p anchor scan: each chip
-    runs the packed 2-pass champion kernel over ITS shard of the
-    lane-packed weight arrays (W1=[d1|d2], W2=[d1|d3]), then the global
-    winner resolves with a max+argmax all-reduce over ``axis``.
+    runs the K-wide packed champion kernel (`packed2k_best` — the SAME
+    kernel and weight layout as the single-chip anchor) over ITS shard,
+    then the global winner resolves with a max+argmax all-reduce over
+    ``axis``.
 
     Scan scores are globally comparable (the live-dim centering shift is
-    computed over the FULL DB before sharding, and identical rows split
-    into identical bf16 lanes), so cross-shard exact ties gather equal
-    values and `argmax`'s first-occurrence rule picks the lowest shard —
-    whose per-shard champion already holds the lowest in-shard index —
-    i.e. the lowest GLOBAL index, bitwise the same tie-break as the
-    single-chip packed scan.  Returns (global idx (M,), scan val (M,));
-    callers re-score the winner in exact fp32 through their sharded
-    row-gather (the kappa rule's d_app never comes from scan space)."""
-    vals, idx = packed2_champions(q1, q2, w1_shard, w2_shard,
-                                  dbnh_shard[None, :], tile_n=tile_n,
-                                  interpret=interpret)
-    k = jnp.argmax(vals, axis=1)
-    lv = jnp.take_along_axis(vals, k[:, None], axis=1)[:, 0]
-    li = (jnp.take_along_axis(idx, k[:, None], axis=1)[:, 0]
-          + jax.lax.axis_index(axis) * w1_shard.shape[0])
+    computed over the FULL DB before sharding, identical rows pack into
+    identical bf16 lanes — including the norm lanes), so cross-shard
+    exact ties gather equal values and `argmax`'s first-occurrence rule
+    picks the lowest shard — whose per-shard champion already holds the
+    lowest in-shard index (the kernel's running-scratch strict-improve
+    rule, locked equal to the per-tile-champions pipeline by
+    tests/test_pallas_kernel.py) — i.e. the lowest GLOBAL index, bitwise
+    the same tie-break as the single-chip packed scan.  Returns
+    (global idx (M,), scan val (M,)); callers re-score the winner in
+    exact fp32 through their sharded row-gather (the kappa rule's d_app
+    never comes from scan space)."""
+    li_loc, lv = packed2k_best(q1, q2, wk_shard, tile_n=tile_n,
+                               interpret=interpret)
+    li = li_loc + jax.lax.axis_index(axis) * wk_shard.shape[0]
     allv = jax.lax.all_gather(lv, axis)  # (D, M)
     alli = jax.lax.all_gather(li, axis)
     k2 = jnp.argmax(allv, axis=0)

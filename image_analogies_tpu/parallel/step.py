@@ -62,8 +62,8 @@ def _cached_multichip_step(mesh: Mesh, strategy: str, force_xla: bool,
     shard (same parity class, ~2x fewer MXU passes) — real-TPU meshes
     only; the signature grows by (w1, w2, dbnh) shard inputs."""
 
-    def local_step(static_q_loc, db_loc, dbn_loc, af_loc, w1_loc, w2_loc,
-                   dbnh_loc, tmpl: TpuLevelDB, km):
+    def local_step(static_q_loc, db_loc, dbn_loc, af_loc, wk_loc,
+                   tmpl: TpuLevelDB, km):
         rows = db_loc.shape[0]
         f = tmpl.static_q.shape[1]
 
@@ -85,8 +85,9 @@ def _cached_multichip_step(mesh: Mesh, strategy: str, force_xla: bool,
                 g1, g2, _ = bf16_split3(qc[:, tmpl.live_idx])
                 p, _ = packed_champion_allreduce(
                     g1.astype(jnp.bfloat16), g2.astype(jnp.bfloat16),
-                    w1_loc, w2_loc, dbnh_loc, "db",
-                    tile_n=_scan_tile(w1_loc.shape[0], w1_loc.shape[1]),
+                    wk_loc, "db",
+                    tile_n=_scan_tile(wk_loc.shape[0], wk_loc.shape[1],
+                                      cap_rows=4096),
                     interpret=packed_interpret)
             else:
                 p, _ = approx_fn(queries)
@@ -128,7 +129,7 @@ def _cached_multichip_step(mesh: Mesh, strategy: str, force_xla: bool,
         local_step,
         mesh=mesh,
         in_specs=(P("data", None, None), P("db", None), P("db"), P("db"),
-                  P("db", None), P("db", None), P("db"), P(), P()),
+                  P("db", None), P(), P()),
         out_specs=(P("data", None), P("data", None), P("data")),
         check_rep=False,
     )
@@ -144,9 +145,8 @@ def multichip_level_step(
     template: TpuLevelDB,  # single-frame LevelDB carrying shared arrays/meta
     kappa_mult: float,
     force_xla: bool = False,
-    w1_shard: jax.Array = None,  # packed-scan shards (build_sharded_db
-    w2_shard: jax.Array = None,  # with packed=True); None -> HIGHEST
-    dbnh_shard: jax.Array = None,  # merged-kernel scan
+    wk_shard: jax.Array = None,  # K-wide packed-scan shard
+    # (build_sharded_db with packed=True); None -> HIGHEST merged scan
     packed_interpret: bool = False,  # tests: packed scan via the Pallas
     # interpreter on CPU meshes (overrides the force_xla packed gate)
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
@@ -173,17 +173,15 @@ def multichip_level_step(
     precision = (jax.lax.Precision.HIGHEST
                  if template.strategy == "wavefront"
                  else jax.lax.Precision.DEFAULT)
-    packed = (w1_shard is not None and template.strategy == "wavefront"
+    packed = (wk_shard is not None and template.strategy == "wavefront"
               and (not force_xla or packed_interpret))
     if not packed:
-        # tiny placeholder shards keep ONE shard_map signature; the
-        # non-packed anchor never reads them
-        z = jnp.zeros((db_shards, 1), jnp.bfloat16)
-        w1_shard, w2_shard = z, z
-        dbnh_shard = jnp.zeros((db_shards,), jnp.float32)
+        # tiny placeholder shard keeps ONE shard_map signature; the
+        # non-packed anchor never reads it
+        wk_shard = jnp.zeros((db_shards, 1), jnp.bfloat16)
     step = _cached_multichip_step(mesh, template.strategy, force_xla,
                                   precision, packed,
                                   packed and packed_interpret)
     return step(frame_static_q, db_shard_src, dbn_shard_src,
-                afilt_shard_src, w1_shard, w2_shard, dbnh_shard, template,
+                afilt_shard_src, wk_shard, template,
                 jnp.float32(kappa_mult))

@@ -38,6 +38,16 @@ def run_digest(params, a_shape, b_shape) -> str:
     return hashlib.sha256(payload.encode()).hexdigest()[:16]
 
 
+def clip_digest(params, a_shape, b_shape, n_frames: int, phase: str) -> str:
+    """Digest for the sharded VIDEO path's stacked per-level checkpoints:
+    the single-image `run_digest` extended with the clip length and the
+    two_phase phase tag (phase-1 and phase-2 planes are different state
+    and must never resume into each other)."""
+    base = run_digest(params, a_shape, b_shape)
+    return hashlib.sha256(
+        f"{base}:clip:{n_frames}:{phase}".encode()).hexdigest()[:16]
+
+
 def save_level(ckpt_dir: str, level: int, bp: np.ndarray,
                s: np.ndarray, digest: str = "") -> str:
     os.makedirs(ckpt_dir, exist_ok=True)

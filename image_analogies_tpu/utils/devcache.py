@@ -1,0 +1,75 @@
+"""Content-addressed device-upload cache.
+
+This box's PJRT tunnel moves ~9 MB/s host->device, so re-uploading the SAME
+immutable input planes on every synthesis call costs ~1.3 s of the 1024^2
+north star per run (measured round 4: wall 8.5 s vs 6.3 s device time, the
+gap being input uploads + the result fetch).  Real TPU hosts move these in
+milliseconds, but the principle stands everywhere: a warm engine should not
+re-pay data movement for bit-identical inputs (the exemplar pair A/A' is
+reused across every frame/run in practice).
+
+`device_put_cached` keys on the CONTENT (sha1 of bytes + shape/dtype), not
+object identity, so mutation can never serve a stale buffer — a changed
+array hashes to a new key.  Hashing costs ~5 ms per 4 MB plane, ~100x
+cheaper than this tunnel's upload.  The cache is process-local and
+byte-bounded (LRU); `clear()` drops it (failure-retry paths call this via
+jax.clear_caches anyway producing fresh uploads).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+_MAX_BYTES = 1 << 30  # 1 GiB of cached device inputs
+_cache: "OrderedDict[tuple, object]" = OrderedDict()
+_bytes = 0
+
+
+def clear() -> None:
+    global _bytes
+    _cache.clear()
+    _bytes = 0
+
+
+def device_put_cached(x, dtype=None):
+    """jnp.asarray(x, dtype) memoized by content hash.
+
+    Only plain host ndarrays are cached; device arrays and non-arrays pass
+    through (already resident / trivial).  Returns a device array that MUST
+    be treated as immutable (all engine consumers are)."""
+    import jax
+    import jax.numpy as jnp
+
+    if x is None:
+        return None
+    if isinstance(x, jax.Array):
+        return x if dtype is None else jnp.asarray(x, dtype)
+    arr = np.asarray(x, dtype)
+    if arr.nbytes < (1 << 16):  # tiny arrays: hashing gains nothing
+        return jnp.asarray(arr)
+    global _bytes
+    h = hashlib.sha1(arr.tobytes()).hexdigest()
+    key = (h, arr.shape, str(arr.dtype), str(jax.default_backend()))
+    hit = _cache.get(key)
+    if hit is not None:
+        try:
+            _ = hit.shape  # a deleted/invalidated buffer raises here
+            _cache.move_to_end(key)
+            return hit
+        except Exception:  # pragma: no cover - buffer invalidated
+            _bytes -= arr.nbytes
+            _cache.pop(key, None)
+    dev = jax.device_put(jnp.asarray(arr))
+    _cache[key] = dev
+    _bytes += arr.nbytes
+    while _bytes > _MAX_BYTES and _cache:
+        _, old = _cache.popitem(last=False)
+        try:
+            _bytes -= int(np.prod(old.shape)) * old.dtype.itemsize
+        except Exception:  # pragma: no cover
+            pass
+    return dev

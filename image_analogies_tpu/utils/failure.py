@@ -101,6 +101,10 @@ def run_with_retry(
 
                 jax.clear_caches()  # drop live executables/buffers that may
                 # reference poisoned device state before re-running
+                from image_analogies_tpu.utils import devcache
+
+                devcache.clear()  # cached input uploads may reference the
+                # same poisoned device state; retries must re-upload
             except Exception:  # pragma: no cover - cache clear is best-effort
                 pass
             time.sleep(backoff_s * attempt)

@@ -151,3 +151,26 @@ def test_ssim_properties(rng):
     assert ssim(x, noisy) > ssim(x, 1.0 - x)
     with pytest.raises(ValueError):
         ssim(x, x[:16])
+
+
+def test_devcache_content_keyed(rng):
+    """Upload memoization must key on CONTENT: identical bytes reuse the
+    buffer, a mutated array gets a fresh one (never a stale hit)."""
+    import jax.numpy as jnp
+
+    from image_analogies_tpu.utils import devcache
+
+    devcache.clear()
+    a = np.asarray(rng.standard_normal((256, 256)), np.float32)
+    d1 = devcache.device_put_cached(a, jnp.float32)
+    d2 = devcache.device_put_cached(a.copy(), jnp.float32)  # same bytes
+    assert d1 is d2
+    a2 = a.copy()
+    a2[0, 0] += 1.0
+    d3 = devcache.device_put_cached(a2, jnp.float32)
+    assert d3 is not d1
+    np.testing.assert_array_equal(np.asarray(d3), a2)
+    # tiny arrays bypass the cache entirely (hashing gains nothing)
+    t = devcache.device_put_cached(np.zeros((4,), np.float32), jnp.float32)
+    np.testing.assert_array_equal(np.asarray(t), np.zeros((4,)))
+    devcache.clear()

@@ -523,3 +523,41 @@ def test_packed_best_matches_champion_select():
     np.testing.assert_array_equal(np.asarray(wi), np.argmax(s_ref, axis=1))
     np.testing.assert_allclose(np.asarray(wv), s_ref.max(axis=1), rtol=1e-6,
                                atol=1e-6)
+
+    # packed2wn_best — the SHIPPING exact_hi2_2p scan: full 2p product
+    # set with norms riding W1's lanes; picks must equal the
+    # exact-norm-subtract reference (duplicate rows included: identical
+    # norm lanes keep exact ties lowest-index), scores within the
+    # documented ~2^-24-relative norm-lane band
+    from image_analogies_tpu.ops.pallas_match import (
+        add_norm_lanes,
+        packed2wn_best,
+    )
+
+    d3f = np.asarray(d3, np.float32)
+    s2p = s_ref + q1f @ d3f.T
+    w1n = add_norm_lanes(w1, 0.5 * nrm, l)
+    ni, nv = packed2wn_best(q1, q2, w1n, w2, tile_n=tile, interpret=True)
+    np.testing.assert_array_equal(np.asarray(ni), np.argmax(s2p, axis=1))
+    np.testing.assert_allclose(np.asarray(nv), s2p.max(axis=1), rtol=0,
+                               atol=5e-7)
+    assert ni[2] == 3  # duplicate-row tie stays lowest-index
+
+    # packed2k_best — the SHIPPING K-wide single-array form of the same
+    # product set (one MXU dot per tile, d1 laid down twice): identical
+    # picks, scores within the norm-lane band
+    from image_analogies_tpu.ops.pallas_match import packed2k_best
+
+    o2 = 2 * l + 3
+    kp2 = 256
+    wkk = jnp.zeros((n, kp2), jnp.bfloat16)
+    wkk = (wkk.at[:, :l].set(d1.astype(jnp.bfloat16))
+           .at[:, l:2 * l].set(d2.astype(jnp.bfloat16)))
+    wkk = add_norm_lanes(wkk, 0.5 * nrm, l)
+    wkk = (wkk.at[:, o2:o2 + l].set(d1.astype(jnp.bfloat16))
+           .at[:, o2 + l:o2 + 2 * l].set(d3.astype(jnp.bfloat16)))
+    ki, kv = packed2k_best(q1, q2, wkk, tile_n=tile, interpret=True)
+    np.testing.assert_array_equal(np.asarray(ki), np.argmax(s2p, axis=1))
+    np.testing.assert_allclose(np.asarray(kv), s2p.max(axis=1), rtol=0,
+                               atol=5e-7)
+    assert ki[2] == 3

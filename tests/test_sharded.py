@@ -219,6 +219,7 @@ def test_packed_champion_allreduce_matches_global(rng):
     from jax.sharding import PartitionSpec as P
 
     from image_analogies_tpu.ops.pallas_match import (
+        add_norm_lanes,
         bf16_split3,
         packed2_champions,
     )
@@ -262,14 +263,22 @@ def test_packed_champion_allreduce_matches_global(rng):
 
     mesh = make_mesh(db_shards=shards)
     sharded = shard_map(
-        lambda qq1, qq2, w1s, w2s, dh: packed_champion_allreduce(
-            qq1, qq2, w1s, w2s, dh, "db", tile_n=tile, interpret=True),
+        lambda qq1, qq2, wks: packed_champion_allreduce(
+            qq1, qq2, wks, "db", tile_n=tile, interpret=True),
         mesh=mesh,
-        in_specs=(P(), P(), P("db", None), P("db", None), P("db")),
+        in_specs=(P(), P(), P("db", None)),
         out_specs=(P(), P()),
         check_rep=False,
     )
-    gi, gv = jax.jit(sharded)(q1, q2, w1, w2, dbnh)
+    # round 4: the allreduce consumes the K-wide single-array layout
+    # [d1|d2|norm lanes|d1|d3] (the same one packed2k_best scans)
+    o2 = 2 * L + 3
+    kp2 = 256
+    wk = jnp.zeros((n, kp2), jnp.bfloat16)
+    wk = wk.at[:, :L].set(d1).at[:, L:2 * L].set(d2)
+    wk = add_norm_lanes(wk, dbnh, L)
+    wk = wk.at[:, o2:o2 + L].set(d1).at[:, o2 + L:o2 + 2 * L].set(d3)
+    gi, gv = jax.jit(sharded)(q1, q2, wk)
     np.testing.assert_array_equal(np.asarray(gi), ref)
 
 
@@ -308,7 +317,7 @@ def test_packed_mesh_level_matches_solo_interpret(rng):
     mesh = make_mesh(db_shards=4)
     to_j = lambda x: None if x is None else jnp.asarray(x, jnp.float32)
     template = make_level_template(params, job, "wavefront")
-    dbp, dbnp, afp, w1, w2, dbnh, shift = build_sharded_db(
+    dbp, dbnp, afp, wk, shift = build_sharded_db(
         spec, to_j(job.a_src), to_j(job.a_filt), None, None, None,
         template.rowsafe, mesh, True, 1, packed=True)
     template = dataclasses.replace(template, feat_mean=shift)
@@ -316,7 +325,7 @@ def test_packed_mesh_level_matches_solo_interpret(rng):
                                      None)
     bp, s, _ = multichip_level_step(
         mesh, static_q[None], dbp, dbnp, afp, template, job.kappa_mult,
-        force_xla=True, w1_shard=w1, w2_shard=w2, dbnh_shard=dbnh,
+        force_xla=True, wk_shard=wk,
         packed_interpret=True)
     s_mesh = np.asarray(s[0]).reshape(24, 24)
     # the packed score formula rounds differently than the solo XLA score

