@@ -136,7 +136,7 @@ def main() -> int:
     # ---- config 2 (oil filter, 256^2, 3 levels): LIVE oracle ----
     a, ap, b = make_structured(256)
     p = AnalogyParams(levels=3, kappa=5.0, backend="tpu",
-                      strategy="wavefront")
+                      strategy="wavefront", level_sync=False)
     res_tpu, tpu_s, tpu_s_med = _run_tpu(a, ap, b, p, keep_levels=True)
     # the live oracle gets the same min-of-N floor treatment as the TPU
     # side (review round 3: a single slow CPU draw against a best-of-3 TPU
@@ -192,7 +192,7 @@ def main() -> int:
                     "experiments/oracle_1024.py before benching")
         p = AnalogyParams(levels=ocfg["config"]["levels"],
                           kappa=ocfg["config"]["kappa"], backend="tpu",
-                          strategy="wavefront")
+                          strategy="wavefront", level_sync=False)
         res_ns, ns_s, ns_s_med = _run_tpu(a, ap, b, p, keep_levels=True)
         oracle_s = float(ocfg["wall_s"])
         rec = {

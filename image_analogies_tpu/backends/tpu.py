@@ -100,6 +100,12 @@ _HIGHEST = jax.lax.Precision.HIGHEST
 # coherent source-map runs extend `fine_radius` pixels further left-to-right).
 _REFINE_PASSES = 3
 
+# The wavefront scan's packed (Nb, 2) carry stores source-map indices as
+# exact f32 VALUES (int bit patterns would be denormal-flushed by real TPU
+# data paths — measured round 4); f32 represents integers exactly below
+# 2^24, so exemplars beyond 4096^2 rows are rejected at trace time.
+_WAVEFRONT_MAX_ROWS = 1 << 24
+
 
 @dataclass
 class TpuLevelDB:
@@ -145,19 +151,19 @@ class TpuLevelDB:
     # the ONE derivation shared by the packed-DB lane layout and the
     # anchor's query packing; only set for pad_mode="packed"
     live_idx: Optional[jax.Array]  # (L,) int32 or None
-    # live/dead-split scoring arrays (round-4, single-chip wavefront on
+    # live/dead-split scoring array (round-4, single-chip wavefront on
     # TPU): queries are identically ZERO on dead dims, so the exact fp32
     # distance decomposes as  d = sum_live (cf - q)^2 + dead_sqnorm[row]
     # with dead_sqnorm a NON-NEGATIVE per-row sum (no cancellation, near-
-    # zero d stays accurate — unlike the norm trick).  Re-score + coherence
-    # gathers then move (M, nf, L) live columns instead of (M, nf, F) full
-    # rows: ~2x less gather traffic per step.  Summation order differs
-    # from the full-row form only like any XLA-vs-NumPy reordering —
-    # fp-band ties the audit explains (verified on-chip round 4:
-    # 256^2 explained=1.0; the 1024^2 record lands in the driver-written
-    # BENCH_r04.json at round end).
-    db_live: Optional[jax.Array]  # (Na, L) fp32 or None
-    dead_sqnorm: Optional[jax.Array]  # (Na,) fp32 or None
+    # zero d stays accurate — unlike the norm trick).  Layout: the live
+    # columns PLUS the dead-norm as a final column, (Na, L+1), so the
+    # re-score and coherence read ONE gathered row each (TPU gathers cost
+    # per row) instead of full (F) rows plus a second norm gather.
+    # Summation order differs from the full-row form only like any
+    # XLA-vs-NumPy reordering — fp-band ties the audit explains (verified
+    # on-chip round 4: 256^2 explained=1.0; the 1024^2 record lands in
+    # the driver-written BENCH_r04.json at round end).
+    db_live: Optional[jax.Array]  # (Na, L+1) fp32 or None
     ha: int = field(metadata=dict(static=True))
     wa: int = field(metadata=dict(static=True))
     hb: int = field(metadata=dict(static=True))
@@ -393,7 +399,6 @@ def _prepare_level_arrays(
         "feat_mean": None,
         "live_idx": None,
         "db_live": None,
-        "dead_sqnorm": None,
     }
     if pad_full and pad_tile and pad_mode.startswith("packed"):
         # live/dead-split scoring arrays (see TpuLevelDB) — TPU wavefront
@@ -401,8 +406,9 @@ def _prepare_level_arrays(
         # so their exact-equality fixtures stay byte-stable
         live_np = np.nonzero(spec.query_live_mask())[0]
         dead_np = np.setdiff1d(np.arange(spec.total), live_np)
-        out["db_live"] = db[:, live_np]
-        out["dead_sqnorm"] = jnp.sum(db[:, dead_np] ** 2, axis=1)
+        out["db_live"] = jnp.concatenate(
+            [db[:, live_np],
+             jnp.sum(db[:, dead_np] ** 2, axis=1)[:, None]], axis=1)
     if pad_tile:
         src = db if pad_full else db_rowsafe
         srcn = out["db_sqnorm"] if pad_full else out["db_rowsafe_sqnorm"]
@@ -587,7 +593,7 @@ def make_level_template(params, job: LevelJob, strategy: str,
         afilt_sharded=None, diag=diag, db_pad=None, db_pad2=None,
         dbn_pad=None,
         dbnh_pad=None, feat_mean=None, live_idx=live_idx,
-        db_live=None, dead_sqnorm=None,
+        db_live=None,
         ha=ha, wa=wa, hb=hb, wb=wb, fine_start=fsl.start,
         n_rowsafe=(spec.fine_size // 2) * spec.fine_size,
         strategy=strategy, refine_passes=params.refine_passes,
@@ -619,7 +625,7 @@ def slim_for_mesh(db: TpuLevelDB, keep_sharded: bool = False) -> TpuLevelDB:
     return dataclasses.replace(
         db, db=z2, db_sqnorm=z1, db_rowsafe=z2, db_rowsafe_sqnorm=z1,
         static_q=z2, a_filt_flat=z1, db_pad=None, db_pad2=None,
-        dbn_pad=None, dbnh_pad=None, db_live=None, dead_sqnorm=None, **kw)
+        dbn_pad=None, dbnh_pad=None, db_live=None, **kw)
 
 
 # --------------------------------------------------------------- exact scan
@@ -656,7 +662,7 @@ def _resolve_pixel(db: TpuLevelDB, q, bp, s, p_app, d_app_fn, kappa_mult):
 
 
 def _batched_coherence(db: TpuLevelDB, s, queries, idx_c, ok, n_cand: int,
-                       row_fn, q_live=None):
+                       row_fn, q_live=None, s_r=None):
     """Batched Ashikhmin candidates for M pixels at once (Hertzmann §3.2):
     for each query m the candidates are {s(r) + (q - r)} over its first
     ``n_cand`` causal window positions r (idx_c (M, n_cand) flat positions,
@@ -667,20 +673,25 @@ def _batched_coherence(db: TpuLevelDB, s, queries, idx_c, ok, n_cand: int,
 
     With ``q_live`` (the queries' live columns, single-chip TPU wavefront)
     the score uses the live/dead split instead:
-    d = sum_live (cf_live - q_live)^2 + dead_sqnorm[cand] — exact up to
+    d = sum_live (cf_live - q_live)^2 + dead_norm_col — exact up to
     summation order, ~2x less gather traffic (see TpuLevelDB.db_live).
 
+    ``s_r`` optionally supplies the pre-gathered source-map window values
+    (the wavefront step packs them into its B' gather — one gather serves
+    both); otherwise they gather from ``s`` here.
+
     Returns (p_coh (M,), d_coh (M,), has_coh (M,))."""
-    s_r = s[idx_c]  # (M, n_cand)
+    if s_r is None:
+        s_r = s[idx_c]  # (M, n_cand)
     ci = s_r // db.wa - db.off[None, :n_cand, 0]
     cj = s_r % db.wa - db.off[None, :n_cand, 1]
     ok = ok & (ci >= 0) & (ci < db.ha) & (cj >= 0) & (cj < db.wa)
     cand = (jnp.clip(ci, 0, db.ha - 1) * db.wa
             + jnp.clip(cj, 0, db.wa - 1))
     if q_live is not None:
-        cf = db.db_live[cand]  # (M, n_cand, L)
-        dc = (jnp.sum((cf - q_live[:, None, :]) ** 2, axis=-1)
-              + db.dead_sqnorm[cand])
+        cf = db.db_live[cand]  # (M, n_cand, L+1): live cols | dead norm
+        dc = (jnp.sum((cf[..., :-1] - q_live[:, None, :]) ** 2, axis=-1)
+              + cf[..., -1])
     else:
         cf = row_fn(cand)  # (M, n_cand, F)
         dc = jnp.sum((cf - queries[:, None, :]) ** 2, axis=-1)
@@ -1083,8 +1094,9 @@ def make_anchor_fn(db: TpuLevelDB):
             p = jnp.minimum(p, na - 1)
             if db.db_live is not None:
                 # live/dead-split exact re-score (see TpuLevelDB.db_live)
-                d = (jnp.sum((db.db_live[p] - queries[:, live_idx]) ** 2,
-                             axis=1) + db.dead_sqnorm[p])
+                g = db.db_live[p]  # (M, L+1): live cols | dead norm
+                d = (jnp.sum((g[:, :-1] - queries[:, live_idx]) ** 2,
+                             axis=1) + g[:, -1])
                 return p, d
             return p, jnp.sum((db.db[p] - queries) ** 2, axis=1)
 
@@ -1161,6 +1173,13 @@ def wavefront_scan_core(db: TpuLevelDB, kappa_mult, anchor_fn,
     """
     nb = db.hb * db.wb
     hb, wb = db.hb, db.wb
+    # source-map indices ride an f32 lane of the packed (Nb, 2) carry
+    # (exact only below 2^24 — a 4096^2 exemplar; see the gather comment).
+    # Explicit raise, not assert: `python -O` must not strip the guard.
+    if db.ha * db.wa > _WAVEFRONT_MAX_ROWS:
+        raise ValueError(
+            f"wavefront packed carry stores source indices as exact f32 "
+            f"values; exemplar {db.ha}x{db.wa} exceeds 2^24 rows")
     # live/dead-split coherence scoring (single-chip TPU path only — the
     # mesh supplies its own row_fn and keeps full-row psum gathers)
     use_live = (row_fn is None and db.db_live is not None
@@ -1185,7 +1204,7 @@ def wavefront_scan_core(db: TpuLevelDB, kappa_mult, anchor_fn,
 
     def make_step(seg):
         def step(t, state):
-            bp, s, n_coh = state
+            bps, n_coh = state
             pix = seg[t]  # (M,) flat indices, -1 on short diagonals
             lane_ok = pix >= 0
             pixc = jnp.maximum(pix, 0)
@@ -1197,7 +1216,19 @@ def wavefront_scan_core(db: TpuLevelDB, kappa_mult, anchor_fn,
             idx = (jnp.clip(wi, 0, hb - 1) * wb
                    + jnp.clip(wj, 0, wb - 1))  # (M, nc) edge-clamped
             written = (idx < pixc[:, None]).astype(_F32)
-            dyn = bp[idx] * written * db.fine_sqrtw[None, :nc]
+            # ONE gather serves both the query build (B' values, lane 0)
+            # and the coherence candidates (source-map indices as exact
+            # f32 VALUES in lane 1) — the window positions are the same
+            # (M, nc) set, and TPU gathers cost per row.  Values, not a
+            # bitcast: int bit patterns stored in f32 lanes are DENORMAL
+            # for small ints and real TPU data paths flush them to zero
+            # (measured round 4: bitcast packing scored SSIM 0.69 on-chip
+            # while CPU stayed bit-exact); f32<->int conversion is exact
+            # for indices < 2^24, guarded at build time by
+            # _WAVEFRONT_MAX_ROWS.
+            g = bps[idx]  # (M, nc, 2)
+            dyn = g[..., 0] * written * db.fine_sqrtw[None, :nc]
+            s_r = g[..., 1].astype(jnp.int32)
             m = int(dyn.shape[0])
             dyn_full = jnp.zeros((m, nf), _F32).at[:, :nc].set(dyn)
             queries = jax.lax.dynamic_update_slice(
@@ -1209,28 +1240,29 @@ def wavefront_scan_core(db: TpuLevelDB, kappa_mult, anchor_fn,
             # on the single-chip TPU path — same metric, fewer gathered
             # rows)
             p_coh, d_coh, has_coh = _batched_coherence(
-                db, s, queries, idx, inb, nc, row_fn,
-                q_live=(queries[:, db.live_idx] if use_live else None))
+                db, None, queries, idx, inb, nc, row_fn,
+                q_live=(queries[:, db.live_idx] if use_live else None),
+                s_r=s_r)
 
             use_coh = has_coh & (d_coh <= d_app * kappa_mult)
             p = jnp.where(use_coh, p_coh, p_app).astype(jnp.int32)
             # write only live lanes: -1 padding -> index nb, dropped
             wpix = jnp.where(lane_ok, pix, nb)
-            bp = bp.at[wpix].set(afilt_fn(p), mode="drop")
-            s = s.at[wpix].set(p, mode="drop")
-            return bp, s, n_coh + (use_coh & lane_ok).sum(dtype=jnp.int32)
+            row = jnp.stack([afilt_fn(p), p.astype(_F32)], axis=-1)
+            bps = bps.at[wpix].set(row, mode="drop")
+            return bps, n_coh + (use_coh & lane_ok).sum(dtype=jnp.int32)
 
         return step
 
     # the schedule comes in width-bucketed segments (see _diag_schedule):
     # one fori_loop per segment, chained in t order — identical semantics,
     # each segment's batch padded only to its own max diagonal width
-    state = (jnp.zeros((nb,), _F32), jnp.zeros((nb,), jnp.int32),
-             jnp.int32(0))
+    state = (jnp.zeros((nb, 2), _F32), jnp.int32(0))
     for seg in db.diag:
         state = jax.lax.fori_loop(0, int(seg.shape[0]), make_step(seg),
                                   state)
-    return state
+    bps, n_coh = state
+    return bps[:, 0], bps[:, 1].astype(jnp.int32), n_coh
 
 
 @jax.jit
@@ -1388,8 +1420,7 @@ class TpuMatcher(Matcher):
             dbnh_pad=arrs["dbnh_pad"],
             feat_mean=arrs["feat_mean"],
             live_idx=arrs["live_idx"],
-            db_live=arrs["db_live"],
-            dead_sqnorm=arrs["dead_sqnorm"])
+            db_live=arrs["db_live"])
 
     # ------------------------------------------------------------- protocol
 
@@ -1458,19 +1489,28 @@ class TpuMatcher(Matcher):
         hb, wb = job.b_shape
         bp = bp.reshape(hb, wb)
         s = s.reshape(hb, wb)
-        jax.block_until_ready((bp, s))  # completion WITHOUT a host fetch
-        dt = time.perf_counter() - t0
         n = hb * wb
         stats = {
             "level": job.level,
             "db_rows": db.ha * db.wa,
             "pixels": n,
             "_n_coh": n_coh,  # device scalar; driver batch-fetches
-            "pixels_per_s": n / max(dt, 1e-9),
-            "ms": dt * 1e3,
             "backend": "tpu",
             "strategy": db.strategy,
         }
+        if self.params.level_sync or self.params.level_retries > 0:
+            # (level retries require the sync: a fault must surface
+            # INSIDE the retry wrapper, not at the final fetch)
+            jax.block_until_ready((bp, s))  # completion, no host fetch
+            dt = time.perf_counter() - t0
+            stats["pixels_per_s"] = n / max(dt, 1e-9)
+            stats["ms"] = dt * 1e3
+        else:
+            # pipelined mode: the work is ENQUEUED; device compute of
+            # this level overlaps the host prep + dispatch of the next
+            # (config.AnalogyParams.level_sync) — the timing recorded
+            # here is only the enqueue cost, named so honestly
+            stats["enqueue_ms"] = (time.perf_counter() - t0) * 1e3
         if n_ref is not None:
             # picks the left-propagation refinement switched to a same-row
             # coherence candidate — reported separately so coherence_ratio

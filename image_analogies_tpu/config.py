@@ -157,6 +157,17 @@ class AnalogyParams:
     # device/runtime faults (level granularity — combine with checkpoint_dir
     # so a process restart after exhausted retries loses at most one level).
     level_retries: int = 0
+    # §5.5 observability vs pipelining: with True (default) the driver
+    # synchronizes after each level so per-level `ms` / `pixels_per_s`
+    # stats measure real device time.  False lets all levels' device work
+    # ENQUEUE back-to-back (one sync before the final fetch) — on a
+    # high-latency dispatch link this pipelines host prep under device
+    # compute and removes per-level round-trips; per-level stats then
+    # report `enqueue_ms` instead of `ms` (they no longer measure
+    # compute).  bench.py uses False: the north-star metric is synthesis
+    # wall-clock, not per-level telemetry.  level_retries > 0 forces the
+    # sync regardless (faults must surface inside the retry wrapper).
+    level_sync: bool = True
     checkpoint_dir: Optional[str] = None  # per-level checkpoints if set
     resume_from_level: Optional[int] = None  # level index (finest=0) to resume at
     profile_dir: Optional[str] = None  # jax.profiler trace dir if set

@@ -30,12 +30,25 @@ from image_analogies_tpu.utils import logging as ialog
 class AnalogyResult:
     bp: np.ndarray  # (H,W,3) or (H,W) final B'
     bp_y: np.ndarray  # (H,W) synthesized filtered plane (luminance)
-    source_map: np.ndarray  # (H,W) int32 flat indices into A (finest level)
+    # (H,W) int32 flat indices into A (finest level).  Stored raw (device
+    # array on the TPU path unless a host consumer already forced it):
+    # the map is introspection metadata, not the synthesized image, and
+    # its eager fetch cost ~0.2 s/run over this box's tunnel — access
+    # through the `source_map` property, which fetches once on demand.
+    source_map_raw: Any = None
     stats: List[Dict[str, Any]] = field(default_factory=list)
     # with keep_levels=True: every level's (bp, s), finest first — the
     # tie-audit (utils/parity.py) re-scores mismatched picks against the
     # exact per-level decision context
     levels: Optional[List] = None
+
+    @property
+    def source_map(self) -> np.ndarray:
+        sm = self.source_map_raw
+        if not isinstance(sm, np.ndarray):
+            sm = np.asarray(sm, np.int32)
+            self.source_map_raw = sm
+        return sm
 
 
 def _prep_planes(a, ap, b, params, remap_anchor=None):
@@ -251,11 +264,16 @@ def create_image_analogy(
         if not st.pop("_emitted", False):
             ialog.emit(st, params.log_path)
     bp_y = np.asarray(bp_pyr[0], np.float32)
-    s_map = np.asarray(s_pyr[0], np.int32)
+    # the source map stays a DEVICE array unless a host consumer needs it
+    # here (source_rgb's color gather, keep_levels' audit planes) — it is
+    # introspection metadata, fetched lazily by AnalogyResult.source_map
+    s_raw = s_pyr[0]
+    if params.color_mode == "source_rgb" or keep_levels:
+        s_raw = np.asarray(s_raw, np.int32)
     if params.color_mode == "source_rgb":
         ap_flat = ap_rgb.reshape(-1, ap_rgb.shape[-1]) if ap_rgb.ndim == 3 \
             else ap_rgb.reshape(-1)
-        out = ap_flat[s_map.reshape(-1)].reshape(
+        out = ap_flat[s_raw.reshape(-1)].reshape(
             bp_y.shape + (() if ap_rgb.ndim == 2 else (ap_rgb.shape[-1],)))
     elif b_yiq is not None:
         out = color.yiq2rgb(
@@ -265,10 +283,10 @@ def create_image_analogy(
     if keep_levels:
         # reuse the already-fetched finest planes; only the coarser levels
         # (a quarter of the data, shrinking geometrically) transfer here
-        levels_np = [(bp_y, s_map)] + [
+        levels_np = [(bp_y, s_raw)] + [
             (np.asarray(bp_pyr[lv], np.float32),
              np.asarray(s_pyr[lv], np.int32))
             for lv in range(1, levels)]
     return AnalogyResult(
-        bp=out, bp_y=bp_y, source_map=s_map, stats=stats,
+        bp=out, bp_y=bp_y, source_map_raw=s_raw, stats=stats,
         levels=(levels_np if keep_levels else None))

@@ -254,9 +254,15 @@ def _sharded_phase(a, ap, frames, params: AnalogyParams, mesh,
                 to_j(b_src_stacks[level + 1]) if coarse else None,
                 bfc,
                 to_j(b_temp_stacks[level]) if temporal else None)
-            return multichip_level_step(
+            out = multichip_level_step(
                 mesh, frame_static_q, dbp, dbnp, afp, template,
                 job0.kappa_mult, force_xla=force_xla, wk_shard=wk)
+            if params.level_retries > 0:
+                # a transient device fault must surface INSIDE the retry
+                # wrapper, not at the post-wrapper host fetch (same §5.3
+                # invariant the single-chip path enforces)
+                jax.block_until_ready(out)
+            return out
 
         bp, s, n_coh = failure.run_with_retry(
             _level, retries=params.level_retries,
@@ -322,7 +328,8 @@ def _sharded_phase(a, ap, frames, params: AnalogyParams, mesh,
                 [bp_y, b_yiqs[i][..., 1], b_yiqs[i][..., 2]], axis=-1))
         else:
             out = np.clip(bp_y, 0.0, 1.0)
-        results.append(AnalogyResult(bp=out, bp_y=bp_y, source_map=s_map))
+        results.append(AnalogyResult(bp=out, bp_y=bp_y,
+                                     source_map_raw=s_map))
     return results
 
 

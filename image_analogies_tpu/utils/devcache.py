@@ -56,13 +56,16 @@ def device_put_cached(x, dtype=None):
     key = (h, arr.shape, str(arr.dtype), str(jax.default_backend()))
     hit = _cache.get(key)
     if hit is not None:
+        deleted = True
         try:
-            _ = hit.shape  # a deleted/invalidated buffer raises here
+            deleted = hit.is_deleted()
+        except Exception:  # pragma: no cover - treat unknown as dead
+            pass
+        if not deleted:
             _cache.move_to_end(key)
             return hit
-        except Exception:  # pragma: no cover - buffer invalidated
-            _bytes -= arr.nbytes
-            _cache.pop(key, None)
+        _bytes -= arr.nbytes
+        _cache.pop(key, None)
     dev = jax.device_put(jnp.asarray(arr))
     _cache[key] = dev
     _bytes += arr.nbytes

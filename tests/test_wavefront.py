@@ -154,8 +154,8 @@ def test_live_dead_split_scoring_matches_full_rows():
     # end-to-end: inject the split arrays; outputs must agree with the
     # full-row scan (identical up to fp summation order)
     db_live = dataclasses.replace(
-        db, db_live=jnp.asarray(dbf[:, live]),
-        dead_sqnorm=jnp.asarray((dbf[:, dead] ** 2).sum(-1)),
+        db, db_live=jnp.asarray(np.concatenate(
+            [dbf[:, live], (dbf[:, dead] ** 2).sum(-1)[:, None]], axis=1)),
         live_idx=jnp.asarray(live, np.int32))
     km = jnp.float32(job.kappa_mult)
     bp_f, s_f, n_f = _run_wavefront(db, km)
