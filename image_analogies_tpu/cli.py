@@ -78,6 +78,12 @@ def _add_engine_flags(p: argparse.ArgumentParser) -> None:
                    help="disable luminance remapping")
     p.add_argument("--no-gaussian", action="store_true",
                    help="unweighted (flat) neighborhood distances")
+    p.add_argument("--no-level-sync", action="store_true",
+                   help="pipeline pyramid levels (enqueue all device work, "
+                        "one sync before the final fetch) — faster on "
+                        "high-latency links; per-level stats then report "
+                        "enqueue_ms, and level retries force the sync back "
+                        "on (see config.AnalogyParams.level_sync)")
     p.add_argument("--level-retries", type=int, default=None,
                    help="retry a level on transient device faults this many "
                         "times (level-granular recovery, SURVEY.md 5.3)")
@@ -111,6 +117,8 @@ def _params_from_args(args, base: AnalogyParams) -> AnalogyParams:
         kw["coarse_patch_size"] = args.coarse_patch_size
     if args.no_ann:
         kw["use_ann"] = False
+    if args.no_level_sync:
+        kw["level_sync"] = False
     if args.no_remap:
         kw["remap_luminance"] = False
     if args.no_gaussian:

@@ -119,3 +119,17 @@ def test_refine_passes_reaches_batched_scan(assets):
     r3 = create_image_analogy(a, ap, b, AnalogyParams(
         levels=1, backend="tpu", strategy="batched", refine_passes=3))
     assert r0.bp_y.shape == r3.bp_y.shape == (14, 14)
+
+
+def test_no_level_sync_flag_maps():
+    args = build_parser().parse_args(
+        ["run", "--ap", "x.png", "--out", "y.png", "--no-level-sync"])
+    from image_analogies_tpu.cli import _params_from_args
+    from image_analogies_tpu.config import PRESETS
+
+    p = _params_from_args(args, PRESETS["oil_filter"])
+    assert p.level_sync is False
+    # default stays synced (per-level stats measure real device time)
+    args2 = build_parser().parse_args(
+        ["run", "--ap", "x.png", "--out", "y.png"])
+    assert _params_from_args(args2, PRESETS["oil_filter"]).level_sync is True
