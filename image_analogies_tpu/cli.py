@@ -82,8 +82,11 @@ def _add_engine_flags(p: argparse.ArgumentParser) -> None:
                    help="pipeline pyramid levels (enqueue all device work, "
                         "one sync before the final fetch) — faster on "
                         "high-latency links; per-level stats then report "
-                        "enqueue_ms, and level retries force the sync back "
-                        "on (see config.AnalogyParams.level_sync)")
+                        "enqueue_ms.  Level retries force the sync back "
+                        "on, and per-level host consumers "
+                        "(--checkpoint-dir, --save-levels, --log-path) "
+                        "still fetch each level as it completes (see "
+                        "config.AnalogyParams.level_sync)")
     p.add_argument("--level-retries", type=int, default=None,
                    help="retry a level on transient device faults this many "
                         "times (level-granular recovery, SURVEY.md 5.3)")
