@@ -9,7 +9,7 @@ The shipping kernel case is `packed2k_best` (the round-4 K-wide form);
 the superseded round-3 candidates it was measured against are recorded in
 the in-file history note (their builds no longer exist in production).
 
-    python experiments/step_decompose_probe.py [--size 1024] [--iters 100]
+    python experiments/step_decompose_probe.py [--size 1024] [--iters 600]
 """
 
 from __future__ import annotations
