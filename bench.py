@@ -220,6 +220,14 @@ def main() -> int:
     ns_ssim = ns_rec["ssim_vs_oracle"]
     ns_match = ns_rec["value_match"]
 
+    # The parity note goes to STDOUT, before the JSON: rounds 3/4 printed
+    # it to stderr after the JSON and the driver's capture (which appends
+    # captured stderr after stdout) recorded "parsed": null every round
+    # (round-4 VERDICT weak item 2).  Keeping bench.py's stderr empty and
+    # the JSON the last stdout line makes JSON-last hold under both
+    # merged-fd and concatenated capture models.
+    print("# parity strategy=wavefront; full per-config record in the "
+          "JSON line below")
     print(json.dumps({
         "metric": "1024x1024 B' synthesis wall-clock, 5-level pyramid, "
                   "kappa=5 (north-star config), wavefront oracle-parity "
@@ -231,9 +239,7 @@ def main() -> int:
         "ssim_vs_oracle": round(ns_ssim, 4),
         "value_match": round(ns_match, 4),
         "configs": configs,
-    }))
-    print(f"# parity strategy=wavefront; configs={json.dumps(configs)}",
-          file=sys.stderr)
+    }), flush=True)
     return 0
 
 

@@ -83,6 +83,13 @@ def main() -> int:
         "wall_s_this_run": round(wall_s, 1),
         "levels_ms": [round(s["ms"], 1) for s in res.stats],
         "host": "this box (judge's CPU)",
+        # self-consistency note (round-4 ADVICE item 2): wall_s (the
+        # speedup denominator bench.py uses) and levels_ms can come from
+        # DIFFERENT runs when a regeneration is slower than a prior run
+        "provenance": ("wall_s is the MIN over all generations of this "
+                       "exact input (digest-matched); wall_s_this_run and "
+                       "levels_ms describe the generation whose planes are "
+                       "cached in the .npz"),
     }
     names = [f"oracle_1024_seed{seed}.json"]
     if seed == 7:  # historic name bench.py's primary leg reads

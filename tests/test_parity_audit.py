@@ -83,9 +83,26 @@ def test_committed_bench_record_backs_auto_default():
     import json
     import os
     import re
+    import subprocess
 
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    benches = sorted(glob.glob(os.path.join(here, "BENCH_r*.json")))
+    # enumerate COMMITTED bench files via git (round-4 ADVICE item 3: a
+    # working-directory glob would validate untracked/stale local bench
+    # files instead of the evidence actually at HEAD); fall back to the
+    # glob only outside a git checkout (e.g. an exported tarball)
+    try:
+        tracked = subprocess.run(
+            ["git", "ls-files", "BENCH_r*.json"], cwd=here,
+            capture_output=True, text=True, timeout=30, check=True,
+        ).stdout.split()
+        benches = sorted(os.path.join(here, p) for p in tracked)
+    except (OSError, subprocess.SubprocessError):
+        benches = []
+    if not benches:
+        # outside a git checkout — or exported without .git but extracted
+        # inside some ENCLOSING work tree, where ls-files exits 0 with
+        # empty output — fall back to the working-directory glob
+        benches = sorted(glob.glob(os.path.join(here, "BENCH_r*.json")))
     records = []
     for path in benches:
         with open(path) as f:
