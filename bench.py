@@ -220,14 +220,13 @@ def main() -> int:
     ns_ssim = ns_rec["ssim_vs_oracle"]
     ns_match = ns_rec["value_match"]
 
-    # The parity note goes to STDOUT, before the JSON: rounds 3/4 printed
-    # it to stderr after the JSON and the driver's capture (which appends
-    # captured stderr after stdout) recorded "parsed": null every round
-    # (round-4 VERDICT weak item 2).  Keeping bench.py's stderr empty and
-    # the JSON the last stdout line makes JSON-last hold under both
-    # merged-fd and concatenated capture models.
-    print("# parity strategy=wavefront; full per-config record in the "
-          "JSON line below")
+    # The JSON below is bench.py's ONLY output on either stream: rounds
+    # 3/4 printed a parity note to stderr AFTER the JSON and the driver's
+    # capture (which appends captured stderr after stdout) recorded
+    # "parsed": null every round (round-4 VERDICT weak item 2).  The note
+    # carried nothing the JSON's `configs` doesn't; emitting nothing else
+    # keeps the JSON parseable under every capture model (last-line,
+    # whole-stdout, merged-fd).
     print(json.dumps({
         "metric": "1024x1024 B' synthesis wall-clock, 5-level pyramid, "
                   "kappa=5 (north-star config), wavefront oracle-parity "

@@ -1,0 +1,236 @@
+"""Split the wavefront step's coherence block (trace: ~135 us/step at
+north-star plateau — the ONE dominant XLA fusion left after round 4) into
+its parts, each timed as a loop-carried on-chip fori_loop at high iteration
+count (the ~90 ms tunnel dispatch is ~30 us/step at iters=3000 and is
+subtracted via the noop case):
+
+  gather12   the (M, nc=12) row gather from db_live (L+1 cols)
+  gather6    same with HALF the rows (is cost really per-row?)
+  score      live-split scoring given pre-gathered rows (no gather)
+  argmin     the masked argmin + take_along_axis tail
+  full       the production _batched_coherence block
+  bpsgather  the query build's (M, nc) gather from the (Nb, 2) carry
+  rescore    the anchor re-score gather+sum (M rows)
+  scatter    the (M,) row scatter into the carry
+
+    python experiments/coherence_parts_probe.py [--iters 3000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from examples.make_assets import make_structured
+from image_analogies_tpu.backends.base import LevelJob
+from image_analogies_tpu.backends.tpu import TpuMatcher, _batched_coherence
+from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.ops import color
+from image_analogies_tpu.ops.features import spec_for_level
+
+_F32 = jnp.float32
+
+
+def bench(run, args_tuple, reps=3):
+    run_c = jax.jit(run)
+    out = run_c(*args_tuple)
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(run_c(*args_tuple))
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def main() -> int:
+    pa = argparse.ArgumentParser()
+    pa.add_argument("--size", type=int, default=1024)
+    pa.add_argument("--iters", type=int, default=3000)
+    args = pa.parse_args()
+
+    a, ap, b = make_structured(args.size)
+    params = AnalogyParams(levels=1, backend="tpu", strategy="wavefront",
+                           match_mode="exact_hi2_2p")
+    spec = spec_for_level(params, 0, 1, 1)
+    a_src, a_filt, b_src = (color.luminance(a), color.luminance(ap),
+                            color.luminance(b))
+    a_src, a_filt = color.remap_pair(a_src, a_filt, b_src)
+    job = LevelJob(level=0, spec=spec,
+                   kappa_mult=params.kappa_factor(0) ** 2,
+                   a_src=a_src, a_filt=a_filt, b_src=b_src)
+    db = TpuMatcher(params).build_features(job)
+
+    hb, wb, ha, wa = db.hb, db.wb, db.ha, db.wa
+    na, nb = ha * wa, hb * wb
+    nf = int(db.off.shape[0])
+    nc = (nf - 1) // 2
+    c = spec.fine_size // 2 + 1
+    m = (min(hb, (wb + c - 1) // c) + 7) // 8 * 8
+    lw = int(db.live_idx.shape[0])
+
+    rng = np.random.default_rng(0)
+    pix = jnp.asarray(
+        np.sort(rng.choice(nb, size=m, replace=False)).astype(np.int32))
+    bps0 = jnp.asarray(rng.random((nb, 2), dtype=np.float32))
+    qlive0 = jnp.asarray(rng.random((m, lw), dtype=np.float32))
+    cand0 = jnp.asarray(rng.integers(0, na, (m, nc)).astype(np.int32))
+    rows0 = jnp.asarray(rng.random((m, nc, lw + 1), dtype=np.float32))
+    p0 = jnp.asarray(rng.integers(0, na, m).astype(np.int32))
+    off_i = db.off[:, 0][None, :nc]
+    off_j = db.off[:, 1][None, :nc]
+    iters = args.iters
+
+    def loop(body):
+        def run(*arrs):
+            def f(i, carry):
+                return body(i, carry, *arrs)
+            return jax.lax.fori_loop(0, iters, f, jnp.int32(0))
+        return run
+
+    # consume EVERY element (sum) — a [0]-element dep lets XLA slice the
+    # whole case down to a 1-row gather (measured: 0.01 us/step "gathers")
+    dep = lambda x: jnp.sum(x.astype(_F32)).astype(jnp.int32) % 2
+
+    def noop(i, acc):
+        return acc + (i % 2)
+
+    def gather_n(n):
+        def body(i, acc, dbl, cand):
+            cf = dbl[(cand[:, :n] + acc) % na]
+            return acc + dep(cf)
+        return body
+
+    def score(i, acc, rows, qlive):
+        cf = rows + acc.astype(_F32) * 1e-30
+        dc = (jnp.sum((cf[..., :-1] - qlive[:, None, :]) ** 2, axis=-1)
+              + cf[..., -1])
+        return acc + dep(dc)
+
+    def argmin_tail(i, acc, dc0, cand):
+        dc = dc0 + acc.astype(_F32) * 1e-30
+        k = jnp.argmin(dc, axis=1)
+        d = jnp.take_along_axis(dc, k[:, None], axis=1)[:, 0]
+        p = jnp.take_along_axis(cand, k[:, None], axis=1)[:, 0]
+        return acc + dep(d) + (p[0] % 2)
+
+    def full(i, acc, dbl, s_r, qlive, queries):
+        sr = (s_r + acc) % na
+        ci = sr // wa - off_i
+        cj = sr % wa - off_j
+        ok = (ci >= 0) & (ci < ha) & (cj >= 0) & (cj < wa)
+        idx = jnp.zeros((m, nc), jnp.int32)  # placeholder base validity
+        p_coh, d_coh, has = _batched_coherence(
+            db, None, queries, idx, ok, nc, lambda i_: db.db[i_],
+            q_live=qlive, s_r=sr)
+        return acc + dep(d_coh)
+
+    def bps_gather(i, acc, bps):
+        pixc = (pix + acc) % nb
+        qi = pixc // wb
+        qj = pixc - qi * wb
+        wi = qi[:, None] + off_i
+        wj = qj[:, None] + off_j
+        idx = (jnp.clip(wi, 0, hb - 1) * wb + jnp.clip(wj, 0, wb - 1))
+        g = bps[idx]
+        return acc + dep(g)
+
+    def rescore(i, acc, dbl, qlive):
+        p = (p0 + acc) % na
+        g = dbl[p]
+        d = jnp.sum((g[:, :-1] - qlive) ** 2, axis=1) + g[:, -1]
+        return acc + dep(d)
+
+    def scatter(i, acc, bps, vals):
+        wpix = (pix + acc) % nb
+        out = bps.at[wpix].set(vals, mode="drop")
+        return acc + dep(out)
+
+    dc0 = jnp.asarray(rng.random((m, nc), dtype=np.float32))
+    vals0 = jnp.asarray(rng.random((m, 2), dtype=np.float32))
+    queries0 = jnp.asarray(
+        rng.random((m, int(db.static_q.shape[1])), dtype=np.float32))
+
+    def scatter_sorted(i, acc, bps, vals):
+        # sorted ascending + per-lane OOB sentinels (all distinct) — the
+        # production schedule's pix rows ARE ascending with -1 pads at the
+        # end, so this formulation is realizable in the real step
+        wpix = pix + acc * 0 + jnp.arange(m, dtype=jnp.int32) * 0
+        wpix = jnp.where(wpix >= 0, wpix,
+                         nb + jnp.arange(m, dtype=jnp.int32))
+        out = bps.at[wpix].set(vals, mode="drop", unique_indices=True,
+                               indices_are_sorted=True)
+        return acc + dep(out)
+
+    def dus_scatter(i, acc, bps_diag, vals):
+        # diagonal-layout scatter: the step's M results land CONTIGUOUS
+        off = (acc.astype(jnp.int32) % 32) * m
+        out = jax.lax.dynamic_update_slice(bps_diag, vals, (off, 0))
+        return acc + dep(out)
+
+    def staticq_gather(i, acc, static_q):
+        pixc = (pix + acc) % nb
+        g = static_q[pixc]
+        return acc + dep(g)
+
+    def staticq_slice(i, acc, static_q_diag):
+        off = (acc.astype(jnp.int32) % 32) * m
+        g = jax.lax.dynamic_slice(static_q_diag, (off, 0),
+                                  (m, static_q_diag.shape[1]))
+        return acc + dep(g)
+
+    def gather_clustered(i, acc, dbl, s_r):
+        # production-shaped candidate gather: 12 rows per query CLUSTERED
+        # around a base row (sr +- window shifts), like real coherence
+        sr = (s_r[:, :1] + acc) % na
+        cand = jnp.clip(sr + jnp.arange(nc)[None, :] * (wa // 256), 0,
+                        na - 1)
+        cf = dbl[cand]
+        return acc + dep(cf)
+
+    cases = {
+        "noop": (noop, ()),
+        "gather12": (gather_n(nc), (db.db_live, cand0)),
+        "gather6": (gather_n(6), (db.db_live, cand0)),
+        "gather3": (gather_n(3), (db.db_live, cand0)),
+        "gather_clustered": (gather_clustered, (db.db_live, cand0)),
+        "score": (score, (rows0, qlive0)),
+        "argmin": (argmin_tail, (dc0, cand0)),
+        "full": (full, (db.db_live, cand0, qlive0, queries0)),
+        "bpsgather": (bps_gather, (bps0,)),
+        "rescore": (rescore, (db.db_live, qlive0)),
+        "scatter": (scatter, (bps0, vals0)),
+        "scatter_sorted": (scatter_sorted, (bps0, vals0)),
+        "dus_scatter": (dus_scatter, (jnp.zeros((nb + 64 * m, 2), _F32),
+                                      vals0)),
+        "staticq_gather": (staticq_gather, (db.static_q,)),
+        "staticq_slice": (staticq_slice,
+                          (jnp.zeros((nb + 64 * m,
+                                      int(db.static_q.shape[1])), _F32),)),
+    }
+    rec = {"m": m, "na": na, "nc": nc, "iters": iters}
+    base = None
+    for name, (body, arrs) in cases.items():
+        us = bench(loop(body), arrs) / iters * 1e6
+        if name == "noop":
+            base = us
+        rec[name + "_us"] = round(us, 2)
+        extra = f"  (-noop: {us - base:.1f})" if base is not None else ""
+        print(f"# {name}: {us:.2f} us/step{extra}", file=sys.stderr,
+              flush=True)
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1246,10 +1246,18 @@ def wavefront_scan_core(db: TpuLevelDB, kappa_mult, anchor_fn,
 
             use_coh = has_coh & (d_coh <= d_app * kappa_mult)
             p = jnp.where(use_coh, p_coh, p_app).astype(jnp.int32)
-            # write only live lanes: -1 padding -> index nb, dropped
-            wpix = jnp.where(lane_ok, pix, nb)
+            # write only live lanes: -1 padding -> OOB sentinel, dropped.
+            # Each pad lane gets a DISTINCT OOB sentinel (nb + lane) so the
+            # index vector is fully unique (the schedule's live lanes are
+            # strictly increasing flat indices, pads at the end), letting
+            # the scatter lower with unique_indices=True: measured -0.35 s
+            # on the north star.  indices_are_sorted=True — also true of
+            # this vector — was tried and REJECTED: it lowers to a path
+            # that cost +0.9 s end-to-end on this toolchain.
+            wpix = jnp.where(lane_ok, pix,
+                             nb + jax.lax.iota(jnp.int32, pix.shape[0]))
             row = jnp.stack([afilt_fn(p), p.astype(_F32)], axis=-1)
-            bps = bps.at[wpix].set(row, mode="drop")
+            bps = bps.at[wpix].set(row, mode="drop", unique_indices=True)
             return bps, n_coh + (use_coh & lane_ok).sum(dtype=jnp.int32)
 
         return step

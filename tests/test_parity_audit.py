@@ -86,27 +86,34 @@ def test_committed_bench_record_backs_auto_default():
     import subprocess
 
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    # enumerate COMMITTED bench files via git (round-4 ADVICE item 3: a
-    # working-directory glob would validate untracked/stale local bench
-    # files instead of the evidence actually at HEAD); fall back to the
-    # glob only outside a git checkout (e.g. an exported tarball)
+    # enumerate AND read the committed bench records via git (round-4
+    # ADVICE item 3): both the file list and the CONTENT come from HEAD,
+    # so an untracked/stale/locally-edited/deleted working-tree bench
+    # file can neither be validated nor crash the test.  Fall back to the
+    # working-directory glob only when git can't serve HEAD (exported
+    # tarball; note ls-files alone would also return empty when such an
+    # export lands inside some enclosing work tree)
+    reads = []
     try:
         tracked = subprocess.run(
-            ["git", "ls-files", "BENCH_r*.json"], cwd=here,
+            ["git", "ls-tree", "-r", "--name-only", "HEAD"], cwd=here,
             capture_output=True, text=True, timeout=30, check=True,
         ).stdout.split()
-        benches = sorted(os.path.join(here, p) for p in tracked)
+        for p in sorted(tracked):
+            if re.fullmatch(r"BENCH_r\d+\.json", p):
+                raw = subprocess.run(
+                    ["git", "show", f"HEAD:{p}"], cwd=here,
+                    capture_output=True, text=True, timeout=30, check=True,
+                ).stdout
+                reads.append((os.path.join(here, p), raw))
     except (OSError, subprocess.SubprocessError):
-        benches = []
-    if not benches:
-        # outside a git checkout — or exported without .git but extracted
-        # inside some ENCLOSING work tree, where ls-files exits 0 with
-        # empty output — fall back to the working-directory glob
-        benches = sorted(glob.glob(os.path.join(here, "BENCH_r*.json")))
+        reads = []
+    if not reads:
+        for path in sorted(glob.glob(os.path.join(here, "BENCH_r*.json"))):
+            with open(path) as f:
+                reads.append((path, f.read()))
     records = []
-    for path in benches:
-        with open(path) as f:
-            raw = f.read()
+    for path, raw in reads:
         data = json.loads(raw)
         # the driver wraps bench.py's JSON line under "parsed"; when that
         # is null (output overflowed), the record survives only in the
