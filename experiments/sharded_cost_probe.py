@@ -109,7 +109,7 @@ def main() -> int:
     mesh = make_mesh(db_shards=1)
     to_j = lambda x: None if x is None else jnp.asarray(x, jnp.float32)
     template = make_level_template(params, job, "wavefront")
-    dbp, dbnp, afp, wk, shift = build_sharded_db(
+    dbp, dbnp, afp, wk, shift, dbl = build_sharded_db(
         spec, to_j(job.a_src), to_j(job.a_filt), to_j(job.a_src_coarse),
         to_j(job.a_filt_coarse), None, template.rowsafe, mesh, True,
         _tile_rows(spec.total), packed=True)
@@ -123,7 +123,7 @@ def main() -> int:
     def run_mesh():
         bp, s, n = multichip_level_step(
             mesh, static_q[None], dbp, dbnp, afp, template,
-            job.kappa_mult, force_xla=False, wk_shard=wk)
+            job.kappa_mult, force_xla=False, wk_shard=wk, dbl_shard=dbl)
         jax.block_until_ready((bp, s))
 
     run_mesh()  # warm/compile

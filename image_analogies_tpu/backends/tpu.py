@@ -128,6 +128,9 @@ class TpuLevelDB:
     db_sharded: Optional[jax.Array]  # (Npad, Fp) laid out over mesh 'db' axis
     dbn_sharded: Optional[jax.Array]
     afilt_sharded: Optional[jax.Array]  # (Npad,) A' values, sharded alongside
+    # round-5 sharded [live | dead norm | A'] rows (packed mesh wavefront
+    # only) — the step's coherence/re-score/value psum source
+    dblive_sharded: Optional[jax.Array]  # (Npad, L+2) over 'db' or None
     diag: Optional[Tuple[jax.Array, ...]]  # anti-diagonal schedule
     # segments (wavefront): tuple of (T_s, M_s) index arrays, tight widths
     # Pre-padded rowsafe DB for the hot loop (tile-aligned rows, 128-aligned
@@ -162,8 +165,10 @@ class TpuLevelDB:
     # Summation order differs from the full-row form only like any
     # XLA-vs-NumPy reordering — fp-band ties the audit explains (verified
     # on-chip round 4: 256^2 explained=1.0; the 1024^2 record lands in
-    # the driver-written BENCH_r04.json at round end).
-    db_live: Optional[jax.Array]  # (Na, L+1) fp32 or None
+    # the driver-written BENCH_r04.json at round end).  Round 5 appends
+    # the A' VALUE as a final column — [live | dead norm | A'] — so the
+    # fused step's one row gather also yields the output value.
+    db_live: Optional[jax.Array]  # (Na, L+2) fp32 or None
     ha: int = field(metadata=dict(static=True))
     wa: int = field(metadata=dict(static=True))
     hb: int = field(metadata=dict(static=True))
@@ -403,12 +408,16 @@ def _prepare_level_arrays(
     if pad_full and pad_tile and pad_mode.startswith("packed"):
         # live/dead-split scoring arrays (see TpuLevelDB) — TPU wavefront
         # packed modes only: the CPU/XLA test paths keep full-row scoring
-        # so their exact-equality fixtures stay byte-stable
+        # so their exact-equality fixtures stay byte-stable.  Layout
+        # (Na, L+2): [live cols | dead norm | A' value] — the A' value
+        # rides the same gathered row (rows cost per fetch; round 5), so
+        # the fused step reads score AND output value in one gather.
         live_np = np.nonzero(spec.query_live_mask())[0]
         dead_np = np.setdiff1d(np.arange(spec.total), live_np)
         out["db_live"] = jnp.concatenate(
             [db[:, live_np],
-             jnp.sum(db[:, dead_np] ** 2, axis=1)[:, None]], axis=1)
+             jnp.sum(db[:, dead_np] ** 2, axis=1)[:, None],
+             a_filt.reshape(-1)[:, None]], axis=1)
     if pad_tile:
         src = db if pad_full else db_rowsafe
         srcn = out["db_sqnorm"] if pad_full else out["db_rowsafe_sqnorm"]
@@ -499,11 +508,22 @@ def _cached_sharded_db_builder(mesh, spec, pad_full: bool, npad: int,
         wk, _, _, shift, _ = _packed_weight_arrays(db, spec, npad,
                                                    mode2p=True)
         shiftp = jnp.zeros((fp,), _F32).at[:f].set(shift)
-        return (dbp, dbnp, afp, wk, shiftp)
+        # sharded twin of the single-chip db_live (round-5 mesh gather
+        # diet): [live cols | dead norm | A' value] — the step's coherence
+        # psum moves L+2 columns instead of full-F rows, and the A'-value
+        # psum disappears (parallel/step.py row_live_fn)
+        live_np = np.nonzero(spec.query_live_mask())[0]
+        dead_np = np.setdiff1d(np.arange(spec.total), live_np)
+        lw = live_np.size
+        dbl = jnp.zeros((npad, lw + 2), _F32)
+        dbl = dbl.at[:n, :lw].set(db[:, live_np])
+        dbl = dbl.at[:n, lw].set(jnp.sum(db[:, dead_np] ** 2, axis=1))
+        dbl = dbl.at[:n, lw + 1].set(a_filt.reshape(-1).astype(_F32))
+        return (dbp, dbnp, afp, wk, shiftp, dbl)
 
     outs = (sh_db, sh_row, sh_row)
     if packed:
-        outs = outs + (sh_db, sh_rep)
+        outs = outs + (sh_db, sh_rep, sh_db)
     return jax.jit(build, out_shardings=outs)
 
 
@@ -538,9 +558,10 @@ def build_sharded_db(spec, a_src, a_filt, a_src_coarse, a_filt_coarse,
     without any chip holding the full DB (see `_cached_sharded_db_builder`).
     Used by the single-image sharded path and the sharded video phase.
 
-    Returns a 5-tuple (dbp, dbnp, afiltp, wk, shift); the last two are
-    None unless ``packed`` (the exact_hi2_2p mesh scan — wk is the
-    round-4 K-wide weight array)."""
+    Returns a 6-tuple (dbp, dbnp, afiltp, wk, shift, dbl); the last three
+    are None unless ``packed`` (the exact_hi2_2p mesh scan — wk is the
+    round-4 K-wide weight array, dbl the round-5 sharded
+    [live | dead norm | A'] scoring rows)."""
     from image_analogies_tpu.parallel.sharded_match import \
         sharded_pad_geometry
 
@@ -550,7 +571,7 @@ def build_sharded_db(spec, a_src, a_filt, a_src_coarse, a_filt_coarse,
     fn = _cached_sharded_db_builder(mesh, spec, pad_full, npad, fp, packed)
     out = fn(a_src, a_filt, a_src_coarse, a_filt_coarse, a_temporal,
              rowsafe)
-    return out if packed else out + (None, None)
+    return out if packed else out + (None, None, None)
 
 
 def make_level_template(params, job: LevelJob, strategy: str,
@@ -590,8 +611,8 @@ def make_level_template(params, job: LevelJob, strategy: str,
         rowsafe=jnp.asarray(rowsafe), a_filt_flat=z1,
         fine_sqrtw=jnp.asarray(spec.sqrt_weights()[fsl]),
         off=jnp.asarray(off), db_sharded=None, dbn_sharded=None,
-        afilt_sharded=None, diag=diag, db_pad=None, db_pad2=None,
-        dbn_pad=None,
+        afilt_sharded=None, dblive_sharded=None, diag=diag, db_pad=None,
+        db_pad2=None, dbn_pad=None,
         dbnh_pad=None, feat_mean=None, live_idx=live_idx,
         db_live=None,
         ha=ha, wa=wa, hb=hb, wb=wb, fine_start=fsl.start,
@@ -621,7 +642,8 @@ def slim_for_mesh(db: TpuLevelDB, keep_sharded: bool = False) -> TpuLevelDB:
     z2 = jnp.zeros((1, db.static_q.shape[1]), _F32)
     z1 = jnp.zeros((1,), _F32)
     kw = {} if keep_sharded else dict(db_sharded=None, dbn_sharded=None,
-                                      afilt_sharded=None, mesh=None)
+                                      afilt_sharded=None,
+                                      dblive_sharded=None, mesh=None)
     return dataclasses.replace(
         db, db=z2, db_sqnorm=z1, db_rowsafe=z2, db_rowsafe_sqnorm=z1,
         static_q=z2, a_filt_flat=z1, db_pad=None, db_pad2=None,
@@ -662,7 +684,8 @@ def _resolve_pixel(db: TpuLevelDB, q, bp, s, p_app, d_app_fn, kappa_mult):
 
 
 def _batched_coherence(db: TpuLevelDB, s, queries, idx_c, ok, n_cand: int,
-                       row_fn, q_live=None, s_r=None):
+                       row_fn, q_live=None, s_r=None, p_app=None,
+                       live_gather=None):
     """Batched Ashikhmin candidates for M pixels at once (Hertzmann §3.2):
     for each query m the candidates are {s(r) + (q - r)} over its first
     ``n_cand`` causal window positions r (idx_c (M, n_cand) flat positions,
@@ -680,7 +703,24 @@ def _batched_coherence(db: TpuLevelDB, s, queries, idx_c, ok, n_cand: int,
     (the wavefront step packs them into its B' gather — one gather serves
     both); otherwise they gather from ``s`` here.
 
-    Returns (p_coh (M,), d_coh (M,), has_coh (M,))."""
+    ``p_app`` (requires ``q_live``) appends the anchor pick as one more
+    gathered-and-scored column, so the anchor's exact re-score rides THE
+    SAME row gather as the candidates (TPU gathers cost per row; a
+    separate M-row re-score fetch measured ~48 us/step at north-star
+    plateau — experiments/coherence_parts_probe.py).  Same rows, same
+    formula; XLA may order the (M, n+1, L+1) reduction differently than
+    the standalone (M, L+1) one — an fp-band perturbation of d_app, the
+    class the tie-audit adjudicates (kappa_boundary).
+
+    ``live_gather`` overrides the row fetch (default ``db.db_live[idx]``)
+    — the mesh step psum-gathers the SHARDED db_live here, shrinking the
+    per-step ICI payload from full-F rows to L+2 columns.
+
+    Returns (p_coh, d_coh, has_coh) — all (M,) — plus, when ``p_app`` is
+    given, d_app (M,) and, when the gathered rows carry the round-5 A'
+    column (width L+2), (af_coh, af_app): the A' values of the coherence
+    pick and the anchor pick, making the step's separate A'-value fetch
+    redundant."""
     if s_r is None:
         s_r = s[idx_c]  # (M, n_cand)
     ci = s_r // db.wa - db.off[None, :n_cand, 0]
@@ -689,17 +729,32 @@ def _batched_coherence(db: TpuLevelDB, s, queries, idx_c, ok, n_cand: int,
     cand = (jnp.clip(ci, 0, db.ha - 1) * db.wa
             + jnp.clip(cj, 0, db.wa - 1))
     if q_live is not None:
-        cf = db.db_live[cand]  # (M, n_cand, L+1): live cols | dead norm
-        dc = (jnp.sum((cf[..., :-1] - q_live[:, None, :]) ** 2, axis=-1)
-              + cf[..., -1])
+        lw = q_live.shape[-1]
+        gidx = (cand if p_app is None
+                else jnp.concatenate([cand, p_app[:, None]], axis=1))
+        if live_gather is None:
+            cf = db.db_live[gidx]  # (M, n_cand(+1), L+1 or L+2)
+        else:
+            cf = live_gather(gidx)
+        dca = (jnp.sum((cf[..., :lw] - q_live[:, None, :]) ** 2, axis=-1)
+               + cf[..., lw])
+        dc = dca[:, :n_cand]
     else:
+        assert p_app is None, "fused anchor re-score needs db_live"
         cf = row_fn(cand)  # (M, n_cand, F)
         dc = jnp.sum((cf - queries[:, None, :]) ** 2, axis=-1)
     dc = jnp.where(ok, dc, jnp.inf)
     k = jnp.argmin(dc, axis=1)
     d_coh = jnp.take_along_axis(dc, k[:, None], axis=1)[:, 0]
     p_coh = jnp.take_along_axis(cand, k[:, None], axis=1)[:, 0]
-    return p_coh, d_coh, ok.any(axis=1)
+    if p_app is None:
+        return p_coh, d_coh, ok.any(axis=1)
+    out = (p_coh, d_coh, ok.any(axis=1), dca[:, n_cand])
+    if cf.shape[-1] > lw + 1:  # A' value column present
+        af = cf[..., lw + 1]
+        af_coh = jnp.take_along_axis(af, k[:, None], axis=1)[:, 0]
+        return out + (af_coh, af[:, n_cand])
+    return out
 
 
 def _pixel_coherence(db: TpuLevelDB, qvec, q, s):
@@ -968,9 +1023,14 @@ def _scan_tile(npad: int, fp: int, cap_rows: int = 0) -> int:
     return tile
 
 
-def make_anchor_fn(db: TpuLevelDB):
+def make_anchor_fn(db: TpuLevelDB, defer_rescore: bool = False):
     """The wavefront strategy's full-DB anchor: (queries (M,F)) ->
     (p_app (M,) int32, d_app (M,) fp32 EXACT squared distance).
+
+    With ``defer_rescore`` (packed modes carrying ``db_live`` only) the
+    anchor returns (p_app, None) and the caller computes d_app through
+    the coherence block's fused row gather (`_batched_coherence(p_app=)`)
+    — same value, one fewer per-step gather.
 
     Both modes end in an exact fp32 re-score against the fp32 DB, so d_app —
     the kappa rule's threshold — is always oracle-grade; the modes differ in
@@ -1092,11 +1152,19 @@ def make_anchor_fn(db: TpuLevelDB):
                     q1, q2, gr.astype(jnp.bfloat16), db.db_pad, db.db_pad2,
                     db.dbnh_pad, tile_n=tile)
             p = jnp.minimum(p, na - 1)
+            if defer_rescore and db.db_live is not None:
+                # the wavefront step re-scores p through the SAME db_live
+                # row gather as its coherence candidates (d_app = None
+                # signals the fused path) — one fewer M-row fetch/step
+                return p, None
             if db.db_live is not None:
-                # live/dead-split exact re-score (see TpuLevelDB.db_live)
-                g = db.db_live[p]  # (M, L+1): live cols | dead norm
-                d = (jnp.sum((g[:, :-1] - queries[:, live_idx]) ** 2,
-                             axis=1) + g[:, -1])
+                # live/dead-split exact re-score (see TpuLevelDB.db_live;
+                # column L is the dead norm — L+1, when present, is the
+                # round-5 A' value, not a score term)
+                lw = live_idx.shape[0]
+                g = db.db_live[p]
+                d = (jnp.sum((g[:, :lw] - queries[:, live_idx]) ** 2,
+                             axis=1) + g[:, lw])
                 return p, d
             return p, jnp.sum((db.db[p] - queries) ** 2, axis=1)
 
@@ -1136,7 +1204,7 @@ def make_anchor_fn(db: TpuLevelDB):
 
 
 def wavefront_scan_core(db: TpuLevelDB, kappa_mult, anchor_fn,
-                        row_fn=None, afilt_fn=None):
+                        row_fn=None, afilt_fn=None, live_gather=None):
     """The parity fast path (VERDICT.md round-1 item 1): the oracle's exact
     algorithm on an anti-diagonal schedule.
 
@@ -1180,10 +1248,12 @@ def wavefront_scan_core(db: TpuLevelDB, kappa_mult, anchor_fn,
         raise ValueError(
             f"wavefront packed carry stores source indices as exact f32 "
             f"values; exemplar {db.ha}x{db.wa} exceeds 2^24 rows")
-    # live/dead-split coherence scoring (single-chip TPU path only — the
-    # mesh supplies its own row_fn and keeps full-row psum gathers)
-    use_live = (row_fn is None and db.db_live is not None
-                and db.live_idx is not None)
+    # live/dead-split coherence scoring: single-chip when the build
+    # carries db_live; on the mesh when the step supplies `live_gather`
+    # (a psum-gather of the SHARDED db_live — round-5 gather diet)
+    use_live = (db.live_idx is not None
+                and ((row_fn is None and db.db_live is not None)
+                     or live_gather is not None))
     if row_fn is None:
         row_fn = lambda i: db.db[i]
     if afilt_fn is None:
@@ -1237,12 +1307,25 @@ def wavefront_scan_core(db: TpuLevelDB, kappa_mult, anchor_fn,
 
             # batched Ashikhmin coherence over the causal window, scored
             # against the FULL DB (the oracle's metric; live/dead split
-            # on the single-chip TPU path — same metric, fewer gathered
-            # rows)
-            p_coh, d_coh, has_coh = _batched_coherence(
-                db, None, queries, idx, inb, nc, row_fn,
-                q_live=(queries[:, db.live_idx] if use_live else None),
-                s_r=s_r)
+            # on the single-chip TPU path and the live-gathering mesh
+            # path — same metric, fewer gathered rows / smaller psum).
+            # When the anchor deferred its re-score (d_app None), p_app
+            # rides the same row gather as the candidates, and when the
+            # rows carry the A' column the output value does too.
+            af_pair = None
+            if use_live and d_app is None:
+                out = _batched_coherence(
+                    db, None, queries, idx, inb, nc, row_fn,
+                    q_live=queries[:, db.live_idx], s_r=s_r, p_app=p_app,
+                    live_gather=live_gather)
+                p_coh, d_coh, has_coh, d_app = out[:4]
+                if len(out) > 4:
+                    af_pair = out[4:]
+            else:
+                p_coh, d_coh, has_coh = _batched_coherence(
+                    db, None, queries, idx, inb, nc, row_fn,
+                    q_live=(queries[:, db.live_idx] if use_live else None),
+                    s_r=s_r, live_gather=live_gather)
 
             use_coh = has_coh & (d_coh <= d_app * kappa_mult)
             p = jnp.where(use_coh, p_coh, p_app).astype(jnp.int32)
@@ -1256,7 +1339,13 @@ def wavefront_scan_core(db: TpuLevelDB, kappa_mult, anchor_fn,
             # that cost +0.9 s end-to-end on this toolchain.
             wpix = jnp.where(lane_ok, pix,
                              nb + jax.lax.iota(jnp.int32, pix.shape[0]))
-            row = jnp.stack([afilt_fn(p), p.astype(_F32)], axis=-1)
+            if af_pair is not None:
+                # A' value came back with the fused row gather — no
+                # separate a_filt_flat fetch
+                af = jnp.where(use_coh, af_pair[0], af_pair[1])
+            else:
+                af = afilt_fn(p)
+            row = jnp.stack([af, p.astype(_F32)], axis=-1)
             bps = bps.at[wpix].set(row, mode="drop", unique_indices=True)
             return bps, n_coh + (use_coh & lane_ok).sum(dtype=jnp.int32)
 
@@ -1275,7 +1364,8 @@ def wavefront_scan_core(db: TpuLevelDB, kappa_mult, anchor_fn,
 
 @jax.jit
 def _run_wavefront(db: TpuLevelDB, kappa_mult):
-    return wavefront_scan_core(db, kappa_mult, make_anchor_fn(db))
+    return wavefront_scan_core(db, kappa_mult,
+                               make_anchor_fn(db, defer_rescore=True))
 
 
 # Strategies with the uniform (db, kappa_mult) -> (bp, s, n_coh) signature;
@@ -1390,8 +1480,8 @@ class TpuMatcher(Matcher):
             packed = (on_tpu and strategy == "wavefront"
                       and packed_scan_eligible(self.params.match_mode,
                                                ha * wa))
-            (db_sharded, dbn_sharded, afilt_sharded, wk,
-             shift) = build_sharded_db(
+            (db_sharded, dbn_sharded, afilt_sharded, wk, shift,
+             dbl_sharded) = build_sharded_db(
                 spec, to_j(job.a_src), to_j(job.a_filt),
                 to_j(job.a_src_coarse), to_j(job.a_filt_coarse),
                 to_j(job.a_temporal), template.rowsafe, mesh, pad_full,
@@ -1404,7 +1494,7 @@ class TpuMatcher(Matcher):
             return dataclasses.replace(
                 template, static_q=static_q, db_sharded=db_sharded,
                 dbn_sharded=dbn_sharded, afilt_sharded=afilt_sharded,
-                db_pad=wk, feat_mean=shift,
+                dblive_sharded=dbl_sharded, db_pad=wk, feat_mean=shift,
                 mesh=mesh)
 
         arrs = _prepare_level_arrays(
@@ -1486,7 +1576,7 @@ class TpuMatcher(Matcher):
                 db.mesh, db.static_q[None], db.db_sharded, db.dbn_sharded,
                 db.afilt_sharded, slim_for_mesh(db), job.kappa_mult,
                 force_xla=jax.default_backend() != "tpu",
-                wk_shard=db.db_pad)
+                wk_shard=db.db_pad, dbl_shard=db.dblive_sharded)
             bp, s, n_coh = bp[0], s[0], n_coh[0]
         elif db.strategy == "batched":
             bp, s, counts = _run_batched(db, jnp.float32(job.kappa_mult))

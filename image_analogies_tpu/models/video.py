@@ -232,7 +232,7 @@ def _sharded_phase(a, ap, frames, params: AnalogyParams, mesh,
                       and packed_scan_eligible(
                           params.match_mode,
                           job0.a_shape[0] * job0.a_shape[1]))
-            dbp, dbnp, afp, wk, _shift = build_sharded_db(
+            dbp, dbnp, afp, wk, _shift, dbl = build_sharded_db(
                 spec, to_j(job0.a_src), to_j(job0.a_filt),
                 to_j(job0.a_src_coarse), to_j(job0.a_filt_coarse),
                 to_j(job0.a_temporal), template.rowsafe, mesh,
@@ -256,7 +256,8 @@ def _sharded_phase(a, ap, frames, params: AnalogyParams, mesh,
                 to_j(b_temp_stacks[level]) if temporal else None)
             out = multichip_level_step(
                 mesh, frame_static_q, dbp, dbnp, afp, template,
-                job0.kappa_mult, force_xla=force_xla, wk_shard=wk)
+                job0.kappa_mult, force_xla=force_xla, wk_shard=wk,
+                dbl_shard=dbl)
             if params.level_retries > 0:
                 # a transient device fault must surface INSIDE the retry
                 # wrapper, not at the post-wrapper host fetch (same §5.3

@@ -54,15 +54,22 @@ from image_analogies_tpu.parallel.sharded_match import (
 @functools.lru_cache(maxsize=None)
 def _cached_multichip_step(mesh: Mesh, strategy: str, force_xla: bool,
                            precision, packed: bool,
-                           packed_interpret: bool = False):
+                           packed_interpret: bool = False,
+                           fused_live: bool = False):
     """Build the shard_map'd multi-frame level step once per
-    (mesh, strategy, force_xla, precision, packed); jit caching then keys
-    on shapes.  ``packed`` switches the wavefront anchor's scan from the
-    HIGHEST merged kernel to the exact_hi2_2p packed champion kernel per
-    shard (same parity class, ~2x fewer MXU passes) — real-TPU meshes
-    only; the signature grows by (w1, w2, dbnh) shard inputs."""
+    (mesh, strategy, force_xla, precision, packed, fused_live); jit
+    caching then keys on shapes.  ``packed`` switches the wavefront
+    anchor's scan from the HIGHEST merged kernel to the exact_hi2_2p
+    packed champion kernel per shard (same parity class, ~2x fewer MXU
+    passes) — real-TPU meshes only; the signature grows by the wk shard
+    input.  ``fused_live`` (packed wavefront + a dblive shard — the
+    round-5 gather diet) scores coherence through a psum-gather of the
+    SHARDED [live | dead norm | A'] rows: the per-step ICI payload drops
+    from M x window x F full rows to L+2 columns, the anchor re-score
+    rides the same gather (deferred d_app), and the A'-value psum
+    disappears (the value comes back as a gathered column)."""
 
-    def local_step(static_q_loc, db_loc, dbn_loc, af_loc, wk_loc,
+    def local_step(static_q_loc, db_loc, dbn_loc, af_loc, wk_loc, dbl_loc,
                    tmpl: TpuLevelDB, km):
         rows = db_loc.shape[0]
         f = tmpl.static_q.shape[1]
@@ -74,11 +81,8 @@ def _cached_multichip_step(mesh: Mesh, strategy: str, force_xla: bool,
                 queries, db_loc, dbn_loc, "db", force_xla=force_xla,
                 precision=precision, prepadded=True, tile_n=_tile_rows(f))
 
-        def anchor_fn(queries):
-            # wavefront anchor contract (see backends.tpu.make_anchor_fn):
-            # globally-reduced pick + exact fp32 re-score through the
-            # psum row-gather — the kappa rule's d_app never comes from
-            # scan space on any path.
+        def scan_fn(queries):
+            # globally-reduced pick, no re-score (see anchor_fn)
             if packed:
                 qc = (queries
                       - tmpl.feat_mean[None, :queries.shape[1]])
@@ -91,6 +95,18 @@ def _cached_multichip_step(mesh: Mesh, strategy: str, force_xla: bool,
                     interpret=packed_interpret)
             else:
                 p, _ = approx_fn(queries)
+            return p
+
+        def anchor_fn(queries):
+            # wavefront anchor contract (see backends.tpu.make_anchor_fn):
+            # globally-reduced pick + exact fp32 re-score — through the
+            # full-row psum gather, or deferred into the coherence
+            # block's live-row gather (fused_live) — the kappa rule's
+            # d_app never comes from scan space on any path.
+            p = scan_fn(queries)
+            if fused_live:
+                return p, None  # wavefront_scan_core re-scores via
+                #                 live_gather (same rows, same formula)
             return p, jnp.sum((row_fn(p) - queries) ** 2, axis=1)
 
         def _local(idx):
@@ -106,6 +122,12 @@ def _cached_multichip_step(mesh: Mesh, strategy: str, force_xla: bool,
             vals = jnp.where(inb[..., None], db_loc[loc], 0.0)
             return jax.lax.psum(vals, "db")[..., :f]
 
+        def live_gather(idx):
+            # the round-5 diet: L+2 columns instead of full-F rows
+            loc, inb = _local(idx)
+            vals = jnp.where(inb[..., None], dbl_loc[loc], 0.0)
+            return jax.lax.psum(vals, "db")
+
         def afilt_fn(idx):
             loc, inb = _local(idx)
             return jax.lax.psum(jnp.where(inb, af_loc[loc], 0.0), "db")
@@ -115,8 +137,9 @@ def _cached_multichip_step(mesh: Mesh, strategy: str, force_xla: bool,
                 **{**{f: getattr(tmpl, f) for f in tmpl.__dataclass_fields__},
                    "static_q": static_q})
             if strategy == "wavefront":
-                return wavefront_scan_core(dbt, km, anchor_fn, row_fn,
-                                           afilt_fn)
+                return wavefront_scan_core(
+                    dbt, km, anchor_fn, row_fn, afilt_fn,
+                    live_gather=live_gather if fused_live else None)
             bp, s, counts = batched_scan_core(dbt, km, approx_fn, row_fn,
                                               afilt_fn)
             return bp, s, counts[0]
@@ -129,7 +152,7 @@ def _cached_multichip_step(mesh: Mesh, strategy: str, force_xla: bool,
         local_step,
         mesh=mesh,
         in_specs=(P("data", None, None), P("db", None), P("db"), P("db"),
-                  P("db", None), P(), P()),
+                  P("db", None), P("db", None), P(), P()),
         out_specs=(P("data", None), P("data", None), P("data")),
         check_rep=False,
     )
@@ -149,6 +172,8 @@ def multichip_level_step(
     # (build_sharded_db with packed=True); None -> HIGHEST merged scan
     packed_interpret: bool = False,  # tests: packed scan via the Pallas
     # interpreter on CPU meshes (overrides the force_xla packed gate)
+    dbl_shard: jax.Array = None,  # (Npad, L+2) [live|dead norm|A'] shard
+    # (round-5 gather diet); None keeps the full-row psum gathers
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Whole-level scan for T frames on the ('data','db') mesh.  Returns
     (bp (T, Nb), s (T, Nb), n_coherence (T,)).
@@ -175,13 +200,16 @@ def multichip_level_step(
                  else jax.lax.Precision.DEFAULT)
     packed = (wk_shard is not None and template.strategy == "wavefront"
               and (not force_xla or packed_interpret))
+    fused_live = packed and dbl_shard is not None
     if not packed:
         # tiny placeholder shard keeps ONE shard_map signature; the
         # non-packed anchor never reads it
         wk_shard = jnp.zeros((db_shards, 1), jnp.bfloat16)
+    if not fused_live:
+        dbl_shard = jnp.zeros((db_shards, 1), jnp.float32)
     step = _cached_multichip_step(mesh, template.strategy, force_xla,
                                   precision, packed,
-                                  packed and packed_interpret)
+                                  packed and packed_interpret, fused_live)
     return step(frame_static_q, db_shard_src, dbn_shard_src,
-                afilt_shard_src, wk_shard, template,
+                afilt_shard_src, wk_shard, dbl_shard, template,
                 jnp.float32(kappa_mult))

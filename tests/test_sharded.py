@@ -282,13 +282,18 @@ def test_packed_champion_allreduce_matches_global(rng):
     np.testing.assert_array_equal(np.asarray(gi), ref)
 
 
-def test_packed_mesh_level_matches_solo_interpret(rng):
+@pytest.mark.parametrize("fused", [False, True])
+def test_packed_mesh_level_matches_solo_interpret(rng, fused):
     """End-to-end coverage of the PRODUCTION packed mesh wavefront (the
     real-TPU scan) on CI hardware: the packed kernel runs through the
     Pallas interpreter inside the virtual db_shards=4 shard_map, driven by
     the same build_sharded_db(packed=True) the TPU path uses, and the
     level output must bit-match the solo CPU wavefront (the interpreter's
-    scan is fp32, so picks are exact)."""
+    scan is fp32, so picks are exact).  ``fused`` additionally routes the
+    coherence/re-score/A'-value reads through the round-5 sharded
+    [live | dead norm | A'] psum gather (the production real-TPU form);
+    its live-split scoring reorders fp sums, so the tie-aware check below
+    adjudicates any divergence."""
     import dataclasses
 
     from image_analogies_tpu.backends.base import LevelJob
@@ -317,7 +322,7 @@ def test_packed_mesh_level_matches_solo_interpret(rng):
     mesh = make_mesh(db_shards=4)
     to_j = lambda x: None if x is None else jnp.asarray(x, jnp.float32)
     template = make_level_template(params, job, "wavefront")
-    dbp, dbnp, afp, wk, shift = build_sharded_db(
+    dbp, dbnp, afp, wk, shift, dbl = build_sharded_db(
         spec, to_j(job.a_src), to_j(job.a_filt), None, None, None,
         template.rowsafe, mesh, True, 1, packed=True)
     template = dataclasses.replace(template, feat_mean=shift)
@@ -326,7 +331,7 @@ def test_packed_mesh_level_matches_solo_interpret(rng):
     bp, s, _ = multichip_level_step(
         mesh, static_q[None], dbp, dbnp, afp, template, job.kappa_mult,
         force_xla=True, wk_shard=wk,
-        packed_interpret=True)
+        packed_interpret=True, dbl_shard=dbl if fused else None)
     s_mesh = np.asarray(s[0]).reshape(24, 24)
     # the packed score formula rounds differently than the solo XLA score
     # (qc.dbc - ||dbc||^2/2 vs ||db||^2 - 2 q.db), so near-tied rows of this

@@ -72,8 +72,11 @@ def test_sharded_video_does_not_retrace():
                       data_shards=2, db_shards=2)
     video_analogy(a, ap, frames, p)
     mesh = make_mesh(db_shards=2, data_shards=2)
+    # args must match the production call EXACTLY (lru_cache keys on the
+    # literal argument tuple — omitted defaults are a different key)
     step = _cached_multichip_step(mesh, "batched", True,
-                                  jax.lax.Precision.DEFAULT, False, False)
+                                  jax.lax.Precision.DEFAULT, False, False,
+                                  False)
     before = step._cache_size()
     assert before > 0  # the run above used this cached jit
     video_analogy(a, ap, frames, p)

@@ -163,3 +163,83 @@ def test_live_dead_split_scoring_matches_full_rows():
     np.testing.assert_allclose(np.asarray(bp_l), np.asarray(bp_f),
                                atol=1e-5)
     assert (np.asarray(s_l) == np.asarray(s_f)).mean() > 0.95
+
+
+def test_fused_anchor_rescore_matches_standalone():
+    """The round-5 fused gather (`_batched_coherence(p_app=...)`): the
+    anchor re-score rides the coherence candidates' row gather.  d_app
+    must match the standalone live/dead-split re-score to fp-band (same
+    rows and formula; reduction order may differ), and the coherence
+    outputs must be untouched by the extra column."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from image_analogies_tpu.backends.base import LevelJob
+    from image_analogies_tpu.backends.tpu import (
+        TpuMatcher,
+        _batched_coherence,
+    )
+    from image_analogies_tpu.config import AnalogyParams
+    from image_analogies_tpu.ops.features import spec_for_level
+    from tests.conftest import make_pair
+
+    a, ap, b = make_pair(16, 16, seed=3)
+    p = AnalogyParams(levels=1, backend="tpu", strategy="wavefront")
+    spec = spec_for_level(p, 0, 1, 1)
+    job = LevelJob(level=0, spec=spec, kappa_mult=p.kappa_factor(0) ** 2,
+                   a_src=a, a_filt=ap, b_src=b)
+    db = TpuMatcher(p).build_features(job)
+    live = np.nonzero(spec.query_live_mask())[0]
+    dead = np.setdiff1d(np.arange(spec.total), live)
+    dbf = np.asarray(db.db)
+    db = dataclasses.replace(
+        db, db_live=jnp.asarray(np.concatenate(
+            [dbf[:, live], (dbf[:, dead] ** 2).sum(-1)[:, None]], axis=1)),
+        live_idx=jnp.asarray(live, np.int32))
+
+    rng = np.random.default_rng(0)
+    na = db.ha * db.wa
+    m, nc = 9, (int(db.off.shape[0]) - 1) // 2
+    queries = jnp.asarray(np.asarray(db.static_q)[
+        rng.choice(db.hb * db.wb, m, replace=False)])
+    idx_c = jnp.asarray(rng.integers(0, db.hb * db.wb, (m, nc)), jnp.int32)
+    s_r = jnp.asarray(rng.integers(0, na, (m, nc)), jnp.int32)
+    ok = jnp.asarray(rng.random((m, nc)) < 0.8)
+    p_app = jnp.asarray(rng.integers(0, na, m), jnp.int32)
+    q_live = queries[:, db.live_idx]
+
+    p0, d0, h0 = _batched_coherence(db, None, queries, idx_c, ok, nc,
+                                    lambda i: db.db[i], q_live=q_live,
+                                    s_r=s_r)
+    p1, d1, h1, d_app = _batched_coherence(
+        db, None, queries, idx_c, ok, nc, lambda i: db.db[i],
+        q_live=q_live, s_r=s_r, p_app=p_app)
+    np.testing.assert_array_equal(np.asarray(p0), np.asarray(p1))
+    np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+    np.testing.assert_array_equal(np.asarray(h0), np.asarray(h1))
+    # the standalone re-score the fused column replaces — same rows, same
+    # formula; XLA may reduce the (M, nc+1, L+1) block in a different
+    # order than the (M, L+1) one, so the comparison is fp-band (~1e-6
+    # relative), the class the tie-audit adjudicates on-chip
+    lw = live.size
+    gj = db.db_live[p_app]
+    d_ref = jnp.sum((gj[:, :lw] - q_live) ** 2, axis=1) + gj[:, lw]
+    np.testing.assert_allclose(np.asarray(d_app), np.asarray(d_ref),
+                               rtol=1e-5, atol=1e-6)
+
+    # round-5 A' column: widen db_live to [live | dead norm | A'] — the
+    # fused call must return the picked candidates' and the anchor's A'
+    # values, and leave every other output untouched
+    afl = np.asarray(db.a_filt_flat)
+    db_w = dataclasses.replace(
+        db, db_live=jnp.concatenate(
+            [db.db_live, jnp.asarray(afl)[:, None]], axis=1))
+    p2, d2, h2, d_app2, af_coh, af_app = _batched_coherence(
+        db_w, None, queries, idx_c, ok, nc, lambda i: db_w.db[i],
+        q_live=q_live, s_r=s_r, p_app=p_app)
+    np.testing.assert_array_equal(np.asarray(p2), np.asarray(p0))
+    np.testing.assert_array_equal(np.asarray(d2), np.asarray(d0))
+    np.testing.assert_array_equal(np.asarray(d_app2), np.asarray(d_app))
+    np.testing.assert_array_equal(np.asarray(af_app), afl[np.asarray(p_app)])
+    np.testing.assert_array_equal(np.asarray(af_coh), afl[np.asarray(p2)])
