@@ -1246,8 +1246,17 @@ def wavefront_scan_core(db: TpuLevelDB, kappa_mult, anchor_fn,
     # Explicit raise, not assert: `python -O` must not strip the guard.
     if db.ha * db.wa > _WAVEFRONT_MAX_ROWS:
         raise ValueError(
-            f"wavefront packed carry stores source indices as exact f32 "
-            f"values; exemplar {db.ha}x{db.wa} exceeds 2^24 rows")
+            f"the wavefront strategy caps exemplars at 2^24 rows "
+            f"({_WAVEFRONT_MAX_ROWS}; a 4096x4096 A): this A is "
+            f"{db.ha}x{db.wa} = {db.ha * db.wa}.  Why: the scan's packed "
+            f"(Nb, 2) carry stores source-map indices as exact f32 VALUES "
+            f"(exact only below 2^24; int bit patterns in f32 lanes are "
+            f"denormal-flushed by real TPU data paths — measured round "
+            f"4).  Workarounds: strategy='batched' (no packed carry; a "
+            f"different but comparable synthesis), or downsample A/A' — "
+            f"and note a >2^24-row DB also exceeds the HBM the scan "
+            f"needs, so multi-chip db_shards with the batched strategy "
+            f"is the supported route at that scale.")
     # live/dead-split coherence scoring: single-chip when the build
     # carries db_live; on the mesh when the step supplies `live_gather`
     # (a psum-gather of the SHARDED db_live — round-5 gather diet)

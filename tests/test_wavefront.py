@@ -165,6 +165,48 @@ def test_live_dead_split_scoring_matches_full_rows():
     assert (np.asarray(s_l) == np.asarray(s_f)).mean() > 0.95
 
 
+def test_wavefront_exemplar_cap_error_names_fallback():
+    """The 2^24-row wavefront exemplar cap (f32-exact index lanes) must
+    fail CLOSED at trace time with an error naming the cap, the reason,
+    and the supported fallbacks; a boundary-sized static geometry (ha*wa
+    == 2^24) must NOT trip it."""
+    import dataclasses
+
+    import pytest
+
+    from image_analogies_tpu.backends.base import LevelJob
+    from image_analogies_tpu.backends.tpu import (
+        TpuMatcher,
+        wavefront_scan_core,
+        make_anchor_fn,
+    )
+    from image_analogies_tpu.config import AnalogyParams
+    from image_analogies_tpu.ops.features import spec_for_level
+    from tests.conftest import make_pair
+
+    a, ap, b = make_pair(14, 14, seed=1)
+    p = AnalogyParams(levels=1, backend="tpu", strategy="wavefront")
+    spec = spec_for_level(p, 0, 1, 1)
+    job = LevelJob(level=0, spec=spec, kappa_mult=p.kappa_factor(0) ** 2,
+                   a_src=a, a_filt=ap, b_src=b)
+    db = TpuMatcher(p).build_features(job)
+    # one row past the cap: the raise happens before any array op, so a
+    # statics-only override exercises the guard without a 16M-row build
+    over = dataclasses.replace(db, ha=4096, wa=4097)
+    with pytest.raises(ValueError, match=r"2\^24.*batched"):
+        wavefront_scan_core(over, 1.0, make_anchor_fn(over))
+    # exactly at the cap: no raise (the guard is strictly greater-than);
+    # trace aborts later for unrelated shape reasons, which is fine —
+    # only the guard's boundary semantics are under test here
+    at_cap = dataclasses.replace(db, ha=4096, wa=4096)
+    try:
+        wavefront_scan_core(at_cap, 1.0, make_anchor_fn(at_cap))
+    except ValueError as e:
+        assert "2^24" not in str(e)
+    except Exception:
+        pass  # downstream shape errors from the statics-only override
+
+
 def test_fused_anchor_rescore_matches_standalone():
     """The round-5 fused gather (`_batched_coherence(p_app=...)`): the
     anchor re-score rides the coherence candidates' row gather.  d_app
