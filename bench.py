@@ -21,7 +21,9 @@ All five BASELINE.json:7-12 eval configs run (round-4 VERDICT item 6):
   (~25 s on structured inputs) so every bench invocation re-validates an
   end-to-end oracle-vs-TPU number with nothing cached, tie-audit included.
 - texture-by-numbers (config 1): 256^2 labels->texture, single-scale.
-- super-resolution (config 3): 256^2, 7x7 patches, kappa in {0.5, 2, 5}.
+- super-resolution (config 3): 192^2, 7x7 patches, kappa in {0.5, 2, 5}
+  (BASELINE pins patches + sweep, not size; the 256^2 oracle alone blew a
+  25-minute budget).
 - batched video (config 5): 4 x 256^2 B-frames, temporal term, two_phase
   (the frame-sharded mesh form is validated by dryrun_multichip).
 
@@ -218,7 +220,7 @@ def main() -> int:
             "oracle": "live",
         }
 
-    if want("tbn_256") or want("superres_256") or want("video_256"):
+    if want("tbn_256") or want("superres_192") or want("video_256"):
         import tempfile
 
         from examples.make_assets import make_all
@@ -228,10 +230,17 @@ def main() -> int:
         assets = {}
         with tempfile.TemporaryDirectory() as d:
             make_all(d, size=256, seed=7)
-            for name in ("tbn_labels_a", "tbn_texture", "tbn_labels_b",
-                         "sr_sharp", "sr_low") + tuple(
-                             f"video_f{t}" for t in range(4)) + (
+            for name in ("tbn_labels_a", "tbn_texture", "tbn_labels_b"
+                         ) + tuple(f"video_f{t}" for t in range(4)) + (
                              "filter_a", "filter_ap"):
+                assets[name] = load_image(os.path.join(d, f"{name}.png"))
+        with tempfile.TemporaryDirectory() as d:
+            # super-res runs at 192^2: BASELINE.json:10 pins patches (7x7)
+            # and the kappa sweep but no size, and the 256^2 cKDTree
+            # oracle on 147-dim rows alone blew a 25-minute bench budget
+            # (measured round 5) — 192^2 keeps the leg a few minutes
+            make_all(d, size=192, seed=7)
+            for name in ("sr_sharp", "sr_low"):
                 assets[name] = load_image(os.path.join(d, f"{name}.png"))
 
     if want("tbn_256"):
@@ -247,7 +256,7 @@ def main() -> int:
         configs["tbn_256"] = _pair_fields(res_t, res_c, t_min, t_med,
                                           cpu_s)
 
-    if want("superres_256"):
+    if want("superres_192"):
         # config 3: super-resolution analogy, 7x7 patches, kappa sweep
         from image_analogies_tpu.models.modes import blur_for_superres
 
@@ -260,12 +269,15 @@ def main() -> int:
             args_s = (blurred, sharp, low)
             res_t, t_min, t_med = _timed(
                 lambda: create_image_analogy(*args_s, p))
+            # reps=1: three kappa legs already give the sweep three
+            # independent oracle draws of the same geometry
             res_c, cpu_s = _min_cpu(
                 lambda: create_image_analogy(*args_s,
-                                             p.replace(backend="cpu")))
+                                             p.replace(backend="cpu")),
+                reps=1)
             sweep[f"kappa_{kappa}"] = _pair_fields(
                 res_t, res_c, t_min, t_med, cpu_s)
-        configs["superres_256"] = sweep
+        configs["superres_192"] = sweep
 
     if want("video_256"):
         # config 5: batched video B-frames, temporal term, two_phase (the

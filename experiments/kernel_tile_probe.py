@@ -1,0 +1,91 @@
+"""Tile-size sweep for the packed2k_best scan kernel (round 5).
+
+The shipping kernel runs 256 grid steps of 4096 rows at north-star level 0
+(measured 0.845-1.03 ms vs a 625 us HBM floor).  Per-grid-step fixed cost
+(champion fold, bookkeeping, DMA issue) is a candidate for part of the
+gap: larger tiles halve the step count at the price of a bigger VMEM
+footprint — the (M, tile) fp32 score block is the limiter, so tiles past
+4096 need `vmem_limit` raised above the platform's scoped default.
+
+    python experiments/kernel_tile_probe.py [--iters 300]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from image_analogies_tpu.ops.pallas_match import packed2k_best
+
+_F32 = jnp.float32
+
+
+def main() -> int:
+    pa = argparse.ArgumentParser()
+    pa.add_argument("--iters", type=int, default=300)
+    pa.add_argument("--m", type=int, default=344)
+    pa.add_argument("--npad", type=int, default=1048576)
+    pa.add_argument("--kp", type=int, default=256)
+    pa.add_argument("--l", type=int, default=55)
+    args = pa.parse_args()
+
+    rng = np.random.default_rng(0)
+    wk = jnp.asarray(
+        rng.standard_normal((args.npad, args.kp)).astype(np.float32)
+        .astype(jnp.bfloat16))
+    q1 = jnp.asarray(rng.standard_normal((args.m, args.l))
+                     .astype(np.float32).astype(jnp.bfloat16))
+    q2 = jnp.asarray((rng.standard_normal((args.m, args.l)) * 2 ** -8)
+                     .astype(np.float32).astype(jnp.bfloat16))
+
+    def bench(tile, vmem):
+        @jax.jit
+        def run(q1, q2, wk):
+            def body(i, carry):
+                q, acc = carry
+                # feed a changing bf16 bit-pattern so iterations can't CSE
+                qq = q + (acc % 2).astype(jnp.bfloat16)
+                idx, val = packed2k_best(qq, q2, wk, tile_n=tile,
+                                         vmem_limit=vmem)
+                return q, acc + idx[0] % 2
+            return jax.lax.fori_loop(0, args.iters, body,
+                                     (q1, jnp.int32(0)))[1]
+
+        out = run(q1, q2, wk)
+        jax.block_until_ready(out)
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(run(q1, q2, wk))
+            ts.append(time.perf_counter() - t0)
+        return min(ts) / args.iters * 1e6
+
+    rec = {"m": args.m, "npad": args.npad, "iters": args.iters}
+    for tile, vmem in ((4096, 0), (8192, 96 * 2 ** 20),
+                      (16384, 110 * 2 ** 20)):
+        try:
+            us = bench(tile, vmem)
+        except Exception as e:  # noqa: BLE001 — OOM/compile fails are data
+            print(f"# tile={tile}: {type(e).__name__}", file=sys.stderr,
+                  flush=True)
+            rec[f"tile{tile}_us"] = None
+            continue
+        rec[f"tile{tile}_us"] = round(us, 1)
+        print(f"# tile={tile} vmem={vmem >> 20}MB: {us:.1f} us/call",
+              file=sys.stderr, flush=True)
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -568,7 +568,7 @@ def _packed_best_kernel(qa_ref, qb_ref, w1_ref, w2_ref, dbnh_ref, idx_out,
 
 @functools.partial(jax.jit, static_argnames=("tile_n", "fold_a",
                                              "one_stream", "norm_in_w",
-                                             "interpret"))
+                                             "interpret", "vmem_limit"))
 def pallas_packed_best(
     qa: jax.Array,  # (Mp or 2Mp, Kp) bf16 row-blocks against W1
     qb: jax.Array,  # (Mp, Kp) bf16 against W2 (1-row stub if one_stream)
@@ -582,6 +582,7 @@ def pallas_packed_best(
     one_stream: bool = False,
     norm_in_w: bool = False,
     interpret: bool = False,
+    vmem_limit: int = 0,  # bytes; 0 keeps the platform's scoped default
 ) -> Tuple[jax.Array, jax.Array]:
     """Entry for `_packed_best_kernel`; returns (idx (Mp,), val (Mp,)) —
     the global scan champion per query, ties lowest-index."""
@@ -632,6 +633,8 @@ def pallas_packed_best(
             transcendentals=0,
         ),
         interpret=interpret,
+        **({"compiler_params": pltpu.CompilerParams(
+            vmem_limit_bytes=vmem_limit)} if vmem_limit else {}),
     )(qa, qb, w1, w2, dbnh)
     return idx[:, 0], val[:, 0]
 
@@ -724,7 +727,8 @@ def norm_query_rows(q1, q2, mp: int, l: int, kp: int):
     return jnp.concatenate([row_a, row_b], axis=0)
 
 
-def packed2k_best(q1, q2, wk, *, tile_n: int, interpret: bool = False):
+def packed2k_best(q1, q2, wk, *, tile_n: int, interpret: bool = False,
+                  vmem_limit: int = 0):
     """The shipping exact_hi2_2p scan (round-4 final form): the FULL
     2-pass product set q1.d1 + q1.d2 + q2.d1 + q1.d3 - ||d||^2/2 computed
     by ONE wide dot_general per tile against a single (Npad, Kp~256)
@@ -760,7 +764,8 @@ def packed2k_best(q1, q2, wk, *, tile_n: int, interpret: bool = False):
     stub_n = jnp.zeros((1, 1), _F32)
     idx, val = pallas_packed_best(
         qa, stub16, wk, stub16, stub_n, tile_n=min(tile_n, wk.shape[0]),
-        fold_a=False, one_stream=True, norm_in_w=True, interpret=interpret)
+        fold_a=False, one_stream=True, norm_in_w=True, interpret=interpret,
+        vmem_limit=vmem_limit)
     return idx[:m], val[:m]
 
 
