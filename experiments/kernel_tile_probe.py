@@ -43,30 +43,41 @@ def main() -> int:
     wk = jnp.asarray(
         rng.standard_normal((args.npad, args.kp)).astype(np.float32)
         .astype(jnp.bfloat16))
-    q1 = jnp.asarray(rng.standard_normal((args.m, args.l))
-                     .astype(np.float32).astype(jnp.bfloat16))
-    q2 = jnp.asarray((rng.standard_normal((args.m, args.l)) * 2 ** -8)
-                     .astype(np.float32).astype(jnp.bfloat16))
+
+    # Harness cloned from step_decompose_probe's kernel case (the one
+    # fori形 that measures real kernel time on this box): an f32 query
+    # carried through the loop with centering + bf16 splits INSIDE the
+    # body, nudged by dep(out)*1e-30 each iteration.  Plain async
+    # dispatch was tried and rejected — per-call tunnel overhead ~2 ms
+    # swamps the 0.85 ms kernel.
+    from image_analogies_tpu.ops.pallas_match import bf16_split3
+
+    q0v = jnp.asarray(rng.random((args.m, 128), dtype=np.float32) * 0.3)
+    feat_mean = jnp.asarray(rng.random(128, dtype=np.float32) * 0.1)
+    live_idx = jnp.asarray(
+        np.sort(rng.choice(128, args.l, replace=False)).astype(np.int32))
+    dep = lambda x: (x.reshape(-1)[0].astype(_F32) * 1e-30)
 
     def bench(tile, vmem):
         @jax.jit
-        def run(q1, q2, wk):
+        def run(q0v, wk, feat_mean, live_idx):
             def body(i, carry):
                 q, acc = carry
-                # feed a changing bf16 bit-pattern so iterations can't CSE
-                qq = q + (acc % 2).astype(jnp.bfloat16)
-                idx, val = packed2k_best(qq, q2, wk, tile_n=tile,
-                                         vmem_limit=vmem)
-                return q, acc + idx[0] % 2
+                qc = q - feat_mean[None, :]
+                g1, g2, _ = bf16_split3(qc[:, live_idx])
+                idx, val = packed2k_best(
+                    g1.astype(jnp.bfloat16), g2.astype(jnp.bfloat16), wk,
+                    tile_n=tile, vmem_limit=vmem)
+                return q.at[0, 0].add(dep(idx)), acc
             return jax.lax.fori_loop(0, args.iters, body,
-                                     (q1, jnp.int32(0)))[1]
+                                      (q0v, jnp.int32(0)))[0]
 
-        out = run(q1, q2, wk)
+        out = run(q0v, wk, feat_mean, live_idx)
         jax.block_until_ready(out)
         ts = []
         for _ in range(3):
             t0 = time.perf_counter()
-            jax.block_until_ready(run(q1, q2, wk))
+            jax.block_until_ready(run(q0v, wk, feat_mean, live_idx))
             ts.append(time.perf_counter() - t0)
         return min(ts) / args.iters * 1e6
 

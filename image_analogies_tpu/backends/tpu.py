@@ -106,6 +106,23 @@ _REFINE_PASSES = 3
 # 2^24, so exemplars beyond 4096^2 rows are rejected at trace time.
 _WAVEFRONT_MAX_ROWS = 1 << 24
 
+# exact_hi2_2p anchor scan tile geometry.  Round-5 sweep on the real
+# chip (full north star, min-of-5 same session): tile 4096 -> 5.745 s,
+# 8192 -> 5.30 s, 16384 -> 5.084 s, 32768 -> 5.284 s — fewer grid steps
+# amortize the per-tile fixed cost (champion fold, bookkeeping, DMA
+# issue) until the VMEM working set starts fighting the scoped double
+# buffers.  16384-row tiles need the VMEM limit raised over the
+# platform's scoped default: (M, tile) f32 scores ~23 MB + two 8 MB
+# weight buffers fit comfortably in the raised 110 MB budget (v5e-class
+# VMEM is 128 MB).  Champion picks are BIT-IDENTICAL across tile sizes
+# (per-row scores are tile-local; the cross-tile strict-improve fold
+# keeps lowest-global-index ties regardless of partitioning).
+# Env overrides kept for future A/Bs.
+_PACKED_TILE_CAP = int(__import__("os").environ.get("IA_PACKED_TILE",
+                                                    16384))
+_PACKED_VMEM_LIMIT = int(__import__("os").environ.get(
+    "IA_PACKED_VMEM", 110 * 2 ** 20))
+
 
 @dataclass
 class TpuLevelDB:
@@ -1117,12 +1134,27 @@ def make_anchor_fn(db: TpuLevelDB, defer_rescore: bool = False):
         # near-ties), end-to-end parity evidence in BENCH_r03.
         live_idx = db.live_idx  # the derivation the DB lanes were packed by
         npad, pk = db.db_pad.shape
-        # the K-wide 2p array (pk ~ 256) carries the same bytes/tile at
-        # 4096 rows as the old two-array layout — keep the 4096-row cap
-        # rather than letting the wider pk halve it
-        tile = _scan_tile(npad, pk, cap_rows=4096)
         na = db.db.shape[0]
         two_pass = db.match_mode == "exact_hi2_2p"
+        if two_pass:
+            # round-5 tile raise (see _PACKED_TILE_CAP), bounded by the
+            # (M, tile) f32 score block against the raised VMEM budget:
+            # the cap must SHRINK with B's diagonal width (a ~4096-wide B
+            # has plateau M ~ 1365 — a fixed 16384 would blow the limit
+            # the north star's M=344 fits comfortably)
+            p5 = int(round(int(db.off.shape[0]) ** 0.5))
+            m_plateau = min(db.hb, -(-db.wb // (p5 // 2 + 1)))
+            mp = max(_round_up(max(m_plateau, 8), 16), 16)
+            budget = int(0.45 * (_PACKED_VMEM_LIMIT or 64 * 2 ** 20))
+            m_cap = max(budget // (mp * 4), 256)
+            m_cap = 1 << (m_cap.bit_length() - 1)
+            tile = _scan_tile(npad, pk,
+                              cap_rows=min(_PACKED_TILE_CAP, m_cap))
+        else:
+            # exact_hi2's 3-pass kernel (packed3_best) has no vmem_limit
+            # plumbing and streams THREE weight arrays per tile — keep
+            # the round-4 4096-row cap it was sized for
+            tile = _scan_tile(npad, pk, cap_rows=4096)
 
         def anchor(queries):
             qc = queries - db.feat_mean[None, :queries.shape[1]]
@@ -1146,7 +1178,8 @@ def make_anchor_fn(db: TpuLevelDB, defer_rescore: bool = False):
             # first divergence not a tie at 256^2 (parity needs the full
             # 2p product set, full stop).
             if two_pass:
-                p, _ = packed2k_best(q1, q2, db.db_pad, tile_n=tile)
+                p, _ = packed2k_best(q1, q2, db.db_pad, tile_n=tile,
+                                     vmem_limit=_PACKED_VMEM_LIMIT)
             else:
                 p, _ = packed3_best(
                     q1, q2, gr.astype(jnp.bfloat16), db.db_pad, db.db_pad2,
@@ -1204,7 +1237,8 @@ def make_anchor_fn(db: TpuLevelDB, defer_rescore: bool = False):
 
 
 def wavefront_scan_core(db: TpuLevelDB, kappa_mult, anchor_fn,
-                        row_fn=None, afilt_fn=None, live_gather=None):
+                        row_fn=None, afilt_fn=None, live_gather=None,
+                        data_axis=None, data_axis_size: int = 1):
     """The parity fast path (VERDICT.md round-1 item 1): the oracle's exact
     algorithm on an anti-diagonal schedule.
 
@@ -1257,6 +1291,11 @@ def wavefront_scan_core(db: TpuLevelDB, kappa_mult, anchor_fn,
             f"and note a >2^24-row DB also exceeds the HBM the scan "
             f"needs, so multi-chip db_shards with the batched strategy "
             f"is the supported route at that scale.")
+    if data_axis is not None and (
+            data_axis_size & (data_axis_size - 1) or data_axis_size > 8):
+        raise ValueError(
+            f"query-parallel wavefront needs a power-of-two data axis "
+            f"<= 8 (segment widths are 8-aligned); got {data_axis_size}")
     # live/dead-split coherence scoring: single-chip when the build
     # carries db_live; on the mesh when the step supplies `live_gather`
     # (a psum-gather of the SHARDED db_live — round-5 gather diet)
@@ -1287,6 +1326,23 @@ def wavefront_scan_core(db: TpuLevelDB, kappa_mult, anchor_fn,
             pix = seg[t]  # (M,) flat indices, -1 on short diagonals
             lane_ok = pix >= 0
             pixc = jnp.maximum(pix, 0)
+            if data_axis is not None:
+                # QUERY-PARALLEL single image (round-5, SURVEY §5.7): the
+                # diagonal's M lanes split over the mesh's `data` axis
+                # RIGHT HERE, so the window math, the bps/static_q
+                # gathers, the query build, the anchor scan, and the
+                # coherence block all run on an M/D slice; the final
+                # (p, A', use_coh) all_gather back so every chip's
+                # replicated carry advances identically.  Slicing is
+                # semantically a no-op (per-query work never reads across
+                # queries), so picks are bit-equal to the unsliced step
+                # (locked by test_wavefront_query_parallel_...).  Segment
+                # widths are 8-aligned, so any power-of-two D <= 8
+                # divides M (checked at entry).  `pix`/`lane_ok` stay
+                # full-width for the scatter.
+                mq = int(pix.shape[0]) // data_axis_size
+                me = jax.lax.axis_index(data_axis)
+                pixc = jax.lax.dynamic_slice_in_dim(pixc, me * mq, mq, 0)
             qi = pixc // wb
             qj = pixc - qi * wb
             wi = qi[:, None] + off_i[:, :nc]
@@ -1338,6 +1394,16 @@ def wavefront_scan_core(db: TpuLevelDB, kappa_mult, anchor_fn,
 
             use_coh = has_coh & (d_coh <= d_app * kappa_mult)
             p = jnp.where(use_coh, p_coh, p_app).astype(jnp.int32)
+            if data_axis is not None:
+                # reassemble the full diagonal: lane order is preserved
+                # (tile k of the gather is data row k's slice)
+                p = jax.lax.all_gather(p, data_axis, tiled=True)
+                use_coh = jax.lax.all_gather(use_coh, data_axis,
+                                             tiled=True)
+                if af_pair is not None:
+                    af_pair = tuple(
+                        jax.lax.all_gather(x, data_axis, tiled=True)
+                        for x in af_pair)
             # write only live lanes: -1 padding -> OOB sentinel, dropped.
             # Each pad lane gets a DISTINCT OOB sentinel (nb + lane) so the
             # index vector is fully unique (the schedule's live lanes are
@@ -1411,7 +1477,13 @@ class TpuMatcher(Matcher):
         # wavefront scores against the FULL DB (the oracle's metric); batched
         # against the rowsafe-masked DB (its symmetric metric).
         pad_full = strategy == "wavefront"
-        sharded = (self.params.db_shards > 1
+        # single-image mesh forms: db_shards shards the patch DB;
+        # data_shards > 1 (wavefront only — create_image_analogy gates)
+        # additionally splits each anti-diagonal's queries over 'data'
+        # (the round-5 query-parallel form, parallel/step.py)
+        sharded = ((self.params.db_shards > 1
+                    or (self.params.data_shards > 1
+                        and strategy == "wavefront"))
                    and strategy in ("batched", "wavefront"))
         # anchor mode (wavefront only).  The sharded mesh step picks its
         # OWN scan via the `packed` gate below (packed 2-pass when
@@ -1479,7 +1551,8 @@ class TpuMatcher(Matcher):
         if sharded:
             from image_analogies_tpu.parallel.mesh import make_mesh
 
-            mesh = make_mesh(db_shards=self.params.db_shards)
+            mesh = make_mesh(db_shards=self.params.db_shards,
+                             data_shards=self.params.data_shards)
             on_tpu = jax.default_backend() == "tpu"
             tile = _tile_rows(spec.total) if on_tpu else 1
             # real-TPU wavefront meshes scan with the packed 2-pass

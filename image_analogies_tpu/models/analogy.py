@@ -139,11 +139,13 @@ def create_image_analogy(
     `remap_anchor` pins the §3.4 luminance remap to another image's stats
     (video clips anchor on frame 0 — see `_prep_planes`).
     """
-    if params.data_shards > 1:
+    if params.data_shards > 1 and params.strategy not in ("wavefront",
+                                                          "auto"):
         raise ValueError(
-            "data_shards shards VIDEO frames over the mesh; use "
-            "models.video.video_analogy (single images shard the patch DB "
-            "via db_shards instead)")
+            "data_shards > 1 on a single image is the query-parallel "
+            "wavefront (anti-diagonals split over the mesh 'data' axis) "
+            "and exists only for strategy='wavefront'/'auto'; for video "
+            "frame sharding use models.video.video_analogy")
     backend = backend or get_backend(params)
     a_src, b_src, a_filt, ap_rgb, b_yiq = _prep_planes(
         a, ap, b, params, remap_anchor=remap_anchor)

@@ -85,7 +85,8 @@ def local_argmin_allreduce(queries, db_shard, dbn_shard, axis: str,
 
 
 def packed_champion_allreduce(q1, q2, wk_shard, axis: str, tile_n: int,
-                              interpret: bool = False):
+                              interpret: bool = False,
+                              vmem_limit: int = 0):
     """Sharded twin of the single-chip exact_hi2_2p anchor scan: each chip
     runs the K-wide packed champion kernel (`packed2k_best` — the SAME
     kernel and weight layout as the single-chip anchor) over ITS shard,
@@ -105,7 +106,7 @@ def packed_champion_allreduce(q1, q2, wk_shard, axis: str, tile_n: int,
     exact fp32 through their sharded row-gather (the kappa rule's d_app
     never comes from scan space)."""
     li_loc, lv = packed2k_best(q1, q2, wk_shard, tile_n=tile_n,
-                               interpret=interpret)
+                               interpret=interpret, vmem_limit=vmem_limit)
     li = li_loc + jax.lax.axis_index(axis) * wk_shard.shape[0]
     allv = jax.lax.all_gather(lv, axis)  # (D, M)
     alli = jax.lax.all_gather(li, axis)

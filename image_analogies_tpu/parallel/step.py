@@ -37,6 +37,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from image_analogies_tpu.backends.tpu import (
+    _PACKED_TILE_CAP,
+    _PACKED_VMEM_LIMIT,
     TpuLevelDB,
     _scan_tile,
     _tile_rows,
@@ -55,7 +57,8 @@ from image_analogies_tpu.parallel.sharded_match import (
 def _cached_multichip_step(mesh: Mesh, strategy: str, force_xla: bool,
                            precision, packed: bool,
                            packed_interpret: bool = False,
-                           fused_live: bool = False):
+                           fused_live: bool = False,
+                           query_parallel: bool = False):
     """Build the shard_map'd multi-frame level step once per
     (mesh, strategy, force_xla, precision, packed, fused_live); jit
     caching then keys on shapes.  ``packed`` switches the wavefront
@@ -91,8 +94,10 @@ def _cached_multichip_step(mesh: Mesh, strategy: str, force_xla: bool,
                     g1.astype(jnp.bfloat16), g2.astype(jnp.bfloat16),
                     wk_loc, "db",
                     tile_n=_scan_tile(wk_loc.shape[0], wk_loc.shape[1],
-                                      cap_rows=4096),
-                    interpret=packed_interpret)
+                                      cap_rows=_PACKED_TILE_CAP),
+                    interpret=packed_interpret,
+                    vmem_limit=0 if packed_interpret
+                    else _PACKED_VMEM_LIMIT)
             else:
                 p, _ = approx_fn(queries)
             return p
@@ -139,7 +144,10 @@ def _cached_multichip_step(mesh: Mesh, strategy: str, force_xla: bool,
             if strategy == "wavefront":
                 return wavefront_scan_core(
                     dbt, km, anchor_fn, row_fn, afilt_fn,
-                    live_gather=live_gather if fused_live else None)
+                    live_gather=live_gather if fused_live else None,
+                    data_axis="data" if query_parallel else None,
+                    data_axis_size=(mesh.shape["data"]
+                                    if query_parallel else 1))
             bp, s, counts = batched_scan_core(dbt, km, approx_fn, row_fn,
                                               afilt_fn)
             return bp, s, counts[0]
@@ -148,12 +156,22 @@ def _cached_multichip_step(mesh: Mesh, strategy: str, force_xla: bool,
         # both have batching rules), not a Python-unrolled loop
         return jax.vmap(one_frame)(static_q_loc)
 
+    if query_parallel:
+        # ONE image over BOTH axes (round-5, SURVEY §5.7): the frame axis
+        # (T=1) replicates over 'data' and each data row scores its slice
+        # of every anti-diagonal (wavefront_scan_core data_axis) against
+        # its 'db' DB shards; outputs are replicated-identical.
+        in_q = P(None, None, None)
+        out = (P(None, None), P(None, None), P(None))
+    else:
+        in_q = P("data", None, None)
+        out = (P("data", None), P("data", None), P("data"))
     stepped = shard_map(
         local_step,
         mesh=mesh,
-        in_specs=(P("data", None, None), P("db", None), P("db"), P("db"),
+        in_specs=(in_q, P("db", None), P("db"), P("db"),
                   P("db", None), P("db", None), P(), P()),
-        out_specs=(P("data", None), P("data", None), P("data")),
+        out_specs=out,
         check_rep=False,
     )
     return jax.jit(stepped)
@@ -188,7 +206,11 @@ def multichip_level_step(
     t_total = frame_static_q.shape[0]
     data_shards = mesh.shape["data"]
     db_shards = mesh.shape["db"]
-    if t_total % data_shards:
+    # ONE frame on a data>1 mesh = query-parallel wavefront (the image's
+    # anti-diagonals split over 'data'; frames can't shard any further)
+    query_parallel = (t_total == 1 and data_shards > 1
+                      and template.strategy == "wavefront")
+    if t_total % data_shards and not query_parallel:
         raise ValueError(f"{t_total} frames not divisible by "
                          f"data={data_shards}")
     if db_shard_src.shape[0] % db_shards:
@@ -209,7 +231,8 @@ def multichip_level_step(
         dbl_shard = jnp.zeros((db_shards, 1), jnp.float32)
     step = _cached_multichip_step(mesh, template.strategy, force_xla,
                                   precision, packed,
-                                  packed and packed_interpret, fused_live)
+                                  packed and packed_interpret, fused_live,
+                                  query_parallel)
     return step(frame_static_q, db_shard_src, dbn_shard_src,
                 afilt_shard_src, wk_shard, dbl_shard, template,
                 jnp.float32(kappa_mult))

@@ -76,7 +76,7 @@ def test_sharded_video_does_not_retrace():
     # literal argument tuple — omitted defaults are a different key)
     step = _cached_multichip_step(mesh, "batched", True,
                                   jax.lax.Precision.DEFAULT, False, False,
-                                  False)
+                                  False, False)
     before = step._cache_size()
     assert before > 0  # the run above used this cached jit
     video_analogy(a, ap, frames, p)

@@ -77,6 +77,25 @@ def test_wavefront_sharded_matches_unsharded():
     np.testing.assert_allclose(solo.bp_y, sharded.bp_y, atol=1e-6)
 
 
+def test_wavefront_query_parallel_matches_unsharded():
+    """Round-5 (SURVEY §5.7): ONE image over BOTH mesh axes — the patch
+    DB over 'db' AND each anti-diagonal's queries over 'data'.  Query
+    slicing is semantically a no-op (per-query work never reads across
+    queries), so the 2x4 mesh must reproduce the solo scan BIT-exactly,
+    including the all_gather lane reassembly on every segment width."""
+    a, ap, b = make_pair(24, 24, seed=11)
+    base = dict(levels=2, kappa=2.0, strategy="wavefront", backend="tpu")
+    solo = create_image_analogy(a, ap, b, AnalogyParams(**base))
+    both = create_image_analogy(
+        a, ap, b, AnalogyParams(db_shards=4, data_shards=2, **base))
+    np.testing.assert_array_equal(solo.source_map, both.source_map)
+    np.testing.assert_allclose(solo.bp_y, both.bp_y, atol=1e-6)
+    # queries over 'data' ONLY (db unsharded) must also hold
+    qonly = create_image_analogy(
+        a, ap, b, AnalogyParams(data_shards=2, **base))
+    np.testing.assert_array_equal(solo.source_map, qonly.source_map)
+
+
 def test_wavefront_a_b_different_sizes():
     # exemplar and target need not share shapes; parity must survive the
     # asymmetric DB/query geometry (A 28x26 vs B 20x24)
