@@ -68,7 +68,10 @@ def _add_engine_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--db-shards", type=int, default=None)
     p.add_argument("--data-shards", type=int, default=None,
                    help="video mode: shard frames over this many mesh "
-                        "devices (two_phase scheme, data x db mesh)")
+                        "devices (two_phase scheme, data x db mesh); on a "
+                        "single image (wavefront): split each "
+                        "anti-diagonal's queries over the mesh 'data' "
+                        "axis (query-parallel, bit-equal to solo)")
     p.add_argument("--refine-passes", type=int, default=None,
                    help="batched strategy: left-propagation refinement "
                         "passes per scan row")
