@@ -251,21 +251,29 @@ def create_image_analogy(
                                         f"level_{level:02d}.png"),
                            np.clip(np.asarray(bp, np.float32), 0.0, 1.0))
 
-    # ONE batched fetch for all deferred device scalars (each individual
-    # fetch costs ~0.1 s of tunnel latency), then finalize + emit
+    # ONE fetch call for the deferred device scalars AND the finest B'
+    # plane: `jax.device_get` on the pair starts both transfers before
+    # blocking, so the stats' scalar round-trip (~0.1 s of tunnel
+    # latency) hides under the 4 MB plane transfer instead of preceding
+    # it serially (round-5; each np.asarray is its own blocking
+    # round-trip)
     dev = [(st, k) for st in stats for k in ("_n_coh", "_n_ref")
            if k in st and not isinstance(st[k], (int, float, np.number))]
     if dev:
+        import jax
         import jax.numpy as jnp
 
-        vals = np.asarray(jnp.stack([st[k] for st, k in dev]))
+        vals, bp_fetched = jax.device_get(
+            (jnp.stack([st[k] for st, k in dev]), bp_pyr[0]))
         for (st, k), v in zip(dev, vals):
             st[k] = float(v)
+        bp_y = np.asarray(bp_fetched, np.float32)
+    else:
+        bp_y = np.asarray(bp_pyr[0], np.float32)
     for st in stats:
         _finalize_stats(st)  # no-op where the streaming path already did
         if not st.pop("_emitted", False):
             ialog.emit(st, params.log_path)
-    bp_y = np.asarray(bp_pyr[0], np.float32)
     # the source map stays a DEVICE array unless a host consumer needs it
     # here (source_rgb's color gather, keep_levels' audit planes) — it is
     # introspection metadata, fetched lazily by AnalogyResult.source_map
