@@ -13,8 +13,7 @@ Analogies is exemplar-database size, and it scales with pod size.
 
 from __future__ import annotations
 
-import functools
-from typing import Callable, Tuple
+from typing import Callable
 
 import jax
 import jax.numpy as jnp
