@@ -24,11 +24,15 @@ All five BASELINE.json:7-12 eval configs run (round-4 VERDICT item 6):
 - super-resolution (config 3): 192^2, 7x7 patches, kappa in {0.5, 2, 5}
   (BASELINE pins patches + sweep, not size; the 256^2 oracle alone blew a
   25-minute budget).
-- batched video (config 5): 4 x 256^2 B-frames, temporal term, two_phase
-  (the frame-sharded mesh form is validated by dryrun_multichip).
+- batched video (config 5): 3 x 256^2 B-frames, 2 levels, temporal term,
+  two_phase (the frame-sharded mesh form is validated by dryrun_multichip;
+  the 4-frame 3-level point is committed in
+  bench_cache/bench_full_r05_builder.json).
 
-The last three run LIVE oracles at native sizes with min-of-N draws on
-both sides.  IA_BENCH_CONFIGS=name[,name...] restricts the oracle configs
+The last three run LIVE oracles at native sizes (min-of-N on the TPU
+side; ONE oracle draw each — their multi-minute oracles are the bench's
+budget ceiling, and the oil config's min-of-2 already anchors the
+live-oracle floor methodology).  IA_BENCH_CONFIGS=name[,name...] restricts the oracle configs
 during development (the north star always runs — it carries the headline
 JSON); the driver's plain invocation runs everything.
 
@@ -231,7 +235,7 @@ def main() -> int:
         with tempfile.TemporaryDirectory() as d:
             make_all(d, size=256, seed=7)
             for name in ("tbn_labels_a", "tbn_texture", "tbn_labels_b"
-                         ) + tuple(f"video_f{t}" for t in range(4)) + (
+                         ) + tuple(f"video_f{t}" for t in range(3)) + (
                              "filter_a", "filter_ap"):
                 assets[name] = load_image(os.path.join(d, f"{name}.png"))
         with tempfile.TemporaryDirectory() as d:
@@ -252,7 +256,9 @@ def main() -> int:
             lambda: create_image_analogy(*args_t, p))
         res_c, cpu_s = _min_cpu(
             lambda: create_image_analogy(*args_t,
-                                         p.replace(backend="cpu")))
+                                         p.replace(backend="cpu")),
+            reps=1)  # (one ~40 s draw; see the module docstring's
+        #                live-oracle budget note)
         configs["tbn_256"] = _pair_fields(res_t, res_c, t_min, t_med,
                                           cpu_s)
 
@@ -282,11 +288,16 @@ def main() -> int:
     if want("video_256"):
         # config 5: batched video B-frames, temporal term, two_phase (the
         # frame-parallel scheme data_shards>1 shards over the mesh; one
-        # chip here, so the sharded path is covered by dryrun_multichip)
+        # chip here, so the sharded path is covered by dryrun_multichip).
+        # 3 frames x 2 levels keeps the leg's LIVE oracle within the
+        # driver's bench budget (4 x 3-level measured 4.08 s TPU vs a
+        # 324.7 s oracle = 80x — committed in
+        # bench_cache/bench_full_r05_builder.json); levels=2 matches the
+        # golden video config.
         from image_analogies_tpu.models.video import video_analogy
 
-        frames = [assets[f"video_f{t}"] for t in range(4)]
-        p = PRESETS["video"].replace(backend="tpu")
+        frames = [assets[f"video_f{t}"] for t in range(3)]
+        p = PRESETS["video"].replace(backend="tpu", levels=2)
         res_t, t_min, t_med = _timed(
             lambda: video_analogy(assets["filter_a"], assets["filter_ap"],
                                   frames, p, scheme="two_phase"))
