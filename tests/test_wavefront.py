@@ -77,6 +77,16 @@ def test_wavefront_sharded_matches_unsharded():
     np.testing.assert_allclose(solo.bp_y, sharded.bp_y, atol=1e-6)
 
 
+def test_data_shards_single_image_gate():
+    """data_shards > 1 on a single image exists only for the wavefront
+    (query-parallel); other strategies must fail closed with an error
+    naming the video entry point."""
+    a, ap, b = make_pair(16, 16, seed=2)
+    with pytest.raises(ValueError, match="video_analogy"):
+        create_image_analogy(a, ap, b, AnalogyParams(
+            levels=1, backend="tpu", strategy="batched", data_shards=2))
+
+
 def test_wavefront_query_parallel_matches_unsharded():
     """Round-5 (SURVEY §5.7): ONE image over BOTH mesh axes — the patch
     DB over 'db' AND each anti-diagonal's queries over 'data'.  Query
