@@ -232,20 +232,33 @@ def main() -> int:
         from image_analogies_tpu.utils.imageio import load_image
 
         assets = {}
-        with tempfile.TemporaryDirectory() as d:
-            make_all(d, size=256, seed=7)
-            for name in ("tbn_labels_a", "tbn_texture", "tbn_labels_b"
-                         ) + tuple(f"video_f{t}" for t in range(3)) + (
-                             "filter_a", "filter_ap"):
-                assets[name] = load_image(os.path.join(d, f"{name}.png"))
-        with tempfile.TemporaryDirectory() as d:
-            # super-res runs at 192^2: BASELINE.json:10 pins patches (7x7)
-            # and the kappa sweep but no size, and the 256^2 cKDTree
-            # oracle on 147-dim rows alone blew a 25-minute bench budget
-            # (measured round 5) — 192^2 keeps the leg a few minutes
-            make_all(d, size=192, seed=7)
-            for name in ("sr_sharp", "sr_low"):
-                assets[name] = load_image(os.path.join(d, f"{name}.png"))
+        # asset building gated per SELECTED config: each make_all draws
+        # the full asset family (pyramid blurs + PNG encodes, seconds per
+        # size), so a --configs subset must not pay for sizes or asset
+        # groups only unselected configs read
+        names_256 = ()
+        if want("tbn_256"):
+            names_256 += ("tbn_labels_a", "tbn_texture", "tbn_labels_b")
+        if want("video_256"):
+            names_256 += tuple(f"video_f{t}" for t in range(3)) + (
+                "filter_a", "filter_ap")
+        if names_256:
+            with tempfile.TemporaryDirectory() as d:
+                make_all(d, size=256, seed=7)
+                for name in names_256:
+                    assets[name] = load_image(
+                        os.path.join(d, f"{name}.png"))
+        if want("superres_192"):
+            with tempfile.TemporaryDirectory() as d:
+                # super-res runs at 192^2: BASELINE.json:10 pins patches
+                # (7x7) and the kappa sweep but no size, and the 256^2
+                # cKDTree oracle on 147-dim rows alone blew a 25-minute
+                # bench budget (measured round 5) — 192^2 keeps the leg a
+                # few minutes
+                make_all(d, size=192, seed=7)
+                for name in ("sr_sharp", "sr_low"):
+                    assets[name] = load_image(
+                        os.path.join(d, f"{name}.png"))
 
     if want("tbn_256"):
         # config 1: texture-by-numbers 256^2, single-scale, 5x5 patches

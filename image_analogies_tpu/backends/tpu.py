@@ -1040,6 +1040,26 @@ def _scan_tile(npad: int, fp: int, cap_rows: int = 0) -> int:
     return tile
 
 
+def _packed_tile_cap(hb: int, wb: int, n_off: int) -> int:
+    """VMEM-aware row cap for the packed 2-pass scan's tile (the round-5
+    tile raise, bounded): the kernel materializes an (M, tile) f32 score
+    block, and the wavefront batch M plateaus at B's anti-diagonal width
+    — a ~4096-wide B has plateau M ~ 1365, where the fixed
+    _PACKED_TILE_CAP=16384 would blow the raised VMEM budget the north
+    star's M=344 fits comfortably.  Shared by the single-chip anchor
+    (`make_anchor_fn`) and the mesh packed anchor scan
+    (`parallel/step.py`), whose per-shard kernel builds the same score
+    block.  ``n_off`` is the causal window size (`db.off.shape[0]` —
+    static under trace), from which the patch width is recovered."""
+    p5 = int(round(n_off ** 0.5))
+    m_plateau = min(hb, -(-wb // (p5 // 2 + 1)))
+    mp = max(_round_up(max(m_plateau, 8), 16), 16)
+    budget = int(0.45 * (_PACKED_VMEM_LIMIT or 64 * 2 ** 20))
+    m_cap = max(budget // (mp * 4), 256)
+    m_cap = 1 << (m_cap.bit_length() - 1)
+    return min(_PACKED_TILE_CAP, m_cap)
+
+
 def make_anchor_fn(db: TpuLevelDB, defer_rescore: bool = False):
     """The wavefront strategy's full-DB anchor: (queries (M,F)) ->
     (p_app (M,) int32, d_app (M,) fp32 EXACT squared distance).
@@ -1137,19 +1157,10 @@ def make_anchor_fn(db: TpuLevelDB, defer_rescore: bool = False):
         na = db.db.shape[0]
         two_pass = db.match_mode == "exact_hi2_2p"
         if two_pass:
-            # round-5 tile raise (see _PACKED_TILE_CAP), bounded by the
-            # (M, tile) f32 score block against the raised VMEM budget:
-            # the cap must SHRINK with B's diagonal width (a ~4096-wide B
-            # has plateau M ~ 1365 — a fixed 16384 would blow the limit
-            # the north star's M=344 fits comfortably)
-            p5 = int(round(int(db.off.shape[0]) ** 0.5))
-            m_plateau = min(db.hb, -(-db.wb // (p5 // 2 + 1)))
-            mp = max(_round_up(max(m_plateau, 8), 16), 16)
-            budget = int(0.45 * (_PACKED_VMEM_LIMIT or 64 * 2 ** 20))
-            m_cap = max(budget // (mp * 4), 256)
-            m_cap = 1 << (m_cap.bit_length() - 1)
+            # round-5 tile raise, VMEM-bounded (see _packed_tile_cap)
             tile = _scan_tile(npad, pk,
-                              cap_rows=min(_PACKED_TILE_CAP, m_cap))
+                              cap_rows=_packed_tile_cap(
+                                  db.hb, db.wb, int(db.off.shape[0])))
         else:
             # exact_hi2's 3-pass kernel (packed3_best) has no vmem_limit
             # plumbing and streams THREE weight arrays per tile — keep

@@ -10,6 +10,7 @@ an `eval` command computing SSIM between two images.
     python -m image_analogies_tpu.cli video --a A.png --ap Ap.png \
         --frames f0.png f1.png f2.png --out-dir out/
     python -m image_analogies_tpu.cli eval --a out.png --b ref.png
+    python -m image_analogies_tpu.cli report run.jsonl
 """
 
 from __future__ import annotations
@@ -96,6 +97,11 @@ def _add_engine_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--resume-from-level", type=int, default=None)
     p.add_argument("--log-path", default=None)
+    p.add_argument("--metrics", action="store_true",
+                   help="run-scoped observability (obs/): per-run metrics "
+                        "registry + span tracing; with --log-path the "
+                        "run_id-stamped records feed `report`.  Off by "
+                        "default and near-zero-cost when off")
     p.add_argument("--profile-dir", default=None)
     p.add_argument("--save-levels", dest="save_levels_dir", default=None,
                    metavar="DIR",
@@ -123,6 +129,8 @@ def _params_from_args(args, base: AnalogyParams) -> AnalogyParams:
         kw["coarse_patch_size"] = args.coarse_patch_size
     if args.no_ann:
         kw["use_ann"] = False
+    if args.metrics:
+        kw["metrics"] = True
     if args.no_level_sync:
         kw["level_sync"] = False
     if args.no_remap:
@@ -226,6 +234,18 @@ def cmd_eval(args) -> int:
     return 0
 
 
+def cmd_report(args) -> int:
+    """Analyze a run-log JSONL (obs/report.py): per-level timing
+    breakdown, counter totals, retry/coherence summaries, manifest."""
+    from image_analogies_tpu.obs import report as obs_report
+
+    if not os.path.exists(args.log):
+        print(f"report: no such log: {args.log}", file=sys.stderr)
+        return 2
+    print(obs_report.report(args.log))
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="image_analogies_tpu",
@@ -278,6 +298,12 @@ def build_parser() -> argparse.ArgumentParser:
     ev.add_argument("--a", required=True)
     ev.add_argument("--b", required=True)
     ev.set_defaults(fn=cmd_eval)
+
+    rp = sub.add_parser("report",
+                        help="analyze a run-log JSONL (--log-path output): "
+                             "per-level timing, counters, manifest")
+    rp.add_argument("log", help="path to the run-log JSONL")
+    rp.set_defaults(fn=cmd_report)
     return ap
 
 

@@ -172,6 +172,15 @@ class AnalogyParams:
     resume_from_level: Optional[int] = None  # level index (finest=0) to resume at
     profile_dir: Optional[str] = None  # jax.profiler trace dir if set
     log_path: Optional[str] = None  # JSONL structured per-level records
+    # Run-scoped observability (obs/): True installs a per-run metrics
+    # registry + span tracing (run_id-stamped JSONL records, run manifest,
+    # run_end counter snapshot — analyzed by `ia report`).  Off by default
+    # and near-zero-cost when off: the instrumentation sites reduce to one
+    # module-bool check, so bench numbers don't move.  Setting log_path
+    # alone also activates the run scope (a log implies observability);
+    # this flag additionally enables it without a log file (counters land
+    # in AnalogyResult-adjacent logging only).
+    metrics: bool = False
     # Write each level's synthesized B' plane as level_XX.png into this dir
     # (the reference family's de-facto debug behavior): visual debugging of
     # coarse-to-fine progress without touching checkpoints.

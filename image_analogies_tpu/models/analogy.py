@@ -18,6 +18,8 @@ import numpy as np
 from image_analogies_tpu.backends import get_backend
 from image_analogies_tpu.backends.base import LevelJob
 from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.obs import metrics as obs_metrics
+from image_analogies_tpu.obs import trace as obs_trace
 from image_analogies_tpu.ops import color
 from image_analogies_tpu.ops.features import spec_for_level
 from image_analogies_tpu.ops.pyramid import build_pyramid_np, num_feasible_levels
@@ -139,6 +141,17 @@ def create_image_analogy(
     `remap_anchor` pins the §3.4 luminance remap to another image's stats
     (video clips anchor on frame 0 — see `_prep_planes`).
     """
+    # Observability run scope (obs/): inert unless params.metrics or a
+    # log_path is set; joins the enclosing run when video already opened
+    # one (single run_id per clip).
+    with obs_trace.run_scope(params):
+        return _create_image_analogy(a, ap, b, params, backend,
+                                     temporal_prev, remap_anchor,
+                                     keep_levels)
+
+
+def _create_image_analogy(a, ap, b, params, backend, temporal_prev,
+                          remap_anchor, keep_levels) -> AnalogyResult:
     if params.data_shards > 1 and params.strategy not in ("wavefront",
                                                           "auto"):
         raise ValueError(
@@ -186,70 +199,75 @@ def create_image_analogy(
                     ialog.emit({"event": "resume_level", "level": level},
                                params.log_path)
                     continue
-            spec = spec_for_level(params, level, levels, src_channels,
-                                  temporal=temporal)
-            job = LevelJob(
-                level=level,
-                spec=spec,
-                kappa_mult=params.kappa_factor(level) ** 2,
-                a_src=a_src_pyr[level],
-                a_filt=a_filt_pyr[level],
-                b_src=b_src_pyr[level],
-                a_src_coarse=(a_src_pyr[level + 1]
-                              if level + 1 < levels else None),
-                a_filt_coarse=(a_filt_pyr[level + 1]
-                               if level + 1 < levels else None),
-                b_src_coarse=(b_src_pyr[level + 1]
-                              if level + 1 < levels else None),
-                b_filt_coarse=(bp_pyr[level + 1]
-                               if level + 1 < levels else None),
-                a_temporal=(a_filt_pyr[level] if temporal else None),
-                b_temporal=(b_temporal_pyr[level] if temporal else None),
-            )
-            t0 = time.perf_counter()
+            with obs_trace.span("level", level=level):
+                spec = spec_for_level(params, level, levels, src_channels,
+                                      temporal=temporal)
+                job = LevelJob(
+                    level=level,
+                    spec=spec,
+                    kappa_mult=params.kappa_factor(level) ** 2,
+                    a_src=a_src_pyr[level],
+                    a_filt=a_filt_pyr[level],
+                    b_src=b_src_pyr[level],
+                    a_src_coarse=(a_src_pyr[level + 1]
+                                  if level + 1 < levels else None),
+                    a_filt_coarse=(a_filt_pyr[level + 1]
+                                   if level + 1 < levels else None),
+                    b_src_coarse=(b_src_pyr[level + 1]
+                                  if level + 1 < levels else None),
+                    b_filt_coarse=(bp_pyr[level + 1]
+                                   if level + 1 < levels else None),
+                    a_temporal=(a_filt_pyr[level] if temporal else None),
+                    b_temporal=(b_temporal_pyr[level]
+                                if temporal else None),
+                )
+                t0 = time.perf_counter()
 
-            def _level():
-                db = backend.build_features(job)
-                return backend.synthesize_level(db, job)
+                def _level():
+                    db = backend.build_features(job)
+                    return backend.synthesize_level(db, job)
 
-            # §5.3: transient device faults retry at level granularity
-            bp, s, st = failure.run_with_retry(
-                _level, retries=params.level_retries,
-                context={"level": level}, log_path=params.log_path)
-            st["total_ms"] = (time.perf_counter() - t0) * 1e3
-            # bp/s may be DEVICE arrays (TPU backend): levels chain through
-            # them without host round-trips (the tunnel moves ~9 MB/s);
-            # host copies are fetched only for opt-in host consumers below
-            # and for the final result.  EXCEPT with level retries armed:
-            # the §5.3 fault model promises a retried level rebuilds from
-            # buffers that survive a device reset, and the coarser plane
-            # chained on-device could be invalidated by the very fault
-            # being retried — so fault-recovery runs keep the pre-chaining
-            # host copies (round-3 ADVICE item 1).
-            if params.level_retries > 0:
-                bp, s = (np.asarray(bp, np.float32),
-                         np.asarray(s, np.int32))
-            bp_pyr[level], s_pyr[level] = bp, s
-            if params.log_path or "_n_coh" not in st:
-                # stream the record now: always when a log file is
-                # configured (observability opt-in pays the ~0.1 s scalar
-                # fetch), and always for records with no deferred device
-                # scalars (CPU backend — deferral would only delay logs)
-                ialog.emit(_finalize_stats(st), params.log_path)
-                st["_emitted"] = True
-            stats.append(st)
-            if params.checkpoint_dir:
-                ckpt.save_level(params.checkpoint_dir, level,
-                                np.asarray(bp, np.float32),
-                                np.asarray(s, np.int32), digest=digest)
-            if params.save_levels_dir:
-                from image_analogies_tpu.utils.imageio import save_image
-                import os
+                # §5.3: transient device faults retry at level granularity
+                bp, s, st = failure.run_with_retry(
+                    _level, retries=params.level_retries,
+                    context={"level": level}, log_path=params.log_path)
+                st["total_ms"] = (time.perf_counter() - t0) * 1e3
+                # bp/s may be DEVICE arrays (TPU backend): levels chain
+                # through them without host round-trips (the tunnel moves
+                # ~9 MB/s); host copies are fetched only for opt-in host
+                # consumers below and for the final result.  EXCEPT with
+                # level retries armed: the §5.3 fault model promises a
+                # retried level rebuilds from buffers that survive a
+                # device reset, and the coarser plane chained on-device
+                # could be invalidated by the very fault being retried —
+                # so fault-recovery runs keep the pre-chaining host copies
+                # (round-3 ADVICE item 1).
+                if params.level_retries > 0:
+                    bp, s = (np.asarray(bp, np.float32),
+                             np.asarray(s, np.int32))
+                bp_pyr[level], s_pyr[level] = bp, s
+                if params.log_path or "_n_coh" not in st:
+                    # stream the record now: always when a log file is
+                    # configured (observability opt-in pays the ~0.1 s
+                    # scalar fetch), and always for records with no
+                    # deferred device scalars (CPU backend — deferral
+                    # would only delay logs)
+                    ialog.emit(_finalize_stats(st), params.log_path)
+                    st["_emitted"] = True
+                stats.append(st)
+                if params.checkpoint_dir:
+                    ckpt.save_level(params.checkpoint_dir, level,
+                                    np.asarray(bp, np.float32),
+                                    np.asarray(s, np.int32), digest=digest)
+                if params.save_levels_dir:
+                    from image_analogies_tpu.utils.imageio import save_image
+                    import os
 
-                os.makedirs(params.save_levels_dir, exist_ok=True)
-                save_image(os.path.join(params.save_levels_dir,
-                                        f"level_{level:02d}.png"),
-                           np.clip(np.asarray(bp, np.float32), 0.0, 1.0))
+                    os.makedirs(params.save_levels_dir, exist_ok=True)
+                    save_image(os.path.join(params.save_levels_dir,
+                                            f"level_{level:02d}.png"),
+                               np.clip(np.asarray(bp, np.float32),
+                                       0.0, 1.0))
 
     # ONE fetch call for the deferred device scalars AND the finest B'
     # plane: `jax.device_get` on the pair starts both transfers before
@@ -263,17 +281,26 @@ def create_image_analogy(
         import jax
         import jax.numpy as jnp
 
-        vals, bp_fetched = jax.device_get(
-            (jnp.stack([st[k] for st, k in dev]), bp_pyr[0]))
+        with obs_trace.span("fetch"):
+            vals, bp_fetched = jax.device_get(
+                (jnp.stack([st[k] for st, k in dev]), bp_pyr[0]))
         for (st, k), v in zip(dev, vals):
             st[k] = float(v)
         bp_y = np.asarray(bp_fetched, np.float32)
+        obs_metrics.inc("fetch.bytes", int(vals.nbytes) + int(bp_y.nbytes))
     else:
         bp_y = np.asarray(bp_pyr[0], np.float32)
     for st in stats:
         _finalize_stats(st)  # no-op where the streaming path already did
         if not st.pop("_emitted", False):
             ialog.emit(st, params.log_path)
+    if obs_metrics._ACTIVE:
+        # kappa coherence-vs-approx pick totals, weighted by pixel count
+        for st in stats:
+            cr, px = st.get("coherence_ratio"), st.get("pixels", 0)
+            if cr is not None and px:
+                obs_metrics.inc("kappa.coherence_px", cr * px)
+                obs_metrics.inc("kappa.total_px", px)
     # the source map stays a DEVICE array unless a host consumer needs it
     # here (source_rgb's color gather, keep_levels' audit planes) — it is
     # introspection metadata, fetched lazily by AnalogyResult.source_map

@@ -41,6 +41,8 @@ from image_analogies_tpu.models.analogy import (
     _prep_planes,
     create_image_analogy,
 )
+from image_analogies_tpu.obs import metrics as obs_metrics
+from image_analogies_tpu.obs import trace as obs_trace
 from image_analogies_tpu.ops import color
 from image_analogies_tpu.utils import failure
 from image_analogies_tpu.utils import logging as ialog
@@ -265,10 +267,14 @@ def _sharded_phase(a, ap, frames, params: AnalogyParams, mesh,
                 jax.block_until_ready(out)
             return out
 
-        bp, s, n_coh = failure.run_with_retry(
-            _level, retries=params.level_retries,
-            context={"level": level, "phase": tag},
-            log_path=params.log_path)
+        # the level span is the sharded path's only timing record: the
+        # streamed per-frame stats below carry no ms fields (their device
+        # scalars are deferred), so `ia report` reads mesh wall here
+        with obs_trace.span("level", level=level, phase=tag):
+            bp, s, n_coh = failure.run_with_retry(
+                _level, retries=params.level_retries,
+                context={"level": level, "phase": tag},
+                log_path=params.log_path)
         if params.level_retries > 0:
             # §5.3: retried levels must rebuild from host-resident state
             bp, s = np.asarray(bp, np.float32), np.asarray(s, np.int32)
@@ -308,11 +314,18 @@ def _sharded_phase(a, ap, frames, params: AnalogyParams, mesh,
         stats.append(rec)
     ialog.emit({"event": "coherence_ratios", "phase": tag,
                 "ratios": ratios}, params.log_path)
+    if obs_metrics._ACTIVE:
+        for rec in recs:
+            obs_metrics.inc("kappa.coherence_px",
+                            rec["coherence_ratio"] * rec["pixels"])
+            obs_metrics.inc("kappa.total_px", rec["pixels"])
 
     # host copies of the FINEST level only — the sole host consumer
     hb, wb = b_src_pyrs[0][0].shape[:2]
-    bp0 = np.asarray(bp_stacks[0], np.float32)
-    s0 = np.asarray(s_stacks[0], np.int32)
+    with obs_trace.span("fetch", phase=tag):
+        bp0 = np.asarray(bp_stacks[0], np.float32)
+        s0 = np.asarray(s_stacks[0], np.int32)
+    obs_metrics.inc("fetch.bytes", int(bp0.nbytes) + int(s0.nbytes))
 
     results = []
     for i in range(t_real):
@@ -342,6 +355,14 @@ def video_analogy(
     scheme: str = "two_phase",
     backend=None,
 ) -> VideoResult:
+    # one observability run per CLIP: the per-frame engine calls below
+    # join this scope (reentrant run_scope) instead of minting their own
+    # run_ids
+    with obs_trace.run_scope(params):
+        return _video_analogy(a, ap, frames, params, scheme, backend)
+
+
+def _video_analogy(a, ap, frames, params, scheme, backend) -> VideoResult:
     if scheme not in ("sequential", "two_phase"):
         raise ValueError(f"unknown scheme {scheme!r}")
     frames = list(frames)
@@ -379,16 +400,19 @@ def video_analogy(
 
             prof = jax.profiler.trace(params.profile_dir)
         with prof:
-            phase1 = _sharded_phase(a, ap, frames, params, mesh, None,
-                                    stats, "phase1", remap_anchor=frames[0])
+            with obs_trace.span("phase", phase="phase1"):
+                phase1 = _sharded_phase(a, ap, frames, params, mesh, None,
+                                        stats, "phase1",
+                                        remap_anchor=frames[0])
             if len(frames) == 1:
                 outs = phase1
             else:
                 prevs = [phase1[t - 1].bp_y for t in range(1, len(frames))]
-                phase2 = _sharded_phase(a, ap, frames[1:], params, mesh,
-                                        prevs, stats, "phase2",
-                                        remap_anchor=frames[0],
-                                        frame_offset=1)
+                with obs_trace.span("phase", phase="phase2"):
+                    phase2 = _sharded_phase(a, ap, frames[1:], params, mesh,
+                                            prevs, stats, "phase2",
+                                            remap_anchor=frames[0],
+                                            frame_offset=1)
                 outs = [phase1[0]] + phase2
         return VideoResult(frames=[r.bp for r in outs],
                            frames_y=[r.bp_y for r in outs], stats=stats)

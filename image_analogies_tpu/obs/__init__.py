@@ -1,0 +1,27 @@
+"""Run-scoped observability (ISSUE 1 tentpole).
+
+Three pieces, all process-local and dependency-free (no jax import):
+
+- ``obs.metrics`` — a thread-safe registry of counters / gauges /
+  histograms.  Instrumentation sites call the module-level helpers
+  (``inc`` / ``add_gauge`` / ``observe``), which are a single bool check
+  when no run is active — the engine's hot paths stay near-zero-cost
+  with observability off (the 256^2 bench leg must not move).
+- ``obs.trace`` — ``run_scope(params)`` opens a run (fresh ``run_id``,
+  manifest record with config hash / backend / mesh / device kind / git
+  rev, per-run metrics registry) and ``span(name, **attrs)`` emits
+  nested wall-clock records; every JSONL record written through
+  ``utils.logging.emit`` while a run is active is stamped with the
+  ``run_id`` and a monotonically increasing ``seq``.
+- ``obs.report`` — the ``ia report`` analyzer: reads a run-log JSONL
+  and prints per-level timing (device vs host), counter totals
+  (devcache hit rate, retries, kappa pick ratio), and the run manifest.
+"""
+
+from image_analogies_tpu.obs import metrics, trace  # noqa: F401
+from image_analogies_tpu.obs.metrics import registry, snapshot  # noqa: F401
+from image_analogies_tpu.obs.trace import (  # noqa: F401
+    current_run_id,
+    run_scope,
+    span,
+)

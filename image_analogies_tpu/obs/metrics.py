@@ -1,0 +1,153 @@
+"""Process-local, thread-safe metrics registry.
+
+A :class:`MetricsRegistry` holds counters, gauges, and histograms keyed
+by name.  One registry is installed per run by ``obs.trace.run_scope``;
+instrumentation sites in the engine call the module-level helpers
+(:func:`inc`, :func:`add_gauge`, :func:`set_gauge`, :func:`observe`),
+which check a single module bool before touching the registry — with no
+active run the cost is one attribute load + branch per call site, so
+bench numbers do not move when observability is off.
+
+No jax / numpy imports here: the registry must be importable from any
+layer (utils, parallel, backends) without creating cycles or forcing
+device init.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional
+
+
+class Histogram:
+    """Fixed power-of-two bucket histogram (base-2 exponential).
+
+    Tracks count / sum / min / max plus counts per bucket
+    ``[2^k, 2^(k+1))``.  Good enough for ms and byte distributions
+    without requiring a quantile sketch dependency.
+    """
+
+    __slots__ = ("count", "total", "min", "max", "buckets")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self.buckets: Dict[int, int] = {}
+
+    def observe(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        if value < self.min:
+            self.min = value
+        if value > self.max:
+            self.max = value
+        k = max(0, math.frexp(value)[1]) if value > 0 else 0
+        self.buckets[k] = self.buckets.get(k, 0) + 1
+
+    def summary(self) -> Dict[str, float]:
+        if not self.count:
+            return {"count": 0, "sum": 0.0, "min": 0.0, "max": 0.0,
+                    "mean": 0.0}
+        return {"count": self.count, "sum": self.total, "min": self.min,
+                "max": self.max, "mean": self.total / self.count}
+
+
+class MetricsRegistry:
+    """Thread-safe named counters / gauges / histograms."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+        self._histograms: Dict[str, Histogram] = {}
+
+    def inc(self, name: str, value: float = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + value
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def add_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = self._gauges.get(name, 0) + value
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram()
+            h.observe(value)
+
+    def counter(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Plain-dict dump, safe to json-serialize into a run record."""
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": {k: h.summary()
+                               for k, h in self._histograms.items()},
+            }
+
+
+# --- module-level fast path -------------------------------------------------
+#
+# _ACTIVE is flipped by obs.trace when a run installs/uninstalls a
+# registry.  Hot-path call sites read one module global and branch; the
+# lock is only ever taken when a run asked for metrics.
+
+_ACTIVE = False
+_REGISTRY: Optional[MetricsRegistry] = None
+_STACK: List[MetricsRegistry] = []
+
+
+def _install(reg: MetricsRegistry) -> None:
+    global _ACTIVE, _REGISTRY
+    _STACK.append(reg)
+    _REGISTRY = reg
+    _ACTIVE = True
+
+
+def _uninstall(reg: MetricsRegistry) -> None:
+    global _ACTIVE, _REGISTRY
+    if reg in _STACK:
+        _STACK.remove(reg)
+    _REGISTRY = _STACK[-1] if _STACK else None
+    _ACTIVE = _REGISTRY is not None
+
+
+def registry() -> Optional[MetricsRegistry]:
+    return _REGISTRY
+
+
+def inc(name: str, value: float = 1) -> None:
+    if _ACTIVE:
+        _REGISTRY.inc(name, value)
+
+
+def set_gauge(name: str, value: float) -> None:
+    if _ACTIVE:
+        _REGISTRY.set_gauge(name, value)
+
+
+def add_gauge(name: str, value: float) -> None:
+    if _ACTIVE:
+        _REGISTRY.add_gauge(name, value)
+
+
+def observe(name: str, value: float) -> None:
+    if _ACTIVE:
+        _REGISTRY.observe(name, value)
+
+
+def snapshot() -> Dict[str, dict]:
+    return _REGISTRY.snapshot() if _REGISTRY is not None else {
+        "counters": {}, "gauges": {}, "histograms": {}}

@@ -1,0 +1,234 @@
+"""`ia report` — turn a run-log JSONL into an answer.
+
+Reads the records ``utils.logging.emit`` wrote (level stats, spans,
+manifest, run_end metrics snapshot) and prints, per run:
+
+- the run manifest (config hash, backend, strategy, mesh, device, git rev)
+- a per-level timing breakdown: wall (from ``span`` records) vs device
+  (the level stat's ``ms`` / ``enqueue_ms``) vs host (wall - device)
+- counter totals: devcache hit rate + upload bytes, retries, psum-gather
+  bytes, and the kappa coherence-vs-approx pick ratio
+- the slowest spans
+
+Works on both solo-run logs (``create_image_analogy``: one stat record
+per level with device timing) and sharded-run logs (``_sharded_phase``:
+per-frame records with no timing — wall comes from the mesh level spans,
+coherence from the phase-end ``coherence_ratios`` summary).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def load_records(path: str) -> List[Dict[str, Any]]:
+    recs = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # tolerate truncated tail lines (preempted run)
+            if isinstance(rec, dict):
+                recs.append(rec)
+    return recs
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.1f} GiB"
+
+
+def _is_level_stat(rec: Dict[str, Any]) -> bool:
+    return ("level" in rec and "event" not in rec
+            and ("db_rows" in rec or "pixels" in rec))
+
+
+def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Aggregate one run's records (already filtered to a single run_id)."""
+    manifest = next((r for r in records if r.get("event") == "run_manifest"),
+                    None)
+    run_end = next((r for r in records if r.get("event") == "run_end"), None)
+    spans = [r for r in records if r.get("event") == "span"]
+    stats = [r for r in records if _is_level_stat(r)]
+    retries = [r for r in records if r.get("event") == "level_retry"]
+    coh_summaries = [r for r in records
+                     if r.get("event") == "coherence_ratios"]
+
+    # --- per-(phase, level) rows -----------------------------------------
+    levels: Dict[Tuple[Optional[str], int], Dict[str, Any]] = {}
+
+    def row(phase, level):
+        key = (phase, level)
+        if key not in levels:
+            levels[key] = {"phase": phase, "level": level, "frames": 0,
+                           "wall_ms": 0.0, "device_ms": 0.0, "pixels": 0,
+                           "db_rows": 0, "coh_px": 0.0, "coh_known_px": 0}
+        return levels[key]
+
+    for st in stats:
+        r = row(st.get("phase"), int(st["level"]))
+        r["frames"] += 1
+        r["pixels"] += int(st.get("pixels", 0))
+        r["db_rows"] = max(r["db_rows"], int(st.get("db_rows", 0)))
+        # device time: real compute under level_sync, enqueue otherwise
+        r["device_ms"] += float(st.get("ms", st.get("enqueue_ms", 0.0)))
+        if "total_ms" in st:
+            r["wall_ms"] += float(st["total_ms"])
+        if "coherence_ratio" in st and st.get("pixels"):
+            r["coh_px"] += float(st["coherence_ratio"]) * int(st["pixels"])
+            r["coh_known_px"] += int(st["pixels"])
+
+    # sharded phase-end summaries carry the deferred coherence ratios the
+    # streamed per-frame records omitted; join on (phase, level, frame)
+    px_by_plf = {(st.get("phase"), int(st["level"]), st.get("frame")):
+                 int(st.get("pixels", 0)) for st in stats}
+    for summ in coh_summaries:
+        phase = summ.get("phase")
+        for key, ratio in (summ.get("ratios") or {}).items():
+            try:
+                lv_s, fr_s = key.split("_")
+                lv, fr = int(lv_s[1:]), int(fr_s[1:])
+            except (ValueError, IndexError):
+                continue
+            px = px_by_plf.get((phase, lv, fr))
+            if px:
+                r = row(phase, lv)
+                r["coh_px"] += float(ratio) * px
+                r["coh_known_px"] += px
+
+    # level spans override the stat-side wall: they bracket the full host
+    # iteration (features + scan + checkpoint io), and on the sharded path
+    # they are the ONLY timing signal
+    span_wall: Dict[Tuple[Optional[str], int], float] = {}
+    for sp in spans:
+        if sp.get("name") == "level" and "level" in sp:
+            k = (sp.get("phase"), int(sp["level"]))
+            span_wall[k] = span_wall.get(k, 0.0) + float(sp.get("wall_ms", 0))
+    for k, wall in span_wall.items():
+        row(k[0], k[1])["wall_ms"] = wall
+
+    for r in levels.values():
+        r["host_ms"] = max(r["wall_ms"] - r["device_ms"], 0.0) \
+            if r["wall_ms"] else 0.0
+        r["coherence_ratio"] = (r["coh_px"] / r["coh_known_px"]
+                                if r["coh_known_px"] else None)
+
+    # --- counters ---------------------------------------------------------
+    counters: Dict[str, float] = {}
+    if run_end:
+        counters.update((run_end.get("metrics") or {}).get("counters", {}))
+    # retries are visible even without the metrics toggle (failure.py
+    # always emits the level_retry event)
+    counters.setdefault("level_retry", 0)
+    counters["level_retry"] = max(counters["level_retry"], len(retries))
+
+    total_coh_px = sum(r["coh_px"] for r in levels.values())
+    total_known_px = sum(r["coh_known_px"] for r in levels.values())
+
+    hits = counters.get("devcache.hits", 0)
+    misses = counters.get("devcache.misses", 0)
+
+    return {
+        "manifest": manifest,
+        "run_end": run_end,
+        "levels": [levels[k] for k in sorted(
+            levels, key=lambda k: (str(k[0] or ""), -k[1]))],
+        "counters": counters,
+        "retries": len(retries),
+        "kappa_pick_ratio": (total_coh_px / total_known_px
+                             if total_known_px else None),
+        "devcache_hit_rate": (hits / (hits + misses)
+                              if (hits + misses) else None),
+        "spans": spans,
+        "n_records": len(records),
+    }
+
+
+def render(an: Dict[str, Any], run_id: Optional[str] = None) -> str:
+    out: List[str] = []
+    w = out.append
+
+    w(f"run {run_id or '(unstamped)'} — {an['n_records']} records")
+    man = an["manifest"]
+    if man:
+        keys = ("config_hash", "backend", "strategy", "mesh", "levels",
+                "device_kind", "device_count", "platform", "git_rev",
+                "jax_version", "metrics")
+        w("  manifest:")
+        for k in keys:
+            if k in man and man[k] is not None:
+                w(f"    {k:<13} {man[k]}")
+
+    if an["levels"]:
+        w("  per-level timing (ms):")
+        w(f"    {'phase':<8} {'lvl':>3} {'frames':>6} {'wall':>10} "
+          f"{'device':>10} {'host':>10} {'pixels':>10} {'coh%':>6}")
+        tot_wall = tot_dev = 0.0
+        for r in an["levels"]:
+            coh = (f"{100 * r['coherence_ratio']:.1f}"
+                   if r["coherence_ratio"] is not None else "-")
+            w(f"    {str(r['phase'] or '-'):<8} {r['level']:>3} "
+              f"{r['frames']:>6} {r['wall_ms']:>10.1f} "
+              f"{r['device_ms']:>10.1f} {r['host_ms']:>10.1f} "
+              f"{r['pixels']:>10} {coh:>6}")
+            tot_wall += r["wall_ms"]
+            tot_dev += r["device_ms"]
+        w(f"    {'total':<8} {'':>3} {'':>6} {tot_wall:>10.1f} "
+          f"{tot_dev:>10.1f} {max(tot_wall - tot_dev, 0.0):>10.1f}")
+
+    w("  counters:")
+    c = an["counters"]
+    if an["devcache_hit_rate"] is not None:
+        w(f"    devcache      {int(c.get('devcache.hits', 0))} hits / "
+          f"{int(c.get('devcache.misses', 0))} misses "
+          f"(hit rate {100 * an['devcache_hit_rate']:.1f}%), "
+          f"uploaded {_fmt_bytes(c.get('devcache.upload_bytes', 0))}")
+    w(f"    retries       {an['retries']}")
+    if an["kappa_pick_ratio"] is not None:
+        w(f"    kappa picks   {100 * an['kappa_pick_ratio']:.1f}% coherence "
+          f"/ {100 * (1 - an['kappa_pick_ratio']):.1f}% approx")
+    if c.get("mesh.level_steps"):
+        w(f"    mesh steps    {int(c['mesh.level_steps'])}, "
+          f"psum-gather ~{_fmt_bytes(c.get('mesh.psum_gather_bytes', 0))}")
+    if c.get("fetch.bytes"):
+        w(f"    fetched       {_fmt_bytes(c['fetch.bytes'])}")
+    shown = {"devcache.hits", "devcache.misses", "devcache.upload_bytes",
+             "level_retry", "mesh.level_steps", "mesh.psum_gather_bytes",
+             "fetch.bytes", "kappa.coherence_px", "kappa.total_px"}
+    rest = {k: v for k, v in c.items() if k not in shown and v}
+    for k in sorted(rest):
+        w(f"    {k:<13} {rest[k]:g}")
+
+    other = [sp for sp in an["spans"] if sp.get("name") != "level"]
+    if other:
+        agg: Dict[str, List[float]] = {}
+        for sp in other:
+            agg.setdefault(sp["name"], []).append(
+                float(sp.get("wall_ms", 0)))
+        w("  spans:")
+        for name in sorted(agg, key=lambda n: -sum(agg[n])):
+            v = agg[name]
+            w(f"    {name:<20} n={len(v):<4} total {sum(v):>9.1f} ms")
+    return "\n".join(out)
+
+
+def report(path: str) -> str:
+    """Analyze a run-log JSONL; one section per run_id found in it."""
+    records = load_records(path)
+    if not records:
+        return f"{path}: no records"
+    by_run: Dict[Optional[str], List[Dict[str, Any]]] = {}
+    for rec in records:
+        by_run.setdefault(rec.get("run_id"), []).append(rec)
+    sections = []
+    for run_id in by_run:  # insertion order == file order
+        sections.append(render(analyze(by_run[run_id]), run_id))
+    return "\n\n".join(sections)

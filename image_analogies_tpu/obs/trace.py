@@ -1,0 +1,256 @@
+"""Run-scoped span tracing.
+
+``run_scope(params, ...)`` opens a run: it mints a ``run_id``, installs
+a per-run :class:`~image_analogies_tpu.obs.metrics.MetricsRegistry`,
+registers a record stamper with ``utils.logging`` (every JSONL record
+written while the run is active gains ``run_id`` + a monotonically
+increasing ``seq``), and emits a ``run_manifest`` record (config hash,
+backend, mesh shape, device kind, git rev).  On exit it emits a
+``run_end`` record carrying the metrics snapshot.
+
+``span(name, **attrs)`` is a context manager producing one
+``{"event": "span", "name": ..., "wall_ms": ..., "depth": ...,
+"parent": ...}`` record per exit.  Spans nest via a thread-local stack.
+
+The whole module is inert unless a run is active: ``run_scope`` with
+``params.metrics`` false and no ``log_path`` yields a no-op scope, and
+``span`` then returns a singleton no-op context manager — no record,
+no allocation, no clock read — so the disabled engine path stays at
+bench speed.  ``run_scope`` is reentrant: a nested call (video's
+per-frame ``create_image_analogy``) joins the enclosing run instead of
+minting a second ``run_id``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+from image_analogies_tpu.obs import metrics as _metrics
+from image_analogies_tpu.utils import logging as _logging
+
+
+class RunContext:
+    """State of one observed run (one engine invocation or one clip)."""
+
+    __slots__ = ("run_id", "log_path", "registry", "seq", "_seq_lock",
+                 "depth")
+
+    def __init__(self, run_id: str, log_path: Optional[str],
+                 registry: _metrics.MetricsRegistry):
+        self.run_id = run_id
+        self.log_path = log_path
+        self.registry = registry
+        self.seq = 0
+        self._seq_lock = threading.Lock()
+        self.depth = 0  # run_scope reentrancy count
+
+    def next_seq(self) -> int:
+        with self._seq_lock:
+            s = self.seq
+            self.seq += 1
+            return s
+
+
+_CURRENT: Optional[RunContext] = None
+_SPANS = threading.local()  # per-thread span stack
+
+
+def current_run_id() -> Optional[str]:
+    return _CURRENT.run_id if _CURRENT is not None else None
+
+
+def _stamp(record: Dict[str, Any]) -> None:
+    ctx = _CURRENT
+    if ctx is not None:
+        record.setdefault("run_id", ctx.run_id)
+        record.setdefault("seq", ctx.next_seq())
+
+
+# Registered once at import: utils.logging calls it on every emit; it is
+# a no-op dict check while no run is active.
+_logging.set_record_stamper(_stamp)
+
+
+_UNSET = object()
+_GIT_REV: Any = _UNSET
+
+
+def _git_rev() -> Optional[str]:
+    global _GIT_REV
+    if _GIT_REV is _UNSET:
+        try:
+            _GIT_REV = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=5,
+                check=True).stdout.strip() or None
+        except Exception:
+            _GIT_REV = None
+    return _GIT_REV
+
+
+def _device_info() -> Dict[str, Any]:
+    """Backend/device facts WITHOUT forcing jax (or device) init: only
+    report what an already-imported, already-initialized jax knows."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return {}
+    try:
+        # jax.devices() would initialize the backend; only peek if the
+        # runtime already has one (local_devices after init is cheap).
+        backends = sys.modules.get("jax._src.xla_bridge")
+        if backends is None or not getattr(backends, "_backends", None):
+            return {"jax_version": getattr(jax, "__version__", None)}
+        devs = jax.devices()
+        return {
+            "jax_version": getattr(jax, "__version__", None),
+            "device_kind": devs[0].device_kind if devs else None,
+            "device_count": len(devs),
+            "platform": devs[0].platform if devs else None,
+        }
+    except Exception:
+        return {"jax_version": getattr(jax, "__version__", None)}
+
+
+def config_digest(params: Any) -> str:
+    """Stable short hash of the full params dataclass (every field —
+    unlike checkpoint.run_digest, which excludes aux knobs: the manifest
+    should distinguish runs that differ in ANY knob)."""
+    try:
+        import dataclasses
+        d = dataclasses.asdict(params)
+    except TypeError:
+        d = dict(getattr(params, "__dict__", {"repr": repr(params)}))
+    blob = json.dumps(d, sort_keys=True, default=str).encode()
+    return hashlib.sha1(blob).hexdigest()[:12]
+
+
+def build_manifest(params: Any = None,
+                   extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    man: Dict[str, Any] = {"event": "run_manifest"}
+    if params is not None:
+        man["config_hash"] = config_digest(params)
+        man["backend"] = getattr(params, "backend", None)
+        man["strategy"] = getattr(params, "strategy", None)
+        man["mesh"] = [getattr(params, "data_shards", 1),
+                       getattr(params, "db_shards", 1)]
+        man["levels"] = getattr(params, "levels", None)
+        man["metrics"] = bool(getattr(params, "metrics", False))
+    rev = _git_rev()
+    if rev:
+        man["git_rev"] = rev
+    man.update(_device_info())
+    if extra:
+        man.update(extra)
+    return man
+
+
+@contextlib.contextmanager
+def run_scope(params: Any = None, log_path: Optional[str] = None,
+              manifest_extra: Optional[Dict[str, Any]] = None):
+    """Open an observed run, or join the active one (reentrant).
+
+    Inert (yields None, zero side effects) unless the params ask for
+    observability: ``params.metrics`` truthy or a log path is set.
+    """
+    global _CURRENT
+    if log_path is None and params is not None:
+        log_path = getattr(params, "log_path", None)
+    want = bool(getattr(params, "metrics", False) or log_path)
+
+    ctx = _CURRENT
+    if ctx is not None:
+        # Reentrant join: video's per-frame engine calls ride the clip's
+        # run — one run_id, one registry, one manifest.
+        ctx.depth += 1
+        try:
+            yield ctx
+        finally:
+            ctx.depth -= 1
+        return
+    if not want:
+        yield None
+        return
+
+    ctx = RunContext(uuid.uuid4().hex[:16], log_path,
+                     _metrics.MetricsRegistry())
+    _CURRENT = ctx
+    _metrics._install(ctx.registry)
+    try:
+        _logging.emit(build_manifest(params, manifest_extra), log_path)
+        yield ctx
+    finally:
+        # run_end goes out while the stamper is still active so it
+        # carries the run_id like every other record of the run.
+        snap = ctx.registry.snapshot()
+        _logging.emit({"event": "run_end", "metrics": snap}, log_path)
+        _metrics._uninstall(ctx.registry)
+        _CURRENT = None
+
+
+class _NoopSpan:
+    """Singleton no-op context manager for the disabled path: ``span()``
+    with no active run costs one global read + one attribute call and
+    allocates nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("name", "attrs", "t0", "ctx")
+
+    def __init__(self, name: str, attrs: Dict[str, Any], ctx: RunContext):
+        self.name = name
+        self.attrs = attrs
+        self.ctx = ctx
+        self.t0 = 0.0
+
+    def __enter__(self):
+        stack = getattr(_SPANS, "stack", None)
+        if stack is None:
+            stack = _SPANS.stack = []
+        stack.append(self)
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        wall_ms = (time.perf_counter() - self.t0) * 1e3
+        stack = _SPANS.stack
+        stack.pop()
+        rec: Dict[str, Any] = {
+            "event": "span",
+            "name": self.name,
+            "wall_ms": round(wall_ms, 3),
+            "depth": len(stack),
+        }
+        if stack:
+            rec["parent"] = stack[-1].name
+        if exc and exc[0] is not None:
+            rec["error"] = getattr(exc[0], "__name__", str(exc[0]))
+        rec.update(self.attrs)
+        _logging.emit(rec, self.ctx.log_path)
+        return False
+
+
+def span(name: str, **attrs: Any):
+    """Wall-clock span; no-op singleton when no run is active."""
+    ctx = _CURRENT
+    if ctx is None:
+        return _NOOP
+    return _Span(name, attrs, ctx)

@@ -37,14 +37,15 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from image_analogies_tpu.backends.tpu import (
-    _PACKED_TILE_CAP,
     _PACKED_VMEM_LIMIT,
     TpuLevelDB,
+    _packed_tile_cap,
     _scan_tile,
     _tile_rows,
     batched_scan_core,
     wavefront_scan_core,
 )
+from image_analogies_tpu.obs import metrics as obs_metrics
 from image_analogies_tpu.ops.pallas_match import bf16_split3
 from image_analogies_tpu.parallel.mesh import shard_map
 from image_analogies_tpu.parallel.sharded_match import (
@@ -93,8 +94,14 @@ def _cached_multichip_step(mesh: Mesh, strategy: str, force_xla: bool,
                 p, _ = packed_champion_allreduce(
                     g1.astype(jnp.bfloat16), g2.astype(jnp.bfloat16),
                     wk_loc, "db",
+                    # the same VMEM-aware cap the single-chip anchor uses
+                    # (the per-shard kernel builds the same (M, tile) f32
+                    # score block, and M plateaus at B's diagonal width
+                    # regardless of sharding)
                     tile_n=_scan_tile(wk_loc.shape[0], wk_loc.shape[1],
-                                      cap_rows=_PACKED_TILE_CAP),
+                                      cap_rows=_packed_tile_cap(
+                                          tmpl.hb, tmpl.wb,
+                                          int(tmpl.off.shape[0]))),
                     interpret=packed_interpret,
                     vmem_limit=0 if packed_interpret
                     else _PACKED_VMEM_LIMIT)
@@ -233,6 +240,20 @@ def multichip_level_step(
                                   precision, packed,
                                   packed and packed_interpret, fused_live,
                                   query_parallel)
+    if obs_metrics._ACTIVE:
+        # host-side ESTIMATE of the per-step psum-gather payload (the
+        # logical rows every chip contributes to, per frame): the nf
+        # coherence candidates + 1 anchor row per pixel, each gather
+        # moving (L+2) f32 columns on the fused-live diet or full-F rows
+        # (+ the separate afilt psum) otherwise.  Counted here, not in
+        # the traced step — tracing must stay observability-free.
+        nb = int(template.static_q.shape[0])
+        nf = int(template.flat_idx.shape[1])
+        width = (int(dbl_shard.shape[1]) if fused_live
+                 else int(template.static_q.shape[1]) + 1)
+        obs_metrics.inc("mesh.level_steps")
+        obs_metrics.inc("mesh.psum_gather_bytes",
+                        t_total * nb * (nf + 1) * width * 4)
     return step(frame_static_q, db_shard_src, dbn_shard_src,
                 afilt_shard_src, wk_shard, dbl_shard, template,
                 jnp.float32(kappa_mult))

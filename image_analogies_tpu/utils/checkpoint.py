@@ -33,7 +33,7 @@ def run_digest(params, a_shape, b_shape) -> str:
         # not parity-equivalent to exact_hi/wavefront.
         if k not in ("checkpoint_dir", "resume_from_level", "profile_dir",
                      "log_path", "db_shards", "data_shards", "level_retries",
-                     "save_levels_dir", "level_sync")),
+                     "save_levels_dir", "level_sync", "metrics")),
         tuple(a_shape), tuple(b_shape)))
     return hashlib.sha256(payload.encode()).hexdigest()[:16]
 

@@ -24,6 +24,8 @@ from typing import Optional
 
 import numpy as np
 
+from image_analogies_tpu.obs import metrics as obs_metrics
+
 _MAX_BYTES = 1 << 30  # 1 GiB of cached device inputs
 _cache: "OrderedDict[tuple, object]" = OrderedDict()
 _bytes = 0
@@ -63,14 +65,19 @@ def device_put_cached(x, dtype=None):
             pass
         if not deleted:
             _cache.move_to_end(key)
+            obs_metrics.inc("devcache.hits")
             return hit
         _bytes -= arr.nbytes
         _cache.pop(key, None)
+        obs_metrics.inc("devcache.dead_evictions")
     dev = jax.device_put(jnp.asarray(arr))
     _cache[key] = dev
     _bytes += arr.nbytes
+    obs_metrics.inc("devcache.misses")
+    obs_metrics.inc("devcache.upload_bytes", arr.nbytes)
     while _bytes > _MAX_BYTES and _cache:
         _, old = _cache.popitem(last=False)
+        obs_metrics.inc("devcache.evictions")
         try:
             _bytes -= int(np.prod(old.shape)) * old.dtype.itemsize
         except Exception:  # pragma: no cover

@@ -25,6 +25,7 @@ from __future__ import annotations
 import time
 from typing import Any, Callable, Optional
 
+from image_analogies_tpu.obs import metrics as obs_metrics
 from image_analogies_tpu.utils import logging as ialog
 
 # Synthetic-fault state (fault injection for tests/drills).
@@ -89,6 +90,7 @@ def run_with_retry(
             if not _is_transient(exc) or attempt >= retries:
                 raise
             attempt += 1
+            obs_metrics.inc("level_retry")
             ialog.emit({
                 "event": "level_retry",
                 "attempt": attempt,

@@ -15,10 +15,22 @@ from typing import Any, Dict, Optional
 
 logger = logging.getLogger("image_analogies_tpu")
 
+# Optional per-record stamper (obs.trace registers one at import to add
+# run_id/seq while a run is active).  Kept as a hook so this module stays
+# import-cycle-free: obs imports utils.logging, never the reverse.
+_STAMPER: Optional[Any] = None
+
+
+def set_record_stamper(fn) -> None:
+    global _STAMPER
+    _STAMPER = fn
+
 
 def emit(record: Dict[str, Any], path: Optional[str] = None) -> None:
     record = dict(record)
     record.setdefault("ts", time.time())
+    if _STAMPER is not None:
+        _STAMPER(record)
     logger.info("%s", json.dumps(record, sort_keys=True))
     if path:
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)

@@ -70,11 +70,18 @@ def test_structured_log_records(tmp_path, rng):
     p = AnalogyParams(levels=2, backend="cpu", log_path=log)
     create_image_analogy(a, ap, b, p)
     recs = [json.loads(l) for l in open(log)]
-    assert len(recs) == 2
-    for r in recs:
+    # a log_path run is an observed run (obs/): the per-level stat records
+    # ride inside a run-scoped envelope — manifest first, run_end (metrics
+    # snapshot) last, every record stamped with the one run_id
+    stat = [r for r in recs if "level" in r and "event" not in r]
+    assert len(stat) == 2
+    for r in stat:
         for key in ("level", "db_rows", "pixels", "coherence_ratio", "ms",
                     "backend", "ts"):
             assert key in r, key
+    assert recs[0].get("event") == "run_manifest"
+    assert recs[-1].get("event") == "run_end"
+    assert len({r.get("run_id") for r in recs}) == 1
 
 
 def test_profile_dir_writes_trace(tmp_path, rng):

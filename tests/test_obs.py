@@ -1,0 +1,346 @@
+"""Observability layer (obs/): registry thread-safety, span nesting +
+run_id propagation, `ia report` golden output on solo and sharded fixture
+logs, and the disabled path's zero-record / zero-allocation guarantee."""
+
+import json
+import threading
+import tracemalloc
+
+import pytest
+
+from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.models.analogy import create_image_analogy
+from image_analogies_tpu.obs import metrics as obs_metrics
+from image_analogies_tpu.obs import report as obs_report
+from image_analogies_tpu.obs import trace as obs_trace
+
+from tests.conftest import make_pair
+
+
+# ---------------------------------------------------------------- registry
+
+def test_registry_counters_under_threads():
+    reg = obs_metrics.MetricsRegistry()
+
+    def work():
+        for _ in range(1000):
+            reg.inc("hits")
+            reg.inc("bytes", 64)
+            reg.observe("ms", 2.5)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = reg.snapshot()
+    assert snap["counters"]["hits"] == 8000
+    assert snap["counters"]["bytes"] == 8000 * 64
+    h = snap["histograms"]["ms"]
+    assert h["count"] == 8000
+    assert h["min"] == h["max"] == 2.5
+    assert h["sum"] == pytest.approx(8000 * 2.5)
+
+
+def test_module_helpers_inert_without_run():
+    assert obs_metrics.registry() is None
+    obs_metrics.inc("nope")
+    obs_metrics.observe("nope", 1.0)
+    assert obs_metrics.registry() is None
+    assert obs_metrics.snapshot() == {"counters": {}, "gauges": {},
+                                      "histograms": {}}
+
+
+def test_module_helpers_route_to_active_run():
+    p = AnalogyParams(metrics=True)
+    with obs_trace.run_scope(p) as ctx:
+        obs_metrics.inc("x", 2)
+        obs_metrics.inc("x", 3)
+        assert obs_metrics.registry() is ctx.registry
+        assert ctx.registry.counter("x") == 5
+    assert obs_metrics.registry() is None
+
+
+# ------------------------------------------------------------------ spans
+
+def test_span_nesting_and_run_id_on_every_record(tmp_path):
+    log = str(tmp_path / "run.jsonl")
+    p = AnalogyParams(metrics=True, log_path=log)
+    with obs_trace.run_scope(p) as ctx:
+        rid = ctx.run_id
+        with obs_trace.span("phase", phase="phase1"):
+            with obs_trace.span("level", level=2):
+                pass
+            with obs_trace.span("level", level=1):
+                pass
+    recs = [json.loads(l) for l in open(log)]
+    # manifest + 3 spans + run_end
+    assert [r.get("event") for r in recs] == [
+        "run_manifest", "span", "span", "span", "run_end"]
+    assert all(r["run_id"] == rid for r in recs)
+    assert [r["seq"] for r in recs] == list(range(5))
+    inner = [r for r in recs if r.get("name") == "level"]
+    assert [r["level"] for r in inner] == [2, 1]
+    assert all(r["depth"] == 1 and r["parent"] == "phase" for r in inner)
+    outer = next(r for r in recs if r.get("name") == "phase")
+    assert outer["depth"] == 0 and "parent" not in outer
+    assert outer["wall_ms"] >= max(r["wall_ms"] for r in inner)
+
+
+def test_run_scope_reentrant_single_run_id(tmp_path):
+    log = str(tmp_path / "run.jsonl")
+    p = AnalogyParams(metrics=True, log_path=log)
+    with obs_trace.run_scope(p) as outer:
+        with obs_trace.run_scope(p) as inner:  # video frame joins the clip
+            assert inner is outer
+            assert obs_trace.current_run_id() == outer.run_id
+    recs = [json.loads(l) for l in open(log)]
+    assert sum(r.get("event") == "run_manifest" for r in recs) == 1
+    assert sum(r.get("event") == "run_end" for r in recs) == 1
+
+
+def test_engine_log_records_all_stamped(tmp_path):
+    log = str(tmp_path / "run.jsonl")
+    a, ap, b = make_pair(20, 22, seed=3)
+    params = AnalogyParams(levels=2, backend="cpu", metrics=True,
+                           log_path=log)
+    create_image_analogy(a, ap, b, params)
+    recs = [json.loads(l) for l in open(log)]
+    assert recs[0]["event"] == "run_manifest"
+    assert recs[-1]["event"] == "run_end"
+    rids = {r.get("run_id") for r in recs}
+    assert len(rids) == 1 and None not in rids
+    assert [r["seq"] for r in recs] == list(range(len(recs)))
+    # one stat + one span per level
+    assert sum(1 for r in recs if r.get("name") == "level") == 2
+    assert sum(1 for r in recs
+               if "level" in r and "event" not in r) == 2
+    # kappa totals landed in the registry snapshot
+    counters = recs[-1]["metrics"]["counters"]
+    assert counters["kappa.total_px"] > 0
+
+
+# -------------------------------------------------------------- ia report
+
+def _write_solo_fixture(path):
+    recs = [
+        {"event": "run_manifest", "config_hash": "abc123def456",
+         "backend": "tpu", "strategy": "wavefront", "mesh": [1, 1],
+         "levels": 2, "metrics": True, "git_rev": "deadbee",
+         "run_id": "solo1", "seq": 0, "ts": 1.0},
+        {"level": 1, "db_rows": 100, "pixels": 144, "ms": 10.0,
+         "total_ms": 12.0, "coherence_ratio": 0.5, "backend": "tpu",
+         "strategy": "wavefront", "run_id": "solo1", "seq": 1, "ts": 1.1},
+        {"event": "span", "name": "level", "level": 1, "wall_ms": 12.5,
+         "depth": 0, "run_id": "solo1", "seq": 2, "ts": 1.2},
+        {"level": 0, "db_rows": 400, "pixels": 576, "ms": 40.0,
+         "total_ms": 45.0, "coherence_ratio": 0.75, "backend": "tpu",
+         "strategy": "wavefront", "run_id": "solo1", "seq": 3, "ts": 1.3},
+        {"event": "span", "name": "level", "level": 0, "wall_ms": 46.0,
+         "depth": 0, "run_id": "solo1", "seq": 4, "ts": 1.4},
+        {"event": "span", "name": "fetch", "wall_ms": 3.0, "depth": 0,
+         "run_id": "solo1", "seq": 5, "ts": 1.5},
+        {"event": "run_end", "metrics": {"counters": {
+            "devcache.hits": 3, "devcache.misses": 1,
+            "devcache.upload_bytes": 4096, "fetch.bytes": 2048,
+            "kappa.coherence_px": 504.0, "kappa.total_px": 720},
+            "gauges": {}, "histograms": {}},
+         "run_id": "solo1", "seq": 6, "ts": 1.6},
+    ]
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r, sort_keys=True) + "\n")
+
+
+SOLO_GOLDEN = """\
+run solo1 — 7 records
+  manifest:
+    config_hash   abc123def456
+    backend       tpu
+    strategy      wavefront
+    mesh          [1, 1]
+    levels        2
+    git_rev       deadbee
+    metrics       True
+  per-level timing (ms):
+    phase    lvl frames       wall     device       host     pixels   coh%
+    -          1      1       12.5       10.0        2.5        144   50.0
+    -          0      1       46.0       40.0        6.0        576   75.0
+    total                     58.5       50.0        8.5
+  counters:
+    devcache      3 hits / 1 misses (hit rate 75.0%), uploaded 4.0 KiB
+    retries       0
+    kappa picks   70.0% coherence / 30.0% approx
+    fetched       2.0 KiB
+  spans:
+    fetch                n=1    total       3.0 ms"""
+
+
+def test_report_golden_solo(tmp_path):
+    log = str(tmp_path / "solo.jsonl")
+    _write_solo_fixture(log)
+    assert obs_report.report(log) == SOLO_GOLDEN
+
+
+def _write_mesh_fixture(path):
+    recs = [
+        {"event": "run_manifest", "config_hash": "fedcba987654",
+         "backend": "tpu", "strategy": "wavefront", "mesh": [2, 2],
+         "levels": 2, "metrics": True, "run_id": "mesh1", "seq": 0,
+         "ts": 2.0},
+    ]
+    seq = 1
+    for lv in (1, 0):
+        for fr in (0, 1):
+            # the sharded phase's streamed per-frame record: NO timing
+            # fields, coherence deferred to the phase-end summary
+            recs.append({"level": lv, "frame": fr, "phase": "phase1",
+                         "db_rows": 100, "pixels": 256, "backend": "tpu",
+                         "strategy": "wavefront",
+                         "mesh": {"data": 2, "db": 2}, "run_id": "mesh1",
+                         "seq": seq, "ts": 2.0 + seq})
+            seq += 1
+        recs.append({"event": "span", "name": "level", "level": lv,
+                     "phase": "phase1", "wall_ms": 20.0 + lv, "depth": 1,
+                     "parent": "phase", "run_id": "mesh1", "seq": seq,
+                     "ts": 2.0 + seq})
+        seq += 1
+    recs.append({"event": "coherence_ratios", "phase": "phase1",
+                 "ratios": {"l1_f0": 0.5, "l1_f1": 0.5, "l0_f0": 0.75,
+                            "l0_f1": 0.25},
+                 "run_id": "mesh1", "seq": seq, "ts": 2.0 + seq})
+    seq += 1
+    recs.append({"event": "span", "name": "fetch", "phase": "phase1",
+                 "wall_ms": 5.0, "depth": 1, "parent": "phase",
+                 "run_id": "mesh1", "seq": seq, "ts": 2.0 + seq})
+    seq += 1
+    recs.append({"event": "span", "name": "phase", "phase": "phase1",
+                 "wall_ms": 60.0, "depth": 0, "run_id": "mesh1",
+                 "seq": seq, "ts": 2.0 + seq})
+    seq += 1
+    recs.append({"event": "run_end", "metrics": {"counters": {
+        "devcache.hits": 10, "devcache.misses": 4,
+        "devcache.upload_bytes": 1 << 20, "mesh.level_steps": 2,
+        "mesh.psum_gather_bytes": 3 << 20, "fetch.bytes": 8192,
+        "kappa.coherence_px": 512.0, "kappa.total_px": 1024},
+        "gauges": {}, "histograms": {}}, "run_id": "mesh1", "seq": seq,
+        "ts": 2.0 + seq})
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r, sort_keys=True) + "\n")
+
+
+MESH_GOLDEN = """\
+run mesh1 — 11 records
+  manifest:
+    config_hash   fedcba987654
+    backend       tpu
+    strategy      wavefront
+    mesh          [2, 2]
+    levels        2
+    metrics       True
+  per-level timing (ms):
+    phase    lvl frames       wall     device       host     pixels   coh%
+    phase1     1      2       21.0        0.0       21.0        512   50.0
+    phase1     0      2       20.0        0.0       20.0        512   50.0
+    total                     41.0        0.0       41.0
+  counters:
+    devcache      10 hits / 4 misses (hit rate 71.4%), uploaded 1.0 MiB
+    retries       0
+    kappa picks   50.0% coherence / 50.0% approx
+    mesh steps    2, psum-gather ~3.0 MiB
+    fetched       8.0 KiB
+  spans:
+    phase                n=1    total      60.0 ms
+    fetch                n=1    total       5.0 ms"""
+
+
+def test_report_golden_sharded(tmp_path):
+    log = str(tmp_path / "mesh.jsonl")
+    _write_mesh_fixture(log)
+    assert obs_report.report(log) == MESH_GOLDEN
+
+
+def test_report_cli_subcommand(tmp_path, capsys):
+    from image_analogies_tpu.cli import main
+
+    log = str(tmp_path / "solo.jsonl")
+    _write_solo_fixture(log)
+    assert main(["report", log]) == 0
+    out = capsys.readouterr().out
+    assert "run solo1" in out
+    assert "per-level timing" in out
+    assert main(["report", str(tmp_path / "missing.jsonl")]) == 2
+
+
+def test_report_tolerates_truncated_tail(tmp_path):
+    log = str(tmp_path / "cut.jsonl")
+    _write_solo_fixture(log)
+    with open(log, "a") as f:
+        f.write('{"event": "span", "name": "lev')  # preempted mid-write
+    assert obs_report.report(log) == SOLO_GOLDEN
+
+
+# ---------------------------------------------------------- disabled path
+
+def test_disabled_path_no_records_no_allocations(tmp_path):
+    a, ap, b = make_pair(20, 22, seed=3)
+    params = AnalogyParams(levels=2, backend="cpu")  # metrics off, no log
+
+    emitted = []
+    from image_analogies_tpu.utils import logging as ialog
+    orig_stamper = ialog._STAMPER
+
+    def spy(record):
+        emitted.append(dict(record))
+        if orig_stamper is not None:
+            orig_stamper(record)
+
+    ialog.set_record_stamper(spy)
+    try:
+        create_image_analogy(a, ap, b, params)  # warm caches
+        assert obs_trace.current_run_id() is None
+        # the stamper sees emit() calls even with no log file — with
+        # observability off, zero obs records (spans/manifest/run_end)
+        # may pass through it
+        assert not any(r.get("event") in ("span", "run_manifest",
+                                          "run_end") for r in emitted)
+        assert not any("run_id" in r for r in emitted)
+    finally:
+        ialog.set_record_stamper(orig_stamper)
+
+    # the disabled span is the no-op SINGLETON: nothing retained
+    sp = obs_trace.span("level", level=0)
+    assert sp is obs_trace.span("fetch")
+    assert sp is obs_trace._NOOP
+
+    # no net allocations attributable to the obs layer across a full run
+    tracemalloc.start()
+    try:
+        create_image_analogy(a, ap, b, params)
+        snap = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+    obs_allocs = [t for t in snap.traces
+                  if any("image_analogies_tpu/obs/" in fr.filename
+                         for fr in t.traceback)]
+    assert obs_allocs == []
+    assert obs_metrics.registry() is None
+
+
+# ------------------------------------------------- shared VMEM tile cap
+
+def test_packed_tile_cap_shrinks_with_wide_b():
+    from image_analogies_tpu.backends.tpu import (
+        _PACKED_TILE_CAP,
+        _packed_tile_cap,
+    )
+
+    # north-star geometry (1024^2, 5x5 patches): plateau M ~ 344 keeps
+    # the full round-5 tile raise
+    assert _packed_tile_cap(1024, 1024, 25) == _PACKED_TILE_CAP
+    # a ~4096-wide B plateaus at M ~ 1365: the cap must shrink below the
+    # fixed 16384 rows or the (M, tile) f32 block blows the VMEM budget
+    wide = _packed_tile_cap(4096, 4096, 25)
+    assert wide < _PACKED_TILE_CAP
+    assert wide >= 256 and (wide & (wide - 1)) == 0  # power of two

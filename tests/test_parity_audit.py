@@ -94,6 +94,7 @@ def test_committed_bench_record_backs_auto_default():
     # tarball; note ls-files alone would also return empty when such an
     # export lands inside some enclosing work tree)
     reads = []
+    git_ok = False
     try:
         tracked = subprocess.run(
             ["git", "ls-tree", "-r", "--name-only", "HEAD"], cwd=here,
@@ -106,9 +107,14 @@ def test_committed_bench_record_backs_auto_default():
                     capture_output=True, text=True, timeout=30, check=True,
                 ).stdout
                 reads.append((os.path.join(here, p), raw))
+        git_ok = True
     except (OSError, subprocess.SubprocessError):
         reads = []
-    if not reads:
+    if not git_ok:
+        # fall back to the working tree only when git itself FAILED
+        # (exported tarball, no git binary) — a git that succeeded with
+        # zero matches is an authoritative "HEAD has no bench records"
+        # and must not be second-guessed by untracked working-tree files
         for path in sorted(glob.glob(os.path.join(here, "BENCH_r*.json"))):
             with open(path) as f:
                 reads.append((path, f.read()))
