@@ -53,6 +53,7 @@ import jax
 import jax.numpy as jnp
 
 from image_analogies_tpu.backends.base import LevelJob, Matcher
+from image_analogies_tpu.obs import device as obs_device
 from image_analogies_tpu.ops.features import (
     build_features_jax,
     causal_mask,
@@ -367,6 +368,13 @@ def _packed_weight_arrays(src, spec, npad: int, mode2p: bool):
     return pack(d1, d2), pack(d3, d1), dbnh, shift, live_idx
 
 
+# jit entry points below are wrapped in obs.device compile-aware shims
+# (a no-op passthrough unless a metrics run is active): every level
+# program's compile wall-time, cache hit/recompile, and XLA cost
+# estimate lands in the run log.  static_argnums mirror each jit's
+# static_argnames positions — the AOT executable takes only dynamic args.
+
+
 @functools.partial(jax.jit, static_argnames=("spec", "pad_tile", "pad_full",
                                              "pad_mode"))
 def _prepare_level_arrays(
@@ -481,6 +489,11 @@ def _prepare_level_arrays(
     return out
 
 
+_prepare_level_arrays = obs_device.instrument(
+    _prepare_level_arrays, "tpu.prepare_level_arrays",
+    static_argnums=(0, 11, 12, 13))  # spec, pad_tile, pad_full, pad_mode
+
+
 @functools.lru_cache(maxsize=None)
 def _cached_sharded_db_builder(mesh, spec, pad_full: bool, npad: int,
                                fp: int, packed: bool):
@@ -541,7 +554,8 @@ def _cached_sharded_db_builder(mesh, spec, pad_full: bool, npad: int,
     outs = (sh_db, sh_row, sh_row)
     if packed:
         outs = outs + (sh_db, sh_rep, sh_db)
-    return jax.jit(build, out_shardings=outs)
+    return obs_device.instrument(jax.jit(build, out_shardings=outs),
+                                 "tpu.sharded_db_build")
 
 
 @functools.partial(jax.jit, static_argnames=("spec",))
@@ -552,6 +566,10 @@ def _prepare_query_arrays(spec, b_src, b_src_coarse, b_filt_coarse,
     `_prepare_level_arrays`, whose program materializes the full DB."""
     return build_features_jax(spec, b_src, None, b_src_coarse,
                               b_filt_coarse, temporal_fine=b_temporal)
+
+
+_prepare_query_arrays = obs_device.instrument(
+    _prepare_query_arrays, "tpu.prepare_query_arrays", static_argnums=(0,))
 
 
 @functools.partial(jax.jit, static_argnames=("spec",))
@@ -566,6 +584,11 @@ def _prepare_query_arrays_batch(spec, b_src, b_src_coarse, b_filt_coarse,
     fn = lambda bs, bsc, bfc, bt: build_features_jax(
         spec, bs, None, bsc, bfc, temporal_fine=bt)
     return jax.vmap(fn)(b_src, b_src_coarse, b_filt_coarse, b_temporal)
+
+
+_prepare_query_arrays_batch = obs_device.instrument(
+    _prepare_query_arrays_batch, "tpu.prepare_query_arrays_batch",
+    static_argnums=(0,))
 
 
 def build_sharded_db(spec, a_src, a_filt, a_src_coarse, a_filt_coarse,
@@ -1452,6 +1475,15 @@ def wavefront_scan_core(db: TpuLevelDB, kappa_mult, anchor_fn,
 def _run_wavefront(db: TpuLevelDB, kappa_mult):
     return wavefront_scan_core(db, kappa_mult,
                                make_anchor_fn(db, defer_rescore=True))
+
+
+# Whole-level scan programs: shimmed like the preparation jits (the
+# TpuLevelDB pytree's static aux — strategy/match_mode/geometry — is part
+# of the shim's program key, so a key hit is exactly a jit cache hit).
+_run_exact = obs_device.instrument(_run_exact, "tpu.run_exact")
+_run_rowwise = obs_device.instrument(_run_rowwise, "tpu.run_rowwise")
+_run_batched = obs_device.instrument(_run_batched, "tpu.run_batched")
+_run_wavefront = obs_device.instrument(_run_wavefront, "tpu.run_wavefront")
 
 
 # Strategies with the uniform (db, kappa_mult) -> (bp, s, n_coh) signature;

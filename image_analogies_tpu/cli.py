@@ -236,13 +236,31 @@ def cmd_eval(args) -> int:
 
 def cmd_report(args) -> int:
     """Analyze a run-log JSONL (obs/report.py): per-level timing
-    breakdown, counter totals, retry/coherence summaries, manifest."""
+    breakdown, counter totals, retry/coherence summaries, compile/HBM
+    sections, manifest.  --json prints the analyze() dict per run."""
     from image_analogies_tpu.obs import report as obs_report
 
     if not os.path.exists(args.log):
         print(f"report: no such log: {args.log}", file=sys.stderr)
         return 2
-    print(obs_report.report(args.log))
+    if args.json:
+        print(obs_report.report_json(args.log))
+    else:
+        print(obs_report.report(args.log))
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """Convert a run-log JSONL into a Chrome/Perfetto trace.json
+    (obs/export.py) for chrome://tracing / ui.perfetto.dev."""
+    from image_analogies_tpu.obs import export as obs_export
+
+    if not os.path.exists(args.log):
+        print(f"trace: no such log: {args.log}", file=sys.stderr)
+        return 2
+    res = obs_export.export_trace(args.log, args.out)
+    print(f"{args.out}: {res['events']} events from "
+          f"{res['records']} records")
     return 0
 
 
@@ -301,9 +319,22 @@ def build_parser() -> argparse.ArgumentParser:
 
     rp = sub.add_parser("report",
                         help="analyze a run-log JSONL (--log-path output): "
-                             "per-level timing, counters, manifest")
+                             "per-level timing, counters, compile/HBM, "
+                             "manifest")
     rp.add_argument("log", help="path to the run-log JSONL")
+    rp.add_argument("--json", action="store_true",
+                    help="machine-readable output: the analyze() dict per "
+                         "run (levels, counters, compile, hbm)")
     rp.set_defaults(fn=cmd_report)
+
+    tr = sub.add_parser("trace",
+                        help="convert a run-log JSONL into a Chrome/"
+                             "Perfetto trace.json (host/device/compile "
+                             "tracks)")
+    tr.add_argument("log", help="path to the run-log JSONL")
+    tr.add_argument("-o", "--out", default="trace.json",
+                    help="output trace path (default: trace.json)")
+    tr.set_defaults(fn=cmd_trace)
     return ap
 
 

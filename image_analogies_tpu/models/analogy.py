@@ -18,6 +18,7 @@ import numpy as np
 from image_analogies_tpu.backends import get_backend
 from image_analogies_tpu.backends.base import LevelJob
 from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.obs import device as obs_device
 from image_analogies_tpu.obs import metrics as obs_metrics
 from image_analogies_tpu.obs import trace as obs_trace
 from image_analogies_tpu.ops import color
@@ -268,6 +269,10 @@ def _create_image_analogy(a, ap, b, params, backend, temporal_prev,
                                             f"level_{level:02d}.png"),
                                np.clip(np.asarray(bp, np.float32),
                                        0.0, 1.0))
+                # per-level HBM watermark (hbm.peak_bytes.d<N> peak
+                # gauges): one bool check when metrics are off, and a
+                # silent no-op on backends with no allocator stats (CPU)
+                obs_device.record_hbm(level, params.log_path)
 
     # ONE fetch call for the deferred device scalars AND the finest B'
     # plane: `jax.device_get` on the pair starts both transfers before

@@ -41,6 +41,7 @@ from image_analogies_tpu.models.analogy import (
     _prep_planes,
     create_image_analogy,
 )
+from image_analogies_tpu.obs import device as obs_device
 from image_analogies_tpu.obs import metrics as obs_metrics
 from image_analogies_tpu.obs import trace as obs_trace
 from image_analogies_tpu.ops import color
@@ -275,6 +276,7 @@ def _sharded_phase(a, ap, frames, params: AnalogyParams, mesh,
                 _level, retries=params.level_retries,
                 context={"level": level, "phase": tag},
                 log_path=params.log_path)
+            obs_device.record_hbm(level, params.log_path)
         if params.level_retries > 0:
             # §5.3: retried levels must rebuild from host-resident state
             bp, s = np.asarray(bp, np.float32), np.asarray(s, np.int32)

@@ -15,7 +15,18 @@ Three pieces, all process-local and dependency-free (no jax import):
   ``run_id`` and a monotonically increasing ``seq``.
 - ``obs.report`` — the ``ia report`` analyzer: reads a run-log JSONL
   and prints per-level timing (device vs host), counter totals
-  (devcache hit rate, retries, kappa pick ratio), and the run manifest.
+  (devcache hit rate, retries, kappa pick ratio), compile/HBM sections,
+  and the run manifest; ``--json`` for the machine-readable dict.
+
+Device-side layer (ISSUE 2 tentpole), imported lazily because it talks
+to jax:
+
+- ``obs.device`` — compile-aware shims around the jit/pjit entry points
+  (``compile.count`` / ``compile.ms`` / ``compile.cache_hits`` /
+  ``xla.flops`` / ``xla.bytes`` counters, per-program compile records)
+  and per-level HBM watermarks (``hbm.peak_bytes.d<N>`` peak gauges).
+- ``obs.export`` — the ``ia trace`` converter: run-log JSONL to
+  Chrome/Perfetto trace.json (host / device / compile tracks).
 """
 
 from image_analogies_tpu.obs import metrics, trace  # noqa: F401

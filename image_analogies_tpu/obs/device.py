@@ -1,0 +1,220 @@
+"""Device/compiler observability (ISSUE 2 tentpole).
+
+:func:`instrument` wraps one jit/pjit entry point in a
+:class:`JitShim` — a compile-aware cache keyed by the program signature
+(pytree structure + per-leaf shape/dtype, i.e. the same information
+jit's own dispatch cache keys on, including the static aux data of
+registered pytrees like ``TpuLevelDB``).  On the first call of a key the
+shim lowers and compiles ahead-of-time (``fn.lower(...).compile()``),
+records the compile wall-time and — where the compiled artifact exposes
+``cost_analysis()`` — the program's estimated FLOPs and bytes-accessed,
+then caches the executable.  Subsequent calls of the same key count as
+cache hits and dispatch the cached executable directly.  Counters flow
+into the PR-1 metrics registry: ``compile.count``, ``compile.ms``,
+``compile.cache_hits``, ``xla.flops``, ``xla.bytes`` (the xla.* totals
+accumulate per EXECUTION, so they estimate work actually dispatched).
+One ``{"event": "compile", ...}`` record is emitted per program, stamped
+with the enclosing span's level/phase/frame so `ia report` can derive
+achieved-TFLOPs per level.
+
+:func:`record_hbm` samples ``device.memory_stats()`` into per-device
+peak gauges (``hbm.peak_bytes.d<N>``) — backends that return None (CPU)
+are tolerated silently.
+
+PR-1 invariant: with no active run the shim's ``__call__`` is a single
+module-bool check and a positional passthrough — no clock read, no
+allocation in obs/ frames (covered by the zero-alloc disabled-path
+test) — and ``record_hbm`` returns after the same bool check.  jax is
+imported lazily and only on the active path; importing this module does
+not force backend init.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from image_analogies_tpu.obs import metrics as _metrics
+from image_analogies_tpu.obs import trace as _trace
+from image_analogies_tpu.utils import logging as _logging
+
+
+def _leaf_sig(leaf: Any) -> Any:
+    """Hashable signature of one pytree leaf: (shape, dtype) for array
+    likes, the value itself for hashable scalars/statics, repr otherwise."""
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is not None and dtype is not None:
+        return ("arr", tuple(shape), str(dtype))
+    try:
+        hash(leaf)
+    except TypeError:
+        return ("repr", repr(leaf))
+    return leaf
+
+
+def program_key(args: tuple, kwargs: Optional[dict]) -> tuple:
+    """The (tree structure, leaf shapes/dtypes/statics) program key — the
+    same facts jit's dispatch cache keys on, so a shim cache hit is a jit
+    cache hit and a shim miss is a recompile."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs or {}))
+    return (treedef, tuple(_leaf_sig(leaf) for leaf in leaves))
+
+
+class JitShim:
+    """Compile-aware wrapper around one jitted callable.
+
+    ``static_argnums`` names the positions jit treats as static: the AOT
+    executable is called with them stripped (a ``Compiled`` object takes
+    only the dynamic args).  Any failure to lower/compile/dispatch falls
+    back to the raw jitted callable — observability must never change
+    what runs.
+    """
+
+    # __weakref__ so the shim can itself be re-wrapped by jax.jit (jit
+    # keeps a weakref to its callable)
+    __slots__ = ("fn", "name", "static_argnums", "_programs", "_lock",
+                 "__weakref__")
+
+    def __init__(self, fn: Any, name: str,
+                 static_argnums: Tuple[int, ...] = ()):
+        self.fn = fn
+        self.name = name
+        self.static_argnums = frozenset(static_argnums)
+        # program key -> (compiled_or_None, cost_or_None); None compiled
+        # means "AOT unusable for this key, call the raw fn"
+        self._programs: Dict[tuple, Tuple[Any, Optional[Dict[str, float]]]] \
+            = {}
+        self._lock = threading.Lock()
+
+    def __getattr__(self, item: str) -> Any:
+        # delegate .lower / .clear_cache / _cache_size etc. to the jit fn
+        return getattr(self.fn, item)
+
+    def __call__(self, *args, **kwargs):
+        if not _metrics._ACTIVE:
+            if kwargs:
+                return self.fn(*args, **kwargs)
+            return self.fn(*args)
+        return self._observed_call(args, kwargs)
+
+    # --- active path -----------------------------------------------------
+
+    def _dynamic_args(self, args: tuple) -> tuple:
+        if not self.static_argnums:
+            return args
+        return tuple(a for i, a in enumerate(args)
+                     if i not in self.static_argnums)
+
+    def _observed_call(self, args: tuple, kwargs: dict):
+        try:
+            key = program_key(args, kwargs)
+        except Exception:
+            return self.fn(*args, **kwargs)
+        with self._lock:
+            entry = self._programs.get(key)
+        if entry is None:
+            entry = self._compile(key, args, kwargs)
+        else:
+            _metrics.inc("compile.cache_hits")
+        compiled, cost = entry
+        if cost is not None:
+            if cost["flops"]:
+                _metrics.inc("xla.flops", cost["flops"])
+            if cost["bytes"]:
+                _metrics.inc("xla.bytes", cost["bytes"])
+        if compiled is not None and not kwargs:
+            try:
+                return compiled(*self._dynamic_args(args))
+            except Exception:
+                # aval/pytree drift (e.g. weak_type) — retire the AOT
+                # executable for this key, keep the cost accounting
+                with self._lock:
+                    self._programs[key] = (None, cost)
+        return self.fn(*args, **kwargs)
+
+    def _compile(self, key: tuple, args: tuple, kwargs: dict):
+        compiled = cost = None
+        t0 = time.perf_counter()
+        try:
+            compiled = self.fn.lower(*args, **kwargs).compile()
+        except Exception:
+            compiled = None
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        if compiled is not None:
+            try:
+                ca = compiled.cost_analysis()
+                if isinstance(ca, (list, tuple)):
+                    ca = ca[0] if ca else {}
+                cost = {"flops": max(float(ca.get("flops", 0.0)), 0.0),
+                        "bytes": max(float(ca.get("bytes accessed", 0.0)),
+                                     0.0)}
+            except Exception:
+                cost = None
+        _metrics.inc("compile.count")
+        _metrics.inc("compile.ms", dt_ms)
+        rec: Dict[str, Any] = {"event": "compile", "name": self.name,
+                               "ms": round(dt_ms, 3),
+                               "ok": compiled is not None}
+        if cost is not None:
+            rec["flops"] = cost["flops"]
+            rec["bytes"] = cost["bytes"]
+        attrs = _trace.current_span_attrs()
+        if attrs:
+            for k in ("level", "phase", "frame"):
+                if k in attrs:
+                    rec[k] = attrs[k]
+        ctx = _trace._CURRENT
+        _logging.emit(rec, ctx.log_path if ctx is not None else None)
+        entry = (compiled, cost)
+        with self._lock:
+            self._programs[key] = entry
+        return entry
+
+
+def instrument(fn: Any, name: str,
+               static_argnums: Tuple[int, ...] = ()) -> JitShim:
+    """Wrap a jit/pjit entry point in a compile-aware shim."""
+    return JitShim(fn, name, static_argnums)
+
+
+def record_hbm(level: Optional[int] = None,
+               log_path: Optional[str] = None) -> None:
+    """Fold per-device HBM watermarks into ``hbm.peak_bytes.d<N>`` peak
+    gauges and (when a log path is set) one ``hbm`` record.  Only peeks
+    at an already-initialized jax runtime — never forces backend init —
+    and tolerates backends whose ``memory_stats()`` is None (CPU)."""
+    if not _metrics._ACTIVE:
+        return
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return
+    try:
+        bridge = sys.modules.get("jax._src.xla_bridge")
+        if bridge is None or not getattr(bridge, "_backends", None):
+            return
+        devs = jax.local_devices()
+    except Exception:
+        return
+    peaks: Dict[str, int] = {}
+    for d in devs:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue  # CPU and friends: no allocator stats — fine
+        peak = stats.get("peak_bytes_in_use", stats.get("bytes_in_use"))
+        if peak is None:
+            continue
+        _metrics.max_gauge(f"hbm.peak_bytes.d{d.id}", float(peak))
+        peaks[f"d{d.id}"] = int(peak)
+    if peaks and log_path:
+        rec: Dict[str, Any] = {"event": "hbm", "peaks": peaks}
+        if level is not None:
+            rec["level"] = level
+        _logging.emit(rec, log_path)

@@ -1,0 +1,122 @@
+"""`ia trace` — run-log JSONL to Chrome/Perfetto trace.json.
+
+Maps the run log's record kinds onto the Chrome Trace Event Format so a
+north-star run can be opened in ``chrome://tracing`` / Perfetto:
+
+- ``span`` records become ``ph=X`` complete events on the HOST track.
+  Spans are emitted at exit carrying ``wall_ms`` and an exit ``ts``, so
+  the event start is ``ts - wall_ms/1e3``; nesting falls out of the
+  interval containment (a child span closes before its parent).
+- level stat records (``ms`` / ``enqueue_ms``) become ``ph=X`` events on
+  the DEVICE track — real device compute under level_sync, enqueue cost
+  otherwise (the record says which by field name).
+- ``compile`` records (obs.device) become ``ph=X`` events on the
+  COMPILE track, args carrying the XLA cost estimate.
+- everything else (manifest, run_end, retries, run_join, hbm, coherence
+  summaries) becomes a ``ph=i`` instant on the host track.
+
+One Chrome ``pid`` per run_id; tids 1/2/3 = host/device/compile, named
+via ``ph=M`` metadata events (which carry ``ts``/``dur`` 0 so every
+event in the file uniformly has ph/ts/pid/tid and dur-or-instant).
+Timestamps are microseconds relative to the earliest event start.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+from image_analogies_tpu.obs.report import _is_level_stat, load_records
+
+HOST_TID = 1
+DEVICE_TID = 2
+COMPILE_TID = 3
+
+_TID_NAMES = {HOST_TID: "host", DEVICE_TID: "device", COMPILE_TID: "compile"}
+
+# bookkeeping fields that don't belong in an event's args payload
+_DROP_ARGS = ("ts",)
+
+
+def _classify(rec: Dict[str, Any]) -> Tuple[str, int, str, Optional[float]]:
+    """(ph, tid, name, dur_ms) of one record."""
+    ev = rec.get("event")
+    if ev == "span":
+        return "X", HOST_TID, str(rec.get("name", "span")), \
+            float(rec.get("wall_ms", 0.0))
+    if ev == "compile":
+        return "X", COMPILE_TID, f"compile {rec.get('name', '?')}", \
+            float(rec.get("ms", 0.0))
+    if ev is None and _is_level_stat(rec):
+        dur = rec.get("ms", rec.get("enqueue_ms", 0.0))
+        name = f"L{rec['level']}"
+        if "frame" in rec:
+            name += f" f{rec['frame']}"
+        name += " device" if "ms" in rec else " enqueue"
+        return "X", DEVICE_TID, name, float(dur)
+    return "i", HOST_TID, str(ev or "record"), None
+
+
+def to_chrome_trace(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Convert run-log records into a Chrome trace dict."""
+    pids: Dict[Optional[str], int] = {}
+
+    def pid_of(rec: Dict[str, Any]) -> int:
+        rid = rec.get("run_id")
+        if rid not in pids:
+            pids[rid] = len(pids) + 1
+        return pids[rid]
+
+    # pass 1: classify + find the earliest start so ts stays small
+    rows = []
+    base = None
+    for rec in records:
+        ts = rec.get("ts")
+        if not isinstance(ts, (int, float)):
+            continue
+        ph, tid, name, dur_ms = _classify(rec)
+        start_s = float(ts) - (dur_ms or 0.0) / 1e3 if ph == "X" \
+            else float(ts)
+        if base is None or start_s < base:
+            base = start_s
+        rows.append((rec, ph, tid, name, dur_ms, start_s))
+    base = base or 0.0
+
+    events: List[Dict[str, Any]] = []
+    for rec, ph, tid, name, dur_ms, start_s in rows:
+        args = {k: v for k, v in rec.items() if k not in _DROP_ARGS}
+        event: Dict[str, Any] = {
+            "ph": ph,
+            "ts": round((start_s - base) * 1e6, 1),  # µs
+            "pid": pid_of(rec),
+            "tid": tid,
+            "name": name,
+            "args": args,
+        }
+        if ph == "X":
+            event["dur"] = round((dur_ms or 0.0) * 1e3, 1)  # µs
+        else:
+            event["s"] = "t"  # thread-scoped instant
+        events.append(event)
+
+    events.sort(key=lambda e: (e["pid"], e["ts"]))
+
+    meta: List[Dict[str, Any]] = []
+    for rid, pid in pids.items():
+        meta.append({"ph": "M", "name": "process_name", "ts": 0, "dur": 0,
+                     "pid": pid, "tid": 0,
+                     "args": {"name": f"run {rid or '(unstamped)'}"}})
+        for tid, tname in _TID_NAMES.items():
+            meta.append({"ph": "M", "name": "thread_name", "ts": 0,
+                         "dur": 0, "pid": pid, "tid": tid,
+                         "args": {"name": tname}})
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def export_trace(log_path: str, out_path: str) -> Dict[str, int]:
+    """Read a run-log JSONL, write Chrome trace JSON, return counts."""
+    records = load_records(log_path)
+    trace = to_chrome_trace(records)
+    with open(out_path, "w") as f:
+        json.dump(trace, f)
+    return {"records": len(records), "events": len(trace["traceEvents"])}

@@ -76,6 +76,13 @@ class MetricsRegistry:
         with self._lock:
             self._gauges[name] = self._gauges.get(name, 0) + value
 
+    def max_gauge(self, name: str, value: float) -> None:
+        """Peak watermark: keep the maximum ever observed (HBM peaks)."""
+        with self._lock:
+            cur = self._gauges.get(name)
+            if cur is None or value > cur:
+                self._gauges[name] = value
+
     def observe(self, name: str, value: float) -> None:
         with self._lock:
             h = self._histograms.get(name)
@@ -141,6 +148,11 @@ def set_gauge(name: str, value: float) -> None:
 def add_gauge(name: str, value: float) -> None:
     if _ACTIVE:
         _REGISTRY.add_gauge(name, value)
+
+
+def max_gauge(name: str, value: float) -> None:
+    if _ACTIVE:
+        _REGISTRY.max_gauge(name, value)
 
 
 def observe(name: str, value: float) -> None:

@@ -136,6 +136,40 @@ def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     hits = counters.get("devcache.hits", 0)
     misses = counters.get("devcache.misses", 0)
 
+    # --- compile / XLA cost (obs.device shims) ----------------------------
+    compiles = [r for r in records if r.get("event") == "compile"]
+    compile_info: Optional[Dict[str, Any]] = None
+    if compiles or counters.get("compile.count"):
+        level_flops: Dict[int, float] = {}
+        for cr in compiles:
+            if "level" in cr and cr.get("flops"):
+                lv = int(cr["level"])
+                level_flops[lv] = level_flops.get(lv, 0) + float(cr["flops"])
+        compile_info = {
+            "count": int(counters.get("compile.count", len(compiles))),
+            "cache_hits": int(counters.get("compile.cache_hits", 0)),
+            "total_ms": float(counters.get(
+                "compile.ms",
+                sum(float(c.get("ms", 0.0)) for c in compiles))),
+            "flops": float(counters.get("xla.flops", 0.0)),
+            "bytes": float(counters.get("xla.bytes", 0.0)),
+            "programs": [{k: c[k] for k in ("name", "ms", "flops", "bytes",
+                                            "level", "phase", "ok")
+                          if k in c} for c in compiles],
+            "level_flops": level_flops,
+        }
+
+    # --- per-device HBM peaks (run_end gauges + streamed hbm records) -----
+    gauges: Dict[str, float] = {}
+    if run_end:
+        gauges.update((run_end.get("metrics") or {}).get("gauges", {}))
+    hbm: Dict[str, float] = {
+        name.split("hbm.peak_bytes.", 1)[1]: float(v)
+        for name, v in gauges.items() if name.startswith("hbm.peak_bytes.")}
+    for hr in (r for r in records if r.get("event") == "hbm"):
+        for dev, v in (hr.get("peaks") or {}).items():
+            hbm[dev] = max(hbm.get(dev, 0.0), float(v))
+
     return {
         "manifest": manifest,
         "run_end": run_end,
@@ -147,6 +181,8 @@ def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                              if total_known_px else None),
         "devcache_hit_rate": (hits / (hits + misses)
                               if (hits + misses) else None),
+        "compile": compile_info,
+        "hbm": hbm or None,
         "spans": spans,
         "n_records": len(records),
     }
@@ -202,10 +238,39 @@ def render(an: Dict[str, Any], run_id: Optional[str] = None) -> str:
         w(f"    fetched       {_fmt_bytes(c['fetch.bytes'])}")
     shown = {"devcache.hits", "devcache.misses", "devcache.upload_bytes",
              "level_retry", "mesh.level_steps", "mesh.psum_gather_bytes",
-             "fetch.bytes", "kappa.coherence_px", "kappa.total_px"}
+             "fetch.bytes", "kappa.coherence_px", "kappa.total_px",
+             "compile.count", "compile.ms", "compile.cache_hits",
+             "xla.flops", "xla.bytes"}
     rest = {k: v for k, v in c.items() if k not in shown and v}
     for k in sorted(rest):
         w(f"    {k:<13} {rest[k]:g}")
+
+    comp = an.get("compile")
+    if comp:
+        w("  compile:")
+        w(f"    programs      {comp['count']} compiled / "
+          f"{comp['cache_hits']} cache hits, total {comp['total_ms']:.1f} ms")
+        if comp["flops"] or comp["bytes"]:
+            w(f"    xla cost      {comp['flops']:.4g} flops executed, "
+              f"{_fmt_bytes(comp['bytes'])} accessed")
+        # achieved TFLOPs where BOTH a cost estimate and a device time
+        # exist for the level (compile events carry one execution's flops;
+        # the solo path runs each level program once per frame)
+        dev_ms = {r["level"]: r["device_ms"] for r in an["levels"]
+                  if r.get("device_ms")}
+        for lv in sorted(comp["level_flops"], reverse=True):
+            ms = dev_ms.get(lv)
+            if ms:
+                tf = comp["level_flops"][lv] / (ms * 1e9)
+                w(f"    L{lv} achieved   ~{tf:.4g} TFLOP/s "
+                  f"({comp['level_flops'][lv]:.3g} flops est / "
+                  f"{ms:.1f} ms device)")
+
+    hbm = an.get("hbm")
+    if hbm:
+        w("  hbm peak:")
+        for dev in sorted(hbm):
+            w(f"    {dev:<13} {_fmt_bytes(hbm[dev])}")
 
     other = [sp for sp in an["spans"] if sp.get("name") != "level"]
     if other:
@@ -220,15 +285,36 @@ def render(an: Dict[str, Any], run_id: Optional[str] = None) -> str:
     return "\n".join(out)
 
 
+def _by_run(records: List[Dict[str, Any]]) \
+        -> Dict[Optional[str], List[Dict[str, Any]]]:
+    by_run: Dict[Optional[str], List[Dict[str, Any]]] = {}
+    for rec in records:
+        by_run.setdefault(rec.get("run_id"), []).append(rec)
+    return by_run
+
+
 def report(path: str) -> str:
     """Analyze a run-log JSONL; one section per run_id found in it."""
     records = load_records(path)
     if not records:
         return f"{path}: no records"
-    by_run: Dict[Optional[str], List[Dict[str, Any]]] = {}
-    for rec in records:
-        by_run.setdefault(rec.get("run_id"), []).append(rec)
     sections = []
+    by_run = _by_run(records)
     for run_id in by_run:  # insertion order == file order
         sections.append(render(analyze(by_run[run_id]), run_id))
     return "\n\n".join(sections)
+
+
+def report_json(path: str) -> str:
+    """Machine-readable `ia report --json`: the analyze() dict per run
+    (manifest, levels, counters, compile/HBM sections), so bench/CI can
+    diff runs without scraping the text renderer."""
+    records = load_records(path)
+    runs = []
+    by_run = _by_run(records)
+    for run_id in by_run:
+        an = analyze(by_run[run_id])
+        an["run_id"] = run_id
+        runs.append(an)
+    return json.dumps({"path": path, "runs": runs}, indent=2,
+                      sort_keys=True, default=str)

@@ -41,7 +41,7 @@ class RunContext:
     """State of one observed run (one engine invocation or one clip)."""
 
     __slots__ = ("run_id", "log_path", "registry", "seq", "_seq_lock",
-                 "depth")
+                 "depth", "owner_thread", "_joined_threads")
 
     def __init__(self, run_id: str, log_path: Optional[str],
                  registry: _metrics.MetricsRegistry):
@@ -51,6 +51,8 @@ class RunContext:
         self.seq = 0
         self._seq_lock = threading.Lock()
         self.depth = 0  # run_scope reentrancy count
+        self.owner_thread = threading.get_ident()
+        self._joined_threads: set = set()  # foreign threads already warned
 
     def next_seq(self) -> int:
         with self._seq_lock:
@@ -168,7 +170,16 @@ def run_scope(params: Any = None, log_path: Optional[str] = None,
     ctx = _CURRENT
     if ctx is not None:
         # Reentrant join: video's per-frame engine calls ride the clip's
-        # run — one run_id, one registry, one manifest.
+        # run — one run_id, one registry, one manifest.  _CURRENT is a
+        # plain module global, so a SECOND THREAD entering run_scope also
+        # lands here and silently shares the first thread's run_id: make
+        # the share visible with one run_join warning per foreign thread.
+        tid = threading.get_ident()
+        if tid != ctx.owner_thread and tid not in ctx._joined_threads:
+            ctx._joined_threads.add(tid)
+            _logging.emit({"event": "run_join", "severity": "warning",
+                           "owner_thread": ctx.owner_thread,
+                           "joined_thread": tid}, ctx.log_path)
         ctx.depth += 1
         try:
             yield ctx
@@ -183,6 +194,10 @@ def run_scope(params: Any = None, log_path: Optional[str] = None,
                      _metrics.MetricsRegistry())
     _CURRENT = ctx
     _metrics._install(ctx.registry)
+    # One append handle per log path for the whole run (the hot level
+    # loop streams a record per level/frame); flushed + closed with the
+    # run so `run_end` is durable the moment the scope exits.
+    _logging.begin_handle_cache()
     try:
         _logging.emit(build_manifest(params, manifest_extra), log_path)
         yield ctx
@@ -191,6 +206,7 @@ def run_scope(params: Any = None, log_path: Optional[str] = None,
         # carries the run_id like every other record of the run.
         snap = ctx.registry.snapshot()
         _logging.emit({"event": "run_end", "metrics": snap}, log_path)
+        _logging.end_handle_cache()
         _metrics._uninstall(ctx.registry)
         _CURRENT = None
 
@@ -254,3 +270,16 @@ def span(name: str, **attrs: Any):
     if ctx is None:
         return _NOOP
     return _Span(name, attrs, ctx)
+
+
+def current_span_attrs() -> Optional[Dict[str, Any]]:
+    """Merged attrs of this thread's open spans (innermost wins) — lets
+    out-of-band records (obs.device compile events) attribute themselves
+    to the enclosing level/phase.  None when no span is open."""
+    stack = getattr(_SPANS, "stack", None)
+    if not stack:
+        return None
+    merged: Dict[str, Any] = {}
+    for sp in stack:
+        merged.update(sp.attrs)
+    return merged

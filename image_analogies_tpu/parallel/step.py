@@ -45,6 +45,7 @@ from image_analogies_tpu.backends.tpu import (
     batched_scan_core,
     wavefront_scan_core,
 )
+from image_analogies_tpu.obs import device as obs_device
 from image_analogies_tpu.obs import metrics as obs_metrics
 from image_analogies_tpu.ops.pallas_match import bf16_split3
 from image_analogies_tpu.parallel.mesh import shard_map
@@ -181,7 +182,9 @@ def _cached_multichip_step(mesh: Mesh, strategy: str, force_xla: bool,
         out_specs=out,
         check_rep=False,
     )
-    return jax.jit(stepped)
+    # lru-cached, so ONE shim per (mesh, strategy, ...) — its program key
+    # then separates shapes, mirroring jit's own dispatch cache
+    return obs_device.instrument(jax.jit(stepped), "mesh.multichip_step")
 
 
 def multichip_level_step(

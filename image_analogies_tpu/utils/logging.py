@@ -10,8 +10,9 @@ from __future__ import annotations
 import json
 import logging
 import os
+import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, TextIO
 
 logger = logging.getLogger("image_analogies_tpu")
 
@@ -26,6 +27,55 @@ def set_record_stamper(fn) -> None:
     _STAMPER = fn
 
 
+# Per-path append-handle cache, active only between begin_handle_cache /
+# end_handle_cache (obs.trace.run_scope brackets a run with them): the
+# hot level loop streams one JSONL record per level/frame, and one
+# open+close per record was pure syscall overhead.  Outside a run the
+# historic open-append-close per record is preserved (no handle held
+# across unrelated emit() calls).
+_HANDLE_LOCK = threading.Lock()
+_HANDLES: Dict[str, TextIO] = {}
+_CACHING = 0  # nesting count of active cache scopes
+
+
+def begin_handle_cache() -> None:
+    global _CACHING
+    with _HANDLE_LOCK:
+        _CACHING += 1
+
+
+def end_handle_cache() -> None:
+    """Flush + close every cached handle when the outermost scope ends."""
+    global _CACHING
+    with _HANDLE_LOCK:
+        _CACHING = max(_CACHING - 1, 0)
+        if _CACHING:
+            return
+        for f in _HANDLES.values():
+            try:
+                f.flush()
+                f.close()
+            except OSError:
+                pass
+        _HANDLES.clear()
+
+
+def _write_line(path: str, line: str) -> None:
+    if _CACHING:
+        with _HANDLE_LOCK:
+            if _CACHING:  # re-check under the lock
+                f = _HANDLES.get(path)
+                if f is None:
+                    os.makedirs(os.path.dirname(os.path.abspath(path)),
+                                exist_ok=True)
+                    f = _HANDLES[path] = open(path, "a")
+                f.write(line + "\n")
+                return
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "a") as f:
+        f.write(line + "\n")
+
+
 def emit(record: Dict[str, Any], path: Optional[str] = None) -> None:
     record = dict(record)
     record.setdefault("ts", time.time())
@@ -33,6 +83,4 @@ def emit(record: Dict[str, Any], path: Optional[str] = None) -> None:
         _STAMPER(record)
     logger.info("%s", json.dumps(record, sort_keys=True))
     if path:
-        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        with open(path, "a") as f:
-            f.write(json.dumps(record, sort_keys=True) + "\n")
+        _write_line(path, json.dumps(record, sort_keys=True))

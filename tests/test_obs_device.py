@@ -1,0 +1,255 @@
+"""Device-side observability (obs/device.py + obs/export.py): compile
+shim accounting (compile counts, cache hits, XLA cost), static-arg AOT
+dispatch and its fallback, the disabled path's zero-record /
+zero-allocation guarantee, HBM sampling on statless backends, and the
+Chrome-trace export schema on both synthetic and real engine logs."""
+
+import json
+import tracemalloc
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.models.analogy import create_image_analogy
+from image_analogies_tpu.obs import device as obs_device
+from image_analogies_tpu.obs import export as obs_export
+from image_analogies_tpu.obs import metrics as obs_metrics
+from image_analogies_tpu.obs import trace as obs_trace
+
+from tests.conftest import make_pair
+
+
+# ------------------------------------------------------------- JitShim
+
+def test_shim_disabled_passthrough_zero_alloc():
+    shim = obs_device.instrument(
+        jax.jit(lambda x, y: jnp.dot(x, y)), "test.dot")
+    x = jnp.ones((8, 8), jnp.float32)
+    ref = np.asarray(shim(x, x))  # warm the jit cache
+
+    emitted = []
+    from image_analogies_tpu.utils import logging as ialog
+    orig = ialog._STAMPER
+    ialog.set_record_stamper(lambda rec: emitted.append(dict(rec)))
+    try:
+        tracemalloc.start()
+        try:
+            for _ in range(50):
+                # results are NOT retained: the only allocations below
+                # the passthrough frame are the (freed) output arrays
+                shim(x, x)
+            snap = tracemalloc.take_snapshot()
+        finally:
+            tracemalloc.stop()
+    finally:
+        ialog.set_record_stamper(orig)
+    assert np.array_equal(np.asarray(shim(x, x)), ref)
+    assert emitted == []  # no compile records with metrics off
+    obs_allocs = [t for t in snap.traces
+                  if any("image_analogies_tpu/obs/" in fr.filename
+                         for fr in t.traceback)]
+    assert obs_allocs == []
+
+
+def test_shim_compile_then_cache_hits(tmp_path):
+    log = str(tmp_path / "run.jsonl")
+    shim = obs_device.instrument(
+        jax.jit(lambda x, y: jnp.dot(x, y)), "test.dot")
+    x = jnp.ones((8, 8), jnp.float32)
+    p = AnalogyParams(metrics=True, log_path=log)
+    with obs_trace.run_scope(p) as ctx:
+        with obs_trace.span("level", level=3):
+            r1 = shim(x, x)
+        r2 = shim(x, x)  # same program key -> cache hit
+        y = jnp.ones((16, 16), jnp.float32)
+        shim(y, y)  # new shapes -> second compile
+        reg = ctx.registry
+        assert reg.counter("compile.count") == 2
+        assert reg.counter("compile.cache_hits") == 1
+        assert reg.counter("compile.ms") > 0
+        assert reg.counter("xla.flops") > 0  # 3 dot executions
+        assert reg.counter("xla.bytes") > 0
+    assert np.array_equal(np.asarray(r1), np.asarray(r2))
+    recs = [json.loads(line) for line in open(log)]
+    comps = [r for r in recs if r.get("event") == "compile"]
+    assert len(comps) == 2
+    assert all(c["name"] == "test.dot" and c["ok"] for c in comps)
+    assert all(c["flops"] > 0 and c["bytes"] > 0 for c in comps)
+    assert comps[0]["level"] == 3  # span attr attribution
+    assert "level" not in comps[1]
+
+
+def test_shim_static_args_aot_call():
+    import functools
+
+    @functools.partial(jax.jit, static_argnames=("k", "mode"))
+    def scale(x, k, mode="mul"):
+        return x * k if mode == "mul" else x + k
+
+    shim = obs_device.instrument(scale, "test.scale", static_argnums=(1, 2))
+    x = jnp.arange(4, dtype=jnp.float32)
+    with obs_trace.run_scope(AnalogyParams(metrics=True)) as ctx:
+        a = shim(x, 3, "mul")  # compile
+        b = shim(x, 3, "mul")  # AOT call with statics stripped
+        c = shim(x, 2, "add")  # different statics -> new program
+        assert ctx.registry.counter("compile.count") == 2
+        assert ctx.registry.counter("compile.cache_hits") == 1
+    assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert np.array_equal(np.asarray(c), np.arange(4) + 2)
+
+
+def test_shim_wrong_statics_falls_back():
+    """A broken static_argnums spec must never change results: the AOT
+    call raises, the shim retires the executable and dispatches the raw
+    jitted fn instead."""
+    shim = obs_device.instrument(
+        jax.jit(lambda x, y: x + y), "test.bad", static_argnums=(1,))
+    x = jnp.ones((4,), jnp.float32)
+    with obs_trace.run_scope(AnalogyParams(metrics=True)) as ctx:
+        a = shim(x, x)  # compile (lower sees both args; AOT expects both)
+        b = shim(x, x)  # AOT call drops arg 1 -> TypeError -> fallback
+        assert ctx.registry.counter("compile.count") == 1
+        assert ctx.registry.counter("compile.cache_hits") == 1
+    assert np.array_equal(np.asarray(a), np.full(4, 2.0))
+    assert np.array_equal(np.asarray(b), np.full(4, 2.0))
+
+
+def test_shim_delegates_jit_attrs():
+    fn = jax.jit(lambda x: x + 1)
+    shim = obs_device.instrument(fn, "test.attr")
+    # attribute access falls through to the wrapped jit fn
+    assert shim._cache_size() == fn._cache_size()
+    lowered = shim.lower(jnp.ones((2,), jnp.float32))
+    assert hasattr(lowered, "compile")
+    # jax.jit keeps a weakref to its callable: the shim must be
+    # re-wrappable (the graft entry jits the instrumented runner)
+    rejit = jax.jit(lambda x: shim(x) * 2)
+    assert np.array_equal(np.asarray(rejit(jnp.ones((2,), jnp.float32))),
+                          np.full(2, 4.0))
+
+
+def test_record_hbm_tolerates_statless_backend():
+    # XLA:CPU returns None from memory_stats(): no gauges, no records,
+    # no exception — and a plain no-op with metrics off
+    obs_device.record_hbm(level=0)
+    with obs_trace.run_scope(AnalogyParams(metrics=True)) as ctx:
+        jax.devices()  # ensure the backend exists for the peek
+        obs_device.record_hbm(level=0)
+        gauges = ctx.registry.snapshot()["gauges"]
+    assert not any(k.startswith("hbm.") for k in gauges)
+
+
+# ------------------------------------------------------- chrome export
+
+def _write_synthetic(path):
+    recs = [
+        {"event": "run_manifest", "backend": "tpu", "run_id": "r1",
+         "seq": 0, "ts": 100.0},
+        {"event": "compile", "name": "tpu.run_wavefront", "ms": 50.0,
+         "flops": 1e6, "bytes": 2e6, "ok": True, "level": 1,
+         "run_id": "r1", "seq": 1, "ts": 100.06},
+        # spans are written at EXIT: outer [100.0, 100.5], inner
+        # [100.2, 100.4] — the inner record appears FIRST in the file
+        {"event": "span", "name": "level", "level": 1, "wall_ms": 200.0,
+         "depth": 1, "parent": "phase", "run_id": "r1", "seq": 2,
+         "ts": 100.4},
+        {"level": 1, "db_rows": 64, "pixels": 100, "ms": 120.0,
+         "run_id": "r1", "seq": 3, "ts": 100.39},
+        {"event": "span", "name": "phase", "wall_ms": 500.0, "depth": 0,
+         "run_id": "r1", "seq": 4, "ts": 100.5},
+        {"event": "run_end", "metrics": {"counters": {}, "gauges": {},
+                                         "histograms": {}},
+         "run_id": "r1", "seq": 5, "ts": 100.5},
+    ]
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r, sort_keys=True) + "\n")
+
+
+def _assert_schema(events):
+    assert events, "empty trace"
+    for e in events:
+        assert e["ph"] in ("X", "i", "M")
+        assert isinstance(e["ts"], (int, float))
+        assert "pid" in e and "tid" in e
+        assert "dur" in e or e["ph"] == "i"
+
+
+def test_trace_export_golden(tmp_path):
+    log = str(tmp_path / "synth.jsonl")
+    _write_synthetic(log)
+    trace = obs_export.to_chrome_trace(obs_export.load_records(log))
+    events = trace["traceEvents"]
+    _assert_schema(events)
+
+    spans = {e["name"]: e for e in events
+             if e["ph"] == "X" and e["tid"] == obs_export.HOST_TID}
+    outer, inner = spans["phase"], spans["level"]
+    # nesting consistent with span depth: the depth-1 interval sits
+    # inside the depth-0 interval despite appearing first in the file
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"]
+    assert outer["args"]["depth"] == 0 and inner["args"]["depth"] == 1
+    assert inner["dur"] == pytest.approx(200.0 * 1e3)  # µs
+
+    dev = [e for e in events if e["tid"] == obs_export.DEVICE_TID
+           and e["ph"] == "X"]
+    assert len(dev) == 1 and dev[0]["name"] == "L1 device"
+    assert dev[0]["dur"] == pytest.approx(120.0 * 1e3)
+
+    comp = [e for e in events if e["tid"] == obs_export.COMPILE_TID
+            and e["ph"] == "X"]
+    assert len(comp) == 1
+    assert comp[0]["args"]["flops"] == 1e6
+
+    insts = [e for e in events if e["ph"] == "i"]
+    assert {e["name"] for e in insts} == {"run_manifest", "run_end"}
+    # one pid for the single run, shared by every non-metadata event
+    assert len({e["pid"] for e in events if e["ph"] != "M"}) == 1
+
+
+# --------------------------------------------- acceptance: engine log
+
+@pytest.fixture(scope="module")
+def engine_log(tmp_path_factory):
+    """Two same-shape engine runs inside one metrics scope on the
+    jax-backed matcher (XLA:CPU compiles the same programs)."""
+    log = str(tmp_path_factory.mktemp("obsdev") / "run.jsonl")
+    a, ap, b = make_pair(20, 22, seed=3)
+    params = AnalogyParams(levels=2, backend="tpu", metrics=True,
+                           log_path=log)
+    with obs_trace.run_scope(params):
+        create_image_analogy(a, ap, b, params)
+        create_image_analogy(a, ap, b, params)
+    return log
+
+
+def test_engine_report_compile_section(engine_log):
+    from image_analogies_tpu.obs import report as obs_report
+
+    recs = obs_report.load_records(engine_log)
+    an = obs_report.analyze(recs)
+    assert an["compile"] is not None
+    assert an["compile"]["count"] >= 1
+    # second run of equal shapes dispatches the cached executables
+    assert an["compile"]["cache_hits"] > 0
+    assert an["compile"]["total_ms"] > 0
+    text = obs_report.render(an, "x")
+    assert "compile:" in text
+    assert "cache hits" in text
+
+
+def test_engine_trace_cli(engine_log, tmp_path):
+    from image_analogies_tpu.cli import main
+
+    out = str(tmp_path / "trace.json")
+    assert main(["trace", engine_log, "-o", out]) == 0
+    trace = json.load(open(out))
+    _assert_schema(trace["traceEvents"])
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert any(n.startswith("compile ") for n in names)
+    assert main(["trace", str(tmp_path / "missing.jsonl"),
+                 "-o", out]) == 2
