@@ -82,6 +82,10 @@ def input_digest(a, ap, b) -> str:
 # metrics snapshot of the most recent _timed scope (IA_BENCH_OBS=1 only):
 # _obs_fields() folds it into the per-config result dict
 _OBS_LAST = None
+# resolved kernel-geometry provenance of the most recent _timed scope
+# (tune/resolve.py); rides every per-config dict so a bench number is
+# never separated from the geometry it measured
+_TUNE_LAST = None
 
 
 def _timed(fn, reps=3):
@@ -101,9 +105,11 @@ def _timed(fn, reps=3):
     snapshot for `_obs_fields` — compile accounting and peak HBM ride
     the bench JSON.  Off by default: the obs-active shims add per-call
     program-key work, and the headline timings must not carry it."""
-    global _OBS_LAST
+    global _OBS_LAST, _TUNE_LAST
     _OBS_LAST = None
     import contextlib
+
+    from image_analogies_tpu.tune import resolve as tune_resolve
 
     scope = contextlib.nullcontext(None)
     if os.environ.get("IA_BENCH_OBS"):
@@ -111,6 +117,7 @@ def _timed(fn, reps=3):
         from image_analogies_tpu.obs import trace as obs_trace
 
         scope = obs_trace.run_scope(AnalogyParams(metrics=True))
+    tune_resolve.reset_provenance()
     with scope as ctx:
         fn()  # compile warm-up
         times = []
@@ -120,16 +127,25 @@ def _timed(fn, reps=3):
             times.append(time.perf_counter() - t0)
         if ctx is not None:
             _OBS_LAST = ctx.registry.snapshot()
+    _TUNE_LAST = tune_resolve.provenance_snapshot()
     return res, min(times), float(np.median(times))
 
 
 def _obs_fields():
-    """Per-config obs fold (IA_BENCH_OBS=1): compile.count/ms/cache_hits
-    and peak HBM per device from the most recent `_timed` scope, so the
-    bench trajectory captures compile-time and memory regressions, not
-    just steady-state seconds.  Empty when obs was off."""
+    """Per-config obs + tune fold: compile.count/ms/cache_hits and peak
+    HBM per device from the most recent `_timed` scope (IA_BENCH_OBS=1;
+    empty when obs was off), plus the resolved kernel-geometry configs
+    and their store-hit/fallback origins (always on — host-side dicts,
+    free)."""
+    out = {}
+    if _TUNE_LAST:
+        from image_analogies_tpu.tune import resolve as tune_resolve
+        cfgs = sorted(_TUNE_LAST.values(), key=lambda c: c["key"])
+        origins = sorted({o for c in cfgs for o in c["origin"].values()})
+        out["tune"] = {**tune_resolve.manifest_info(),
+                       "origins": origins, "configs": cfgs}
     if _OBS_LAST is None:
-        return {}
+        return out
     c = _OBS_LAST.get("counters", {})
     g = _OBS_LAST.get("gauges", {})
     obs = {
@@ -141,7 +157,8 @@ def _obs_fields():
            for k, v in g.items() if k.startswith("hbm.peak_bytes.")}
     if hbm:
         obs["peak_hbm_bytes"] = dict(sorted(hbm.items()))
-    return {"obs": obs}
+    out["obs"] = obs
+    return out
 
 
 def _min_cpu(fn, reps=2):

@@ -27,7 +27,8 @@ import jax.numpy as jnp
 
 from examples.make_assets import make_structured
 from image_analogies_tpu.backends.base import LevelJob
-from image_analogies_tpu.backends.tpu import TpuMatcher, _tile_rows
+from image_analogies_tpu.backends.tpu import TpuMatcher
+from image_analogies_tpu.tune import resolve as tune
 from image_analogies_tpu.config import AnalogyParams
 from image_analogies_tpu.models.analogy import _prep_planes
 from image_analogies_tpu.ops.features import spec_for_level
@@ -88,7 +89,7 @@ def main() -> int:
                 qc = qj[c0:c0 + 256] - db.feat_mean[None, :qj.shape[1]]
                 outs.append(prepadded_argmin2_queries(
                     qc, db.db_pad, db.dbn_pad,
-                    tile_n=_tile_rows(qj.shape[1]) // 2, q_split=q_split))
+                    tile_n=tune.tile_rows(qj.shape[1]) // 2, q_split=q_split))
             i1 = jnp.concatenate([o[0] for o in outs])
             i2 = jnp.concatenate([o[1] for o in outs])
             ok2 = jnp.concatenate([o[2] for o in outs])

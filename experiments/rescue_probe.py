@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from examples.make_assets import make_structured
-from image_analogies_tpu.backends.tpu import _scan_tile
+from image_analogies_tpu.tune import resolve as tune
 from image_analogies_tpu.config import AnalogyParams
 from image_analogies_tpu.models.analogy import _prep_planes, create_image_analogy
 from image_analogies_tpu.ops.features import (
@@ -92,15 +92,13 @@ def main() -> int:
     a_filt_flat = a_filt_pyr[lv].reshape(-1).astype(np.float32)
 
     # production pad/tile geometry (backends/tpu.py build_features): the
-    # build pad tile caps at _tile_rows(spec.total) and the scan tile is
-    # chosen from the PADDED feature width, exactly like the backend
-    from image_analogies_tpu.backends.tpu import _tile_rows
-
+    # build pad tile caps at tune.tile_rows(spec.total) and the scan tile
+    # is chosen from the PADDED feature width, exactly like the backend
     fp = max((f + 127) // 128 * 128, 128)
-    pad_tile = min(_tile_rows(spec.total),
+    pad_tile = min(tune.tile_rows(spec.total),
                    max((na + 255) // 256 * 256, 256))
     npad = (na + pad_tile - 1) // pad_tile * pad_tile
-    tile = _scan_tile(npad, fp)
+    tile = tune.scan_tile(npad, fp)
     ntiles = npad // tile
 
     dbj = jnp.asarray(db)

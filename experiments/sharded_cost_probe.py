@@ -40,10 +40,10 @@ from examples.make_assets import make_structured
 from image_analogies_tpu.backends.base import LevelJob
 from image_analogies_tpu.backends.tpu import (
     _prepare_query_arrays,
-    _tile_rows,
     build_sharded_db,
     make_level_template,
 )
+from image_analogies_tpu.tune import resolve as tune
 from image_analogies_tpu.config import AnalogyParams
 from image_analogies_tpu.models.analogy import _prep_planes, create_image_analogy
 from image_analogies_tpu.ops.features import spec_for_level
@@ -112,7 +112,7 @@ def main() -> int:
     dbp, dbnp, afp, wk, shift, dbl = build_sharded_db(
         spec, to_j(job.a_src), to_j(job.a_filt), to_j(job.a_src_coarse),
         to_j(job.a_filt_coarse), None, template.rowsafe, mesh, True,
-        _tile_rows(spec.total), packed=True)
+        tune.tile_rows(spec.total), packed=True)
     import dataclasses
 
     template = dataclasses.replace(template, feat_mean=shift)

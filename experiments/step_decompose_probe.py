@@ -32,9 +32,9 @@ from image_analogies_tpu.backends.base import LevelJob
 from image_analogies_tpu.backends.tpu import (
     TpuMatcher,
     _batched_coherence,
-    _scan_tile,
     make_anchor_fn,
 )
+from image_analogies_tpu.tune import resolve as tune
 from image_analogies_tpu.config import AnalogyParams
 from image_analogies_tpu.ops import color
 from image_analogies_tpu.ops.features import spec_for_level
@@ -102,7 +102,7 @@ def main() -> int:
     m = (m + 7) // 8 * 8
     f = int(db.static_q.shape[1])
     npad, kp = db.db_pad.shape
-    tile = _scan_tile(npad, kp)
+    tile = tune.scan_tile(npad, kp)
     ntiles = npad // tile
     live = int(db.live_idx.shape[0])
 

@@ -70,29 +70,16 @@ from image_analogies_tpu.ops.pallas_match import (
     prepadded_argmin2_queries,
     prepadded_argmin_queries,
 )
+from image_analogies_tpu.tune import buckets as tune_buckets
+from image_analogies_tpu.tune import resolve as tune
 
-# DB rows per VMEM tile of the fused argmin kernel at 128 padded features:
-# 8192 x 128 x 4 B x 2 (double buffering) = 8 MB of the 16 MB scoped VMEM;
-# bigger tiles OOM, smaller ones pay more per-tile latency in the dependent
-# wavefront chain.  Wider features (RGB label modes pad to 256) shrink the
-# row count to keep the same byte budget — see _tile_rows.
-_ARGMIN_TILE = 8192
-
-
-def _tile_rows(f: int) -> int:
-    """Kernel tile rows for feature dim `f`, holding tile ROWS at
-    ~_ARGMIN_TILE x (128 / padded-F) regardless of the DB dtype: the binding
-    VMEM constraint is the kernel's (M, tile_n) fp32 scores block (scoped
-    limit 16 MB), which depends on tile rows, not DB bytes — doubling rows
-    for a bf16 DB OOMs the scores block at wavefront M (measured).
-
-    Always a multiple of 256: level pads are built as multiples of this
-    tile, and `_scan_tile` needs every realizable npad to have a
-    power-of-2 divisor >= 256 (a 2730-row tile at fp=384 would leave npads
-    whose largest power-of-2 divisor is 2, collapsing the champion-kernel
-    grid to npad/2 tiles)."""
-    fp = max(_round_up(f, 128), 128)
-    return max(512, _ARGMIN_TILE * 128 // fp // 256 * 256)
+# Kernel tile geometry — argmin tile rows, the packed anchor-scan cap,
+# and the raised VMEM budget — is RESOLVED, not hard-coded: every call
+# site asks image_analogies_tpu.tune.resolve (override > env > store >
+# the legacy defaults in tune.geometry, which preserve the round-5
+# measured values and their VMEM rationale verbatim).  Resolution runs
+# on the host at trace time, so the chosen ints are baked into jit
+# programs exactly like the old module constants were.
 
 _F32 = jnp.float32
 _HIGHEST = jax.lax.Precision.HIGHEST
@@ -106,23 +93,6 @@ _REFINE_PASSES = 3
 # data paths — measured round 4); f32 represents integers exactly below
 # 2^24, so exemplars beyond 4096^2 rows are rejected at trace time.
 _WAVEFRONT_MAX_ROWS = 1 << 24
-
-# exact_hi2_2p anchor scan tile geometry.  Round-5 sweep on the real
-# chip (full north star, min-of-5 same session): tile 4096 -> 5.745 s,
-# 8192 -> 5.30 s, 16384 -> 5.084 s, 32768 -> 5.284 s — fewer grid steps
-# amortize the per-tile fixed cost (champion fold, bookkeeping, DMA
-# issue) until the VMEM working set starts fighting the scoped double
-# buffers.  16384-row tiles need the VMEM limit raised over the
-# platform's scoped default: (M, tile) f32 scores ~23 MB + two 8 MB
-# weight buffers fit comfortably in the raised 110 MB budget (v5e-class
-# VMEM is 128 MB).  Champion picks are BIT-IDENTICAL across tile sizes
-# (per-row scores are tile-local; the cross-tile strict-improve fold
-# keeps lowest-global-index ties regardless of partitioning).
-# Env overrides kept for future A/Bs.
-_PACKED_TILE_CAP = int(__import__("os").environ.get("IA_PACKED_TILE",
-                                                    16384))
-_PACKED_VMEM_LIMIT = int(__import__("os").environ.get(
-    "IA_PACKED_VMEM", 110 * 2 ** 20))
 
 
 @dataclass
@@ -204,6 +174,25 @@ class TpuLevelDB:
     # mesh for the sharded whole-level step (db_shards > 1); hashable, so a
     # valid static field — synthesize_level dispatches to parallel/step.py
     mesh: Any = field(default=None, metadata=dict(static=True))
+    # Shape-bucketed levels (tune.buckets): the REAL A extent as a traced
+    # (2,) int32 leaf [ha, wa], with the static ha/wa set to the 0
+    # sentinel — jit programs then cache on the BUCKETED array shapes
+    # instead of the exact A size, so a new exemplar size whose rows land
+    # in the same bucket reuses the compiled runner.  None (default)
+    # keeps ha/wa static and the generated HLO bit-identical to the
+    # unbucketed engine; all consumers go through a_dims()/a_rows().
+    dims_a: Optional[jax.Array] = None
+
+    def a_dims(self):
+        """(ha, wa) as ints (static path) or traced scalars (bucketed)."""
+        if self.dims_a is not None:
+            return self.dims_a[0], self.dims_a[1]
+        return self.ha, self.wa
+
+    def a_rows(self):
+        """Real DB row count ha*wa (excludes bucket padding rows)."""
+        ha, wa = self.a_dims()
+        return ha * wa
 
 
 jax.tree_util.register_dataclass(
@@ -376,11 +365,11 @@ def _packed_weight_arrays(src, spec, npad: int, mode2p: bool):
 
 
 @functools.partial(jax.jit, static_argnames=("spec", "pad_tile", "pad_full",
-                                             "pad_mode"))
+                                             "pad_mode", "db_rows_pad"))
 def _prepare_level_arrays(
     spec, a_src, a_filt, a_src_coarse, a_filt_coarse, a_temporal,
     b_src, b_src_coarse, b_filt_coarse, b_temporal, rowsafe, pad_tile,
-    pad_full=False, pad_mode="f32",
+    pad_full=False, pad_mode="f32", db_rows_pad=0,
 ):
     """All device-side level preparation fused into ONE program: eager
     per-op dispatch over the PJRT tunnel costs ~1s/level otherwise.
@@ -401,7 +390,15 @@ def _prepare_level_arrays(
       [L, 2L).  One bf16 HBM stream + 2 stacked MXU passes reproduce
       HIGHEST's exact product set (see make_anchor_fn).
 
-    The fp32 ``db`` stays the re-score / coherence source in every mode."""
+    The fp32 ``db`` stays the re-score / coherence source in every mode.
+
+    ``db_rows_pad`` (shape bucketing, tune/buckets.py) grows every
+    Na-sized array to the bucketed row count AFTER the real-row builds:
+    means/norms/shifts are computed over real rows only, scan-copy pads
+    carry +inf norms so the argmin never picks them, and full-array pads
+    are zero rows that no gather reaches (coherence candidates clip to
+    the real A extent; the anchor clamps to the real row count).  0 (the
+    default) reproduces the unbucketed arrays bit-for-bit."""
     db = build_features_jax(spec, a_src, a_filt, a_src_coarse, a_filt_coarse,
                             temporal_fine=a_temporal)
     static_q = build_features_jax(spec, b_src, None, b_src_coarse,
@@ -448,7 +445,8 @@ def _prepare_level_arrays(
         srcn = out["db_sqnorm"] if pad_full else out["db_rowsafe_sqnorm"]
         n, f = src.shape
         fp = max((f + 127) // 128 * 128, 128)
-        npad = (n + pad_tile - 1) // pad_tile * pad_tile
+        n_goal = max(n, db_rows_pad)
+        npad = (n_goal + pad_tile - 1) // pad_tile * pad_tile
         if pad_mode == "bf16":
             # centered bf16 scan copy + EXACT fp32 norms of the centered
             # rows (identical rows stay identical -> ties stay lowest-index)
@@ -486,12 +484,31 @@ def _prepare_level_arrays(
             # half norms for the champion scan kernels (bf16 / packed only)
             out["dbnh_pad"] = jnp.full((1, npad), jnp.inf, _F32).at[
                 0, :n].set(0.5 * nrm)
+    if db_rows_pad and db_rows_pad > db.shape[0]:
+        grow = db_rows_pad - db.shape[0]
+        zrows = lambda x: jnp.pad(
+            x, ((0, grow),) + ((0, 0),) * (x.ndim - 1))
+        out["db"] = zrows(out["db"])
+        out["db_sqnorm"] = jnp.pad(out["db_sqnorm"], (0, grow),
+                                   constant_values=jnp.inf)
+        if pad_full:
+            out["db_rowsafe"] = out["db"]
+            out["db_rowsafe_sqnorm"] = out["db_sqnorm"]
+        else:
+            out["db_rowsafe"] = zrows(out["db_rowsafe"])
+            out["db_rowsafe_sqnorm"] = jnp.pad(
+                out["db_rowsafe_sqnorm"], (0, grow),
+                constant_values=jnp.inf)
+        out["a_filt_flat"] = zrows(out["a_filt_flat"])
+        if out["db_live"] is not None:
+            out["db_live"] = zrows(out["db_live"])
     return out
 
 
 _prepare_level_arrays = obs_device.instrument(
     _prepare_level_arrays, "tpu.prepare_level_arrays",
-    static_argnums=(0, 11, 12, 13))  # spec, pad_tile, pad_full, pad_mode
+    # spec, pad_tile, pad_full, pad_mode, db_rows_pad
+    static_argnums=(0, 11, 12, 13, 14))
 
 
 @functools.lru_cache(maxsize=None)
@@ -763,11 +780,12 @@ def _batched_coherence(db: TpuLevelDB, s, queries, idx_c, ok, n_cand: int,
     redundant."""
     if s_r is None:
         s_r = s[idx_c]  # (M, n_cand)
-    ci = s_r // db.wa - db.off[None, :n_cand, 0]
-    cj = s_r % db.wa - db.off[None, :n_cand, 1]
-    ok = ok & (ci >= 0) & (ci < db.ha) & (cj >= 0) & (cj < db.wa)
-    cand = (jnp.clip(ci, 0, db.ha - 1) * db.wa
-            + jnp.clip(cj, 0, db.wa - 1))
+    ha, wa = db.a_dims()
+    ci = s_r // wa - db.off[None, :n_cand, 0]
+    cj = s_r % wa - db.off[None, :n_cand, 1]
+    ok = ok & (ci >= 0) & (ci < ha) & (cj >= 0) & (cj < wa)
+    cand = (jnp.clip(ci, 0, ha - 1) * wa
+            + jnp.clip(cj, 0, wa - 1))
     if q_live is not None:
         lw = q_live.shape[-1]
         gidx = (cand if p_app is None
@@ -800,12 +818,13 @@ def _batched_coherence(db: TpuLevelDB, s, queries, idx_c, ok, n_cand: int,
 def _pixel_coherence(db: TpuLevelDB, qvec, q, s):
     """Ashikhmin candidates for one pixel from the full causal window."""
     s_r = s[db.flat_idx[q]]
-    ci = s_r // db.wa - db.off[:, 0]
-    cj = s_r % db.wa - db.off[:, 1]
-    inb = ((ci >= 0) & (ci < db.ha) & (cj >= 0) & (cj < db.wa)
+    ha, wa = db.a_dims()
+    ci = s_r // wa - db.off[:, 0]
+    cj = s_r % wa - db.off[:, 1]
+    inb = ((ci >= 0) & (ci < ha) & (cj >= 0) & (cj < wa)
            & (db.valid[q] > 0))
-    cand = (jnp.clip(ci, 0, db.ha - 1) * db.wa
-            + jnp.clip(cj, 0, db.wa - 1))
+    cand = (jnp.clip(ci, 0, ha - 1) * wa
+            + jnp.clip(cj, 0, wa - 1))
     cf = db.db[cand]
     dc = jnp.sum((cf - qvec[None, :]) ** 2, axis=1)
     dc = jnp.where(inb, dc, jnp.inf)
@@ -899,12 +918,13 @@ def _left_refine(db: TpuLevelDB, queries, p, d_pick, d_app, kappa_mult,
     jcol = jnp.arange(wb)
     radius = int(round(int(db.off.shape[0]) ** 0.5)) // 2
     best_d, best_p = d_pick, p
+    _, wa = db.a_dims()
     for d in range(1, radius + 1):
         pj = jnp.roll(p, d)  # p[j-d] aligned at j
-        si = pj // db.wa
-        sj = pj % db.wa + d
-        ok = (jcol >= d) & (sj < db.wa)
-        cand = si * db.wa + jnp.minimum(sj, db.wa - 1)
+        si = pj // wa
+        sj = pj % wa + d
+        ok = (jcol >= d) & (sj < wa)
+        cand = si * wa + jnp.minimum(sj, wa - 1)
         cf = row_fn(cand)
         dc = jnp.sum((cf - queries) ** 2, axis=1)
         dc = jnp.where(ok, dc, jnp.inf)
@@ -990,9 +1010,13 @@ def make_approx_fn(db: TpuLevelDB):
                  else jax.lax.Precision.DEFAULT)
     if db.db_pad is not None:
         def approx_fn(queries):
+            tile = tune.tile_rows(
+                queries.shape[1], strategy=db.strategy,
+                dtype=str(db.db_pad.dtype), n_rows=db.db_pad.shape[0])
             return prepadded_argmin_queries(
                 queries, db.db_pad, db.dbn_pad,
-                tile_n=_tile_rows(queries.shape[1]), precision=precision)
+                tile_n=tune.snap_tile_to_divisor(tile, db.db_pad.shape[0]),
+                precision=precision)
     elif db.strategy == "wavefront":
         def approx_fn(queries):
             return argmin_l2(queries, db.db, db.db_sqnorm,
@@ -1035,52 +1059,10 @@ def packed_scan_eligible(match_mode: str, na_rows: int) -> bool:
                  or na_rows >= _PACKED_CROSSOVER_ROWS))
 
 
-def _scan_tile(npad: int, fp: int, cap_rows: int = 0) -> int:
-    """Tile rows for the per-tile champion scans over an (npad, fp) padded
-    DB: the largest power of two that (a) divides npad, (b) fits the VMEM
-    cap (~half the argmin tile — the fp32 multi-row-block dots must fit
-    scoped VMEM; ``cap_rows`` overrides for kernels whose VMEM budget
-    differs, e.g. the single-stream champion scan runs 8192-row tiles at
-    wavefront M), then halved until the champion set spans >= 16 tiles.
-
-    Divisibility is the hard constraint (`pallas_*_champions` asserts
-    npad % tile == 0): npad is a multiple of the build-time pad tile, which
-    is a multiple of 128 but possibly an ODD multiple (round128 of a small
-    DB), and the VMEM cap for wide packed features (_tile_rows(fp)//2) need
-    not be a power of two — so both are snapped down to powers of two
-    before taking the min, which then always divides npad."""
-    p2_npad = npad & (-npad)  # largest power of 2 dividing npad.  On the
-    # single-chip TPU geometries this is >= 256 (build pads are multiples
-    # of 256 — _tile_rows and the small-DB round in build_features); mesh
-    # geometries (sharded_pad_geometry caps at round_up(per_shard, 128))
-    # and CPU-test tile=1 pads can leave only 128 or less — the final tile
-    # then simply equals p2_npad, which always divides npad.
-    cap = max(cap_rows or _tile_rows(fp) // 2, 256)
-    cap = 1 << (cap.bit_length() - 1)  # snap down to a power of 2
-    tile = min(cap, p2_npad, npad)
-    while npad // tile < 16 and tile >= 256:
-        tile //= 2
-    return tile
-
-
-def _packed_tile_cap(hb: int, wb: int, n_off: int) -> int:
-    """VMEM-aware row cap for the packed 2-pass scan's tile (the round-5
-    tile raise, bounded): the kernel materializes an (M, tile) f32 score
-    block, and the wavefront batch M plateaus at B's anti-diagonal width
-    — a ~4096-wide B has plateau M ~ 1365, where the fixed
-    _PACKED_TILE_CAP=16384 would blow the raised VMEM budget the north
-    star's M=344 fits comfortably.  Shared by the single-chip anchor
-    (`make_anchor_fn`) and the mesh packed anchor scan
-    (`parallel/step.py`), whose per-shard kernel builds the same score
-    block.  ``n_off`` is the causal window size (`db.off.shape[0]` —
-    static under trace), from which the patch width is recovered."""
-    p5 = int(round(n_off ** 0.5))
-    m_plateau = min(hb, -(-wb // (p5 // 2 + 1)))
-    mp = max(_round_up(max(m_plateau, 8), 16), 16)
-    budget = int(0.45 * (_PACKED_VMEM_LIMIT or 64 * 2 ** 20))
-    m_cap = max(budget // (mp * 4), 256)
-    m_cap = 1 << (m_cap.bit_length() - 1)
-    return min(_PACKED_TILE_CAP, m_cap)
+# The champion-scan tile helpers (power-of-two snap to npad's divisors,
+# >= 16-tile grids, the VMEM-aware packed cap) live in tune.geometry;
+# call sites below resolve them through tune.resolve so a measured store
+# entry or env override replaces the legacy numbers per device class.
 
 
 def make_anchor_fn(db: TpuLevelDB, defer_rescore: bool = False):
@@ -1121,10 +1103,10 @@ def make_anchor_fn(db: TpuLevelDB, defer_rescore: bool = False):
         # recovers the true argmin through a much wider scan-error band.
         q_split = db.match_mode == "scan_rescue"  # _1p: 1-pass probe mode
         npad, fp = db.db_pad.shape
-        tile = _scan_tile(npad, fp)
+        tile = tune.scan_tile(npad, fp, strategy=db.strategy, dtype="bf16")
         ntiles = npad // tile
         t_rescue = min(_RESCUE_T, ntiles)
-        na = db.db.shape[0]
+        na = db.a_rows()
 
         def anchor(queries):
             qc = queries - db.feat_mean[None, :queries.shape[1]]
@@ -1177,18 +1159,25 @@ def make_anchor_fn(db: TpuLevelDB, defer_rescore: bool = False):
         # near-ties), end-to-end parity evidence in BENCH_r03.
         live_idx = db.live_idx  # the derivation the DB lanes were packed by
         npad, pk = db.db_pad.shape
-        na = db.db.shape[0]
+        na = db.a_rows()
         two_pass = db.match_mode == "exact_hi2_2p"
         if two_pass:
-            # round-5 tile raise, VMEM-bounded (see _packed_tile_cap)
-            tile = _scan_tile(npad, pk,
-                              cap_rows=_packed_tile_cap(
-                                  db.hb, db.wb, int(db.off.shape[0])))
+            # round-5 tile raise, VMEM-bounded (tune.geometry
+            # vmem_bounded_tile_cap, resolved through the store/env)
+            tile = tune.scan_tile(
+                npad, pk, strategy=db.strategy, dtype="packed2",
+                cap_rows=tune.packed_tile_cap(
+                    db.hb, db.wb, int(db.off.shape[0]),
+                    strategy=db.strategy, dtype="packed2", fp=pk,
+                    n_rows=npad))
+            vmem_limit = tune.packed_vmem_limit(
+                strategy=db.strategy, dtype="packed2", fp=pk, n_rows=npad)
         else:
             # exact_hi2's 3-pass kernel (packed3_best) has no vmem_limit
             # plumbing and streams THREE weight arrays per tile — keep
             # the round-4 4096-row cap it was sized for
-            tile = _scan_tile(npad, pk, cap_rows=4096)
+            tile = tune.scan_tile(npad, pk, cap_rows=4096,
+                                  strategy=db.strategy, dtype="packed")
 
         def anchor(queries):
             qc = queries - db.feat_mean[None, :queries.shape[1]]
@@ -1213,7 +1202,7 @@ def make_anchor_fn(db: TpuLevelDB, defer_rescore: bool = False):
             # 2p product set, full stop).
             if two_pass:
                 p, _ = packed2k_best(q1, q2, db.db_pad, tile_n=tile,
-                                     vmem_limit=_PACKED_VMEM_LIMIT)
+                                     vmem_limit=vmem_limit)
             else:
                 p, _ = packed3_best(
                     q1, q2, gr.astype(jnp.bfloat16), db.db_pad, db.db_pad2,
@@ -1243,7 +1232,9 @@ def make_anchor_fn(db: TpuLevelDB, defer_rescore: bool = False):
         q_split = db.match_mode == "two_pass"  # _1p: single-pass probe mode
         # q_split doubles the kernel's query rows, so its (2M, tile_n)
         # score block needs half the tile to stay inside scoped VMEM
-        tile = _tile_rows(db.static_q.shape[1]) // (2 if q_split else 1)
+        tile = tune.tile_rows(
+            db.static_q.shape[1], strategy=db.strategy, dtype="bf16",
+            n_rows=db.db_pad.shape[0]) // (2 if q_split else 1)
 
         def anchor(queries):
             qc = queries - db.feat_mean[None, :queries.shape[1]]
@@ -1312,11 +1303,15 @@ def wavefront_scan_core(db: TpuLevelDB, kappa_mult, anchor_fn,
     # source-map indices ride an f32 lane of the packed (Nb, 2) carry
     # (exact only below 2^24 — a 4096^2 exemplar; see the gather comment).
     # Explicit raise, not assert: `python -O` must not strip the guard.
-    if db.ha * db.wa > _WAVEFRONT_MAX_ROWS:
+    # Bucketed levels (static ha/wa = 0 sentinel) check the PADDED row
+    # count instead — conservative-safe: real indices are strictly below
+    # it, and the host guard cannot read a traced extent.
+    a_rows_bound = (db.ha * db.wa if db.dims_a is None else db.db.shape[0])
+    if a_rows_bound > _WAVEFRONT_MAX_ROWS:
         raise ValueError(
             f"the wavefront strategy caps exemplars at 2^24 rows "
             f"({_WAVEFRONT_MAX_ROWS}; a 4096x4096 A): this A is "
-            f"{db.ha}x{db.wa} = {db.ha * db.wa}.  Why: the scan's packed "
+            f"{db.ha}x{db.wa} = {a_rows_bound}.  Why: the scan's packed "
             f"(Nb, 2) carry stores source-map indices as exact f32 VALUES "
             f"(exact only below 2^24; int bit patterns in f32 lanes are "
             f"denormal-flushed by real TPU data paths — measured round "
@@ -1581,15 +1576,30 @@ class TpuMatcher(Matcher):
         # data_shards > 1 means the multi-frame mesh step (parallel/step.py)
         # supplies its own sharded approx_fn — don't build the single-chip
         # prepadded DB copy it would never read.
+        #
+        # Shape bucketing (tune/buckets.py, opt-in): pad the DB rows to a
+        # canonical bucket and carry the real A extent as the traced
+        # dims_a leaf, so the level's jit programs cache on the bucket
+        # instead of the exact exemplar size.  Single-chip only — the
+        # sharded builders have their own pad geometry.
+        db_rows_pad = 0
+        if (not sharded and self.params.data_shards == 1
+                and tune_buckets.buckets_enabled(self.params)):
+            db_rows_pad = tune_buckets.bucket_rows(ha * wa)
         pad_tile = 0
         if strategy in ("batched", "wavefront") and not sharded \
                 and self.params.data_shards == 1 \
                 and jax.default_backend() == "tpu":
-            na = ha * wa
-            # multiple of 256 so _scan_tile always finds a >=256
-            # power-of-2 divisor of the resulting npad
-            pad_tile = min(_tile_rows(spec.total),
-                           max((na + 255) // 256 * 256, 256))
+            n_goal = db_rows_pad or ha * wa
+            # multiple of 256 so the champion-scan tile snap always finds
+            # a >=256 power-of-2 divisor of the resulting npad
+            pad_tile = min(tune.tile_rows(spec.total, strategy=strategy,
+                                          dtype=pad_mode, n_rows=n_goal),
+                           max((n_goal + 255) // 256 * 256, 256))
+        if db_rows_pad:
+            template = dataclasses.replace(
+                template, ha=0, wa=0,
+                dims_a=jnp.asarray([ha, wa], jnp.int32))
 
         if sharded:
             from image_analogies_tpu.parallel.mesh import make_mesh
@@ -1597,7 +1607,8 @@ class TpuMatcher(Matcher):
             mesh = make_mesh(db_shards=self.params.db_shards,
                              data_shards=self.params.data_shards)
             on_tpu = jax.default_backend() == "tpu"
-            tile = _tile_rows(spec.total) if on_tpu else 1
+            tile = (tune.tile_rows(spec.total, strategy=strategy,
+                                   dtype="f32") if on_tpu else 1)
             # real-TPU wavefront meshes scan with the packed 2-pass
             # kernel per shard (the same exact_hi2_2p parity scan as the
             # single chip); CPU/virtual meshes keep the exact XLA path.
@@ -1628,7 +1639,7 @@ class TpuMatcher(Matcher):
             to_j(job.a_temporal), to_j(job.b_src),
             to_j(job.b_src_coarse), to_j(job.b_filt_coarse),
             to_j(job.b_temporal), template.rowsafe, pad_tile, pad_full,
-            pad_mode)
+            pad_mode, db_rows_pad)
         return dataclasses.replace(
             template,
             db=arrs["db"],
@@ -1715,7 +1726,7 @@ class TpuMatcher(Matcher):
         n = hb * wb
         stats = {
             "level": job.level,
-            "db_rows": db.ha * db.wa,
+            "db_rows": job.a_shape[0] * job.a_shape[1],
             "pixels": n,
             "_n_coh": n_coh,  # device scalar; driver batch-fetches
             "backend": "tpu",

@@ -107,6 +107,17 @@ def _add_engine_flags(p: argparse.ArgumentParser) -> None:
                    metavar="DIR",
                    help="write each level's B' plane as DIR/level_XX.png "
                         "(coarse-to-fine visual debugging)")
+    p.add_argument("--shape-buckets", action="store_true",
+                   help="bucket per-level DB row counts (tune/buckets.py) "
+                        "so differently-sized exemplars reuse jit "
+                        "programs; IA_SHAPE_BUCKETS overrides either way")
+    p.add_argument("--compile-cache-dir", default=None, metavar="DIR",
+                   help="JAX persistent compilation cache dir — compiles "
+                        "survive process restarts (pairs with `warmup`; "
+                        "IA_COMPILE_CACHE_DIR overrides)")
+    p.add_argument("--devcache-bytes", type=int, default=None,
+                   help="device-upload cache byte budget "
+                        "(utils/devcache.py; IA_DEVCACHE_BYTES overrides)")
     p.add_argument("--coordinator", default=None,
                    help="multi-host: coordinator address host:port "
                         "(jax.distributed); see parallel/distributed.py")
@@ -119,10 +130,15 @@ def _params_from_args(args, base: AnalogyParams) -> AnalogyParams:
     for name in ("levels", "kappa", "backend", "strategy", "match_mode",
                  "db_shards", "data_shards", "refine_passes",
                  "level_retries", "checkpoint_dir", "resume_from_level",
-                 "log_path", "profile_dir", "save_levels_dir"):
+                 "log_path", "profile_dir", "save_levels_dir",
+                 "compile_cache_dir"):
         v = getattr(args, name)
         if v is not None:
             kw[name] = v
+    if args.shape_buckets:
+        kw["shape_buckets"] = True
+    if args.devcache_bytes is not None:
+        kw["devcache_max_bytes"] = args.devcache_bytes
     if args.patch_size is not None:
         kw["patch_size"] = args.patch_size
     if args.coarse_patch_size is not None:
@@ -250,6 +266,52 @@ def cmd_report(args) -> int:
     return 0
 
 
+def cmd_tune(args) -> int:
+    """Measured autotuning of kernel geometry (tune/autotune.py): sweep
+    candidate tiles on the live device with min-of-k timing, verify
+    bit-identical champion picks across candidates, persist winners to
+    the tune store.  --dry-run prints the plan and never touches the
+    device."""
+    from image_analogies_tpu.tune import autotune
+
+    cands = (tuple(int(x) for x in args.candidates.split(","))
+             if args.candidates else None)
+    if not args.dry_run:
+        import jax
+        jax.devices()  # init the backend so keys carry the real device kind
+    plan = autotune.build_plan(knob=args.knob, rows=args.rows, f=args.f,
+                               m=args.m, reps=args.reps, candidates=cands,
+                               store=args.store)
+    if args.dry_run:
+        print(json.dumps(plan, indent=2, sort_keys=True))
+        return 0
+    import jax
+    interpret = args.interpret or jax.default_backend() != "tpu"
+    res = autotune.run_plan(plan, interpret=interpret,
+                            persist=not args.no_persist)
+    print(json.dumps(res, indent=2, sort_keys=True))
+    return 0 if res["all_verified"] else 1
+
+
+def cmd_warmup(args) -> int:
+    """AOT-compile the jit signatures for a target resolution
+    (tune/warmup.py) — with --compile-cache-dir the XLA programs persist
+    across processes; with --shape-buckets any same-bucket image then
+    reuses them."""
+    from image_analogies_tpu.tune import warmup as tune_warmup
+
+    base = PRESETS["oil_filter"].replace(backend="tpu")
+    params = _params_from_args(args, base)
+    h, w = (int(x) for x in args.size.split("x"))
+    eh = ew = None
+    if args.exemplar_size:
+        eh, ew = (int(x) for x in args.exemplar_size.split("x"))
+    res = tune_warmup.warmup(params, h, w, exemplar_height=eh,
+                             exemplar_width=ew, seed=args.seed)
+    print(json.dumps(res, sort_keys=True))
+    return 0
+
+
 def cmd_trace(args) -> int:
     """Convert a run-log JSONL into a Chrome/Perfetto trace.json
     (obs/export.py) for chrome://tracing / ui.perfetto.dev."""
@@ -335,6 +397,48 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("-o", "--out", default="trace.json",
                     help="output trace path (default: trace.json)")
     tr.set_defaults(fn=cmd_trace)
+
+    # tune takes NO engine flags (and so skips the distributed-init gate):
+    # --dry-run must never touch the device.
+    tn = sub.add_parser("tune",
+                        help="measured kernel-geometry autotuning: sweep "
+                             "tile candidates on the live device, verify "
+                             "bit-identical picks, persist winners to the "
+                             "tune store (.ia_tune.json)")
+    tn.add_argument("--dry-run", action="store_true",
+                    help="print the sweep plan JSON; no device work")
+    tn.add_argument("--knob", choices=("packed_tile", "argmin_tile", "all"),
+                    default="all")
+    tn.add_argument("--store", default=None,
+                    help="tune store path (default: repo .ia_tune.json, "
+                         "IA_TUNE_STORE overrides)")
+    tn.add_argument("--rows", type=int, default=262144,
+                    help="synthetic DB row count (padded per candidate)")
+    tn.add_argument("--f", type=int, default=253,
+                    help="raw feature width for the argmin sweep")
+    tn.add_argument("--m", type=int, default=1024,
+                    help="query batch size")
+    tn.add_argument("--reps", type=int, default=5,
+                    help="timed reps per candidate (min-of-k)")
+    tn.add_argument("--candidates", default=None,
+                    help="comma-separated tile candidates (overrides the "
+                         "per-knob default grid)")
+    tn.add_argument("--interpret", action="store_true",
+                    help="force Pallas interpret mode (auto on non-TPU)")
+    tn.add_argument("--no-persist", action="store_true",
+                    help="measure + verify but do not write the store")
+    tn.set_defaults(fn=cmd_tune)
+
+    wu = sub.add_parser("warmup",
+                        help="AOT-compile jit signatures for a target "
+                             "resolution (pairs with --compile-cache-dir "
+                             "and --shape-buckets)")
+    wu.add_argument("--size", default="256x256", help="target B HxW")
+    wu.add_argument("--exemplar-size", default=None,
+                    help="A/A' HxW (default: same as --size)")
+    wu.add_argument("--seed", type=int, default=0)
+    _add_engine_flags(wu)
+    wu.set_defaults(fn=cmd_warmup)
     return ap
 
 

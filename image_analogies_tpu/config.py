@@ -186,6 +186,21 @@ class AnalogyParams:
     # coarse-to-fine progress without touching checkpoints.
     save_levels_dir: Optional[str] = None
 
+    # tune/ subsystem (perf PR 3).
+    # Shape bucketing: round each level's padded DB row count up to a
+    # small bucket set so differently-sized A exemplars share jit program
+    # signatures (tune/buckets.py; the A dims ride along as a traced
+    # leaf).  Off by default — identical programs to the pre-bucketing
+    # engine; env IA_SHAPE_BUCKETS overrides either way.
+    shape_buckets: bool = False
+    # JAX persistent compilation cache directory (env IA_COMPILE_CACHE_DIR
+    # overrides).  Pairs with `ia warmup`: pre-compile once, reuse across
+    # process restarts.
+    compile_cache_dir: Optional[str] = None
+    # Device-upload cache byte budget (utils/devcache.py); None keeps the
+    # 1 GiB default, env IA_DEVCACHE_BYTES overrides.
+    devcache_max_bytes: Optional[int] = None
+
     def __post_init__(self):
         if self.levels < 1:
             raise ValueError(f"levels must be >= 1, got {self.levels}")
@@ -225,6 +240,10 @@ class AnalogyParams:
         if self.data_shards < 1:
             raise ValueError(
                 f"data_shards must be >= 1, got {self.data_shards}")
+        if self.devcache_max_bytes is not None and self.devcache_max_bytes < 1:
+            raise ValueError(
+                "devcache_max_bytes must be positive when set, got "
+                f"{self.devcache_max_bytes}")
 
     def replace(self, **kw) -> "AnalogyParams":
         return dataclasses.replace(self, **kw)

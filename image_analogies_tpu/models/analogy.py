@@ -142,10 +142,18 @@ def create_image_analogy(
     `remap_anchor` pins the §3.4 luminance remap to another image's stats
     (video clips anchor on frame 0 — see `_prep_planes`).
     """
+    # Runtime wiring (tune/): persistent compile cache + devcache budget
+    # when configured; no-ops on default params.
+    from image_analogies_tpu.tune import warmup as tune_warmup
+    from image_analogies_tpu.tune import resolve as tune_resolve
+
+    tune_warmup.apply_runtime_config(params)
     # Observability run scope (obs/): inert unless params.metrics or a
     # log_path is set; joins the enclosing run when video already opened
-    # one (single run_id per clip).
-    with obs_trace.run_scope(params):
+    # one (single run_id per clip).  The manifest records the tune-store
+    # provenance so a report ties results to the geometry they ran with.
+    with obs_trace.run_scope(params,
+                             manifest_extra=tune_resolve.manifest_info()):
         return _create_image_analogy(a, ap, b, params, backend,
                                      temporal_prev, remap_anchor,
                                      keep_levels)

@@ -84,10 +84,10 @@ def _sharded_phase(a, ap, frames, params: AnalogyParams, mesh,
     from image_analogies_tpu.backends.base import LevelJob
     from image_analogies_tpu.backends.tpu import (
         _prepare_query_arrays_batch,
-        _tile_rows,
         build_sharded_db,
         make_level_template,
     )
+    from image_analogies_tpu.tune import resolve as tune
     from image_analogies_tpu.ops.features import spec_for_level
     from image_analogies_tpu.ops.pyramid import build_pyramid_np, \
         num_feasible_levels
@@ -223,7 +223,8 @@ def _sharded_phase(a, ap, frames, params: AnalogyParams, mesh,
             # clips; the B stacks repeat across phase 1 and phase 2
             to_j = lambda x: device_put_cached(x, jnp.float32)
             template = make_level_template(params, job0, strategy)
-            tile = _tile_rows(spec.total) if not force_xla else 1
+            tile = (tune.tile_rows(spec.total, strategy=strategy,
+                                   dtype="f32") if not force_xla else 1)
             # real-TPU wavefront meshes scan with the packed kernel per
             # shard (the same exact_hi2_2p parity scan as the single
             # chip); CPU/virtual meshes keep the exact XLA path.  ONE

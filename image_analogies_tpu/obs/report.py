@@ -59,6 +59,9 @@ def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     spans = [r for r in records if r.get("event") == "span"]
     stats = [r for r in records if _is_level_stat(r)]
     retries = [r for r in records if r.get("event") == "level_retry"]
+    tune_resolved = [r for r in records if r.get("event") == "tune_resolved"]
+    tune_errors = [r for r in records if r.get("event") in
+                   ("tune_store_error", "tune_env_error")]
     coh_summaries = [r for r in records
                      if r.get("event") == "coherence_ratios"]
 
@@ -159,6 +162,24 @@ def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             "level_flops": level_flops,
         }
 
+    # --- tuned-geometry provenance (tune/resolve.py records) --------------
+    tune_info: Optional[Dict[str, Any]] = None
+    if (tune_resolved or tune_errors
+            or any(k.startswith("tune.") for k in counters)
+            or (manifest and "tune_store" in manifest)):
+        tune_info = {
+            "store": (manifest or {}).get("tune_store"),
+            "store_entries": (manifest or {}).get("tune_entries"),
+            "store_hits": int(counters.get("tune.store_hits", 0)),
+            "fallbacks": int(counters.get("tune.fallbacks", 0)),
+            "env_overrides": int(counters.get("tune.env_overrides", 0)),
+            "errors": len(tune_errors),
+            "configs": [{k: r[k] for k in
+                         ("key", "tile_rows", "packed_tile_cap",
+                          "packed_vmem_limit", "origin") if k in r}
+                        for r in tune_resolved],
+        }
+
     # --- per-device HBM peaks (run_end gauges + streamed hbm records) -----
     gauges: Dict[str, float] = {}
     if run_end:
@@ -182,6 +203,7 @@ def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "devcache_hit_rate": (hits / (hits + misses)
                               if (hits + misses) else None),
         "compile": compile_info,
+        "tune": tune_info,
         "hbm": hbm or None,
         "spans": spans,
         "n_records": len(records),
@@ -240,7 +262,8 @@ def render(an: Dict[str, Any], run_id: Optional[str] = None) -> str:
              "level_retry", "mesh.level_steps", "mesh.psum_gather_bytes",
              "fetch.bytes", "kappa.coherence_px", "kappa.total_px",
              "compile.count", "compile.ms", "compile.cache_hits",
-             "xla.flops", "xla.bytes"}
+             "xla.flops", "xla.bytes", "tune.store_hits", "tune.fallbacks",
+             "tune.env_overrides"}
     rest = {k: v for k, v in c.items() if k not in shown and v}
     for k in sorted(rest):
         w(f"    {k:<13} {rest[k]:g}")
@@ -265,6 +288,24 @@ def render(an: Dict[str, Any], run_id: Optional[str] = None) -> str:
                 w(f"    L{lv} achieved   ~{tf:.4g} TFLOP/s "
                   f"({comp['level_flops'][lv]:.3g} flops est / "
                   f"{ms:.1f} ms device)")
+
+    tune = an.get("tune")
+    if tune:
+        w("  tune:")
+        if tune.get("store"):
+            w(f"    store         {tune['store']} "
+              f"({tune.get('store_entries', 0)} entries)")
+        w(f"    resolutions   {tune['store_hits']} store / "
+          f"{tune['fallbacks']} default / {tune['env_overrides']} env")
+        if tune["errors"]:
+            w(f"    errors        {tune['errors']} "
+              "(corrupt store / bad env — defaults used)")
+        for cfg in tune["configs"]:
+            origins = ",".join(sorted(set(
+                (cfg.get("origin") or {}).values())))
+            w(f"    {cfg.get('key', '?'):<36} "
+              f"tile_rows={cfg.get('tile_rows')} "
+              f"cap={cfg.get('packed_tile_cap')} [{origins}]")
 
     hbm = an.get("hbm")
     if hbm:

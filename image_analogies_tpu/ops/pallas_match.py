@@ -35,6 +35,19 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def _snap_tile(tile_n: int, npad: int) -> int:
+    """Largest divisor of `npad` that is <= `tile_n` (identity for a
+    tile that already divides), so tuner-swept tile candidates can
+    never trip the grid divisibility requirement."""
+    tile_n = max(min(int(tile_n), npad), 1)
+    if npad % tile_n == 0:
+        return tile_n
+    for t in range(tile_n, 0, -1):
+        if npad % t == 0:
+            return t
+    return 1
+
+
 def _argmin_kernel(q_ref, db_ref, dbn_ref, idx_out, val_out,
                    best_val, best_idx, *, tile_n: int, n_total: int,
                    precision):
@@ -217,8 +230,7 @@ def pallas_argmin2_l2_prepadded(
     query-side truncation error for one extra MXU pass."""
     mp, fp = q.shape
     npad = dbp.shape[0]
-    tile_n = min(tile_n, npad)
-    assert npad % tile_n == 0, (npad, tile_n)
+    tile_n = _snap_tile(tile_n, npad)
     if q_split:
         hi, lo = bf16_split2(q.astype(_F32))  # XLA-folding-safe split
         q = jnp.concatenate([hi.astype(jnp.bfloat16),
@@ -348,8 +360,7 @@ def pallas_pertile_champions(
     row of each tile's best) in TILE-MAJOR layout (see `_pertile_kernel` on
     why).  See `pertile_champions_queries` for the (M, ntiles) wrapper."""
     npad = dbp.shape[0]
-    tile_n = min(tile_n, npad)
-    assert npad % tile_n == 0, (npad, tile_n)
+    tile_n = _snap_tile(tile_n, npad)
     if q_split:
         hi, lo = bf16_split2(q.astype(_F32))  # XLA-folding-safe split
         q = jnp.concatenate([hi.astype(jnp.bfloat16),
@@ -468,8 +479,7 @@ def pallas_packed_champions(
     """Entry for `_packed_kernel`; returns tile-major (ntiles, Mp) pairs."""
     mp, kp = qb.shape
     npad = w1.shape[0]
-    tile_n = min(tile_n, npad)
-    assert npad % tile_n == 0, (npad, tile_n)
+    tile_n = _snap_tile(tile_n, npad)
     assert qa.shape == ((2 * mp if fold_a else mp), kp), (qa.shape, qb.shape)
     qm = qa.shape[0]
     grid = npad // tile_n
@@ -587,8 +597,7 @@ def pallas_packed_best(
     """Entry for `_packed_best_kernel`; returns (idx (Mp,), val (Mp,)) —
     the global scan champion per query, ties lowest-index."""
     npad, kp = w1.shape
-    tile_n = min(tile_n, npad)
-    assert npad % tile_n == 0, (npad, tile_n)
+    tile_n = _snap_tile(tile_n, npad)
     qm, mp = qa.shape[0], (qa.shape[0] // 2 if fold_a else qa.shape[0])
     grid = npad // tile_n
     qb_spec = (pl.BlockSpec((qb.shape[0], qb.shape[1]), lambda t: (0, 0),
@@ -941,8 +950,7 @@ def pallas_argmin_l2_prepadded(
     Returns (idx (Mp,) int32, min_score (Mp,) = dist - ||q||^2)."""
     mp, fp = q.shape
     npad = dbp.shape[0]
-    tile_n = min(tile_n, npad)
-    assert npad % tile_n == 0, (npad, tile_n)
+    tile_n = _snap_tile(tile_n, npad)
 
     grid = npad // tile_n
     kernel = functools.partial(_argmin_kernel, tile_n=tile_n, n_total=npad,

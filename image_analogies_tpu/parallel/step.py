@@ -37,11 +37,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from image_analogies_tpu.backends.tpu import (
-    _PACKED_VMEM_LIMIT,
     TpuLevelDB,
-    _packed_tile_cap,
-    _scan_tile,
-    _tile_rows,
     batched_scan_core,
     wavefront_scan_core,
 )
@@ -53,6 +49,7 @@ from image_analogies_tpu.parallel.sharded_match import (
     local_argmin_allreduce,
     packed_champion_allreduce,
 )
+from image_analogies_tpu.tune import resolve as tune
 
 
 @functools.lru_cache(maxsize=None)
@@ -81,10 +78,14 @@ def _cached_multichip_step(mesh: Mesh, strategy: str, force_xla: bool,
 
         def approx_fn(queries):
             # shards come from shard_level_db (lane-padded); the allreduce
-            # helper picks the prepadded Pallas entry when rows align
+            # helper picks the prepadded Pallas entry when rows align.
+            # Geometry resolves at trace time (host), like every site.
             return local_argmin_allreduce(
                 queries, db_loc, dbn_loc, "db", force_xla=force_xla,
-                precision=precision, prepadded=True, tile_n=_tile_rows(f))
+                precision=precision, prepadded=True,
+                tile_n=tune.tile_rows(f, strategy=strategy,
+                                      dtype=str(db_loc.dtype),
+                                      n_rows=rows))
 
         def scan_fn(queries):
             # globally-reduced pick, no re-score (see anchor_fn)
@@ -99,13 +100,19 @@ def _cached_multichip_step(mesh: Mesh, strategy: str, force_xla: bool,
                     # (the per-shard kernel builds the same (M, tile) f32
                     # score block, and M plateaus at B's diagonal width
                     # regardless of sharding)
-                    tile_n=_scan_tile(wk_loc.shape[0], wk_loc.shape[1],
-                                      cap_rows=_packed_tile_cap(
-                                          tmpl.hb, tmpl.wb,
-                                          int(tmpl.off.shape[0]))),
+                    tile_n=tune.scan_tile(
+                        wk_loc.shape[0], wk_loc.shape[1],
+                        strategy=strategy, dtype="packed2",
+                        cap_rows=tune.packed_tile_cap(
+                            tmpl.hb, tmpl.wb, int(tmpl.off.shape[0]),
+                            strategy=strategy, dtype="packed2",
+                            fp=wk_loc.shape[1],
+                            n_rows=wk_loc.shape[0])),
                     interpret=packed_interpret,
                     vmem_limit=0 if packed_interpret
-                    else _PACKED_VMEM_LIMIT)
+                    else tune.packed_vmem_limit(
+                        strategy=strategy, dtype="packed2",
+                        fp=wk_loc.shape[1], n_rows=wk_loc.shape[0]))
             else:
                 p, _ = approx_fn(queries)
             return p

@@ -1,0 +1,79 @@
+"""Measured kernel-geometry tuning (ISSUE 3).
+
+Three layers:
+
+- :mod:`tune.geometry` — the legacy default math (pure, device-free);
+- :mod:`tune.store` + :mod:`tune.resolve` — the persistent JSON store
+  and the per-call-site resolution funnel every kernel-shape knob now
+  flows through (override > env > store > defaults);
+- :mod:`tune.buckets` — shape bucketing for jit-program reuse.
+
+:mod:`tune.autotune` (the ``ia tune`` sweep) and :mod:`tune.warmup`
+(``ia warmup`` + compile-cache wiring) are imported lazily by the CLI —
+NOT re-exported here — so importing ``tune`` from the backends never
+pulls in the model layer.
+"""
+
+from image_analogies_tpu.tune.buckets import bucket_rows, buckets_enabled
+from image_analogies_tpu.tune.geometry import (
+    ARGMIN_TILE,
+    DEFAULT_PACKED_TILE_CAP,
+    DEFAULT_PACKED_VMEM_LIMIT,
+    default_tile_rows,
+    scan_tile_rows,
+    vmem_bounded_tile_cap,
+)
+# NB: the low-level `resolve()` entry point is deliberately NOT
+# re-exported by name — it would shadow the `tune.resolve` submodule
+# attribute and break `from image_analogies_tpu.tune import resolve`.
+from image_analogies_tpu.tune.resolve import (
+    TuneConfig,
+    device_kind,
+    make_key,
+    manifest_info,
+    override,
+    packed_tile_cap,
+    packed_vmem_limit,
+    provenance_snapshot,
+    reset_provenance,
+    scan_tile,
+    snap_tile_to_divisor,
+    tile_rows,
+)
+from image_analogies_tpu.tune.store import (
+    SCHEMA_VERSION,
+    invalidate_cache,
+    load_entries,
+    merge_entries,
+    save_entries,
+    store_path,
+)
+
+__all__ = [
+    "ARGMIN_TILE",
+    "DEFAULT_PACKED_TILE_CAP",
+    "DEFAULT_PACKED_VMEM_LIMIT",
+    "SCHEMA_VERSION",
+    "TuneConfig",
+    "bucket_rows",
+    "buckets_enabled",
+    "default_tile_rows",
+    "device_kind",
+    "invalidate_cache",
+    "load_entries",
+    "make_key",
+    "manifest_info",
+    "merge_entries",
+    "override",
+    "packed_tile_cap",
+    "packed_vmem_limit",
+    "provenance_snapshot",
+    "reset_provenance",
+    "save_entries",
+    "scan_tile",
+    "scan_tile_rows",
+    "snap_tile_to_divisor",
+    "store_path",
+    "tile_rows",
+    "vmem_bounded_tile_cap",
+]

@@ -1,0 +1,180 @@
+"""Measured autotuner: sweep kernel geometries, verify, persist.
+
+``ia tune`` builds a sweep plan (:func:`build_plan`) and runs it on the
+live device (:func:`run_plan`): for each knob a synthetic workload with
+the production kernel entry points, min-of-k wall timing bracketed by
+``obs.trace.span`` records, and — before anything is persisted — a
+bit-identical champion check across ALL candidates (the cross-tile
+strict-improve fold makes the argmin pick independent of tile geometry;
+the tuner enforces that invariant rather than assuming it, so a kernel
+regression can never be laundered into the store as a "fast" winner).
+
+Winners land in the tune store under the bucket-wildcard key for the
+swept (device, strategy, dtype, F) so one measurement covers every row
+count of that shape class.  ``--dry-run`` prints the plan JSON and never
+touches the device.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from image_analogies_tpu.obs import trace as _trace
+from image_analogies_tpu.tune import geometry as _geometry
+from image_analogies_tpu.tune import resolve as _resolve
+from image_analogies_tpu.tune import store as _store
+
+# Candidate tile caps for the packed anchor scan (the round-5 hand-sweep
+# grid, now measured per device class instead of frozen).
+PACKED_TILE_CANDIDATES = (4096, 8192, 16384, 32768)
+
+
+def _argmin_candidates(fp: int) -> List[int]:
+    base = _geometry.default_tile_rows(fp)
+    return sorted({max(base // 2, 256), base, base * 2})
+
+
+def build_plan(*, knob: str = "all", rows: int = 262144, f: int = 253,
+               m: int = 1024, reps: int = 5,
+               candidates: Optional[Sequence[int]] = None,
+               store: Optional[str] = None) -> Dict[str, Any]:
+    """The sweep plan: everything ``run_plan`` will do, as data.  ``f``
+    is the raw feature width (lane-padded per kernel); ``rows`` the
+    synthetic DB size (padded so every candidate tiles it evenly)."""
+    device = _resolve.device_kind()
+    sweeps: List[Dict[str, Any]] = []
+    if knob in ("packed_tile", "all"):
+        cands = sorted(set(int(c) for c in (candidates or
+                                            PACKED_TILE_CANDIDATES)))
+        if any(c < 256 or c & (c - 1) for c in cands):
+            raise ValueError(
+                f"packed_tile candidates must be powers of two >= 256, "
+                f"got {cands}")
+        # packed2k layout needs 4l+3 <= kp; l=63 fills kp=256 exactly.
+        l = 63
+        kp = _geometry.round_up(4 * l + 3, 128)
+        npad = _geometry.round_up(rows, max(cands))
+        sweeps.append({
+            "knob": "packed_tile_cap",
+            "kernel": "packed2k_best",
+            "store_key": _resolve.make_key(device, "wavefront", "packed2",
+                                           kp, "*"),
+            "candidates": cands,
+            "shape": {"npad": npad, "kp": kp, "l": l, "m": m},
+        })
+    if knob in ("argmin_tile", "all"):
+        fp = max(_geometry.round_up(f, 128), 128)
+        cands = sorted(set(int(c) for c in (candidates or
+                                            _argmin_candidates(fp))))
+        if any(c < 256 or c % 256 for c in cands):
+            raise ValueError(
+                f"argmin_tile candidates must be multiples of 256, "
+                f"got {cands}")
+        lcm = int(np.lcm.reduce(np.asarray(cands, np.int64)))
+        npad = _geometry.round_up(rows, lcm)
+        sweeps.append({
+            "knob": "tile_rows",
+            "kernel": "prepadded_argmin",
+            "store_key": _resolve.make_key(device, "wavefront", "f32",
+                                           fp, "*"),
+            "candidates": cands,
+            "shape": {"npad": npad, "fp": fp, "m": m},
+        })
+    if not sweeps:
+        raise ValueError(f"unknown tune knob {knob!r}")
+    return {"device_kind": device, "reps": int(reps),
+            "store": _store.store_path(store), "sweeps": sweeps}
+
+
+def _time_call(fn, reps: int, **attrs) -> float:
+    """min-of-k wall ms; one warmup call (compile) then k timed reps,
+    each fully synchronized, each bracketed by a tune.candidate span."""
+    import jax
+
+    jax.block_until_ready(fn())  # warmup/compile outside timing
+    best = float("inf")
+    for _ in range(max(reps, 1)):
+        with _trace.span("tune.candidate", **attrs):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn())
+            ms = (time.perf_counter() - t0) * 1e3
+        best = min(best, ms)
+    return best
+
+
+def _run_sweep(sweep: Dict[str, Any], reps: int,
+               interpret: bool) -> Dict[str, Any]:
+    import jax.numpy as jnp
+
+    from image_analogies_tpu.ops.pallas_match import (
+        pallas_argmin_l2_prepadded,
+        packed2k_best,
+    )
+
+    rng = np.random.RandomState(0)
+    shape = sweep["shape"]
+    results: List[Dict[str, Any]] = []
+    picks: List[np.ndarray] = []
+    if sweep["kernel"] == "packed2k_best":
+        npad, kp, l, m = (shape["npad"], shape["kp"], shape["l"],
+                          shape["m"])
+        wk = jnp.asarray(rng.randn(npad, kp).astype(np.float32),
+                         jnp.bfloat16)
+        q1 = jnp.asarray(rng.randn(m, l).astype(np.float32), jnp.bfloat16)
+        q2 = jnp.asarray(rng.randn(m, l).astype(np.float32), jnp.bfloat16)
+        for cand in sweep["candidates"]:
+            tile = _resolve.snap_tile_to_divisor(cand, npad)
+            call = lambda t=tile: packed2k_best(q1, q2, wk, tile_n=t,
+                                                interpret=interpret)
+            ms = _time_call(call, reps, knob=sweep["knob"], candidate=cand)
+            idx, val = call()
+            picks.append(np.asarray(idx))
+            results.append({"candidate": cand, "tile_n": tile,
+                            "ms": round(ms, 3)})
+    else:
+        npad, fp, m = shape["npad"], shape["fp"], shape["m"]
+        dbp = jnp.asarray(rng.randn(npad, fp).astype(np.float32))
+        dbn = (jnp.sum(dbp * dbp, axis=1))[None, :]
+        q = jnp.asarray(rng.randn(max(m, 8), fp).astype(np.float32))
+        for cand in sweep["candidates"]:
+            tile = _resolve.snap_tile_to_divisor(cand, npad)
+            call = lambda t=tile: pallas_argmin_l2_prepadded(
+                q, dbp, dbn, tile_n=t, interpret=interpret)
+            ms = _time_call(call, reps, knob=sweep["knob"], candidate=cand)
+            idx, val = call()
+            picks.append(np.asarray(idx))
+            results.append({"candidate": cand, "tile_n": tile,
+                            "ms": round(ms, 3)})
+
+    verified = all(np.array_equal(picks[0], p) for p in picks[1:])
+    best = min(results, key=lambda r: r["ms"])
+    return {"knob": sweep["knob"], "store_key": sweep["store_key"],
+            "results": results, "verified": verified,
+            "winner": best["candidate"], "winner_ms": best["ms"]}
+
+
+def run_plan(plan: Dict[str, Any], *, interpret: bool = False,
+             persist: bool = True) -> Dict[str, Any]:
+    """Execute a plan from :func:`build_plan`.  Champion picks must be
+    bit-identical across every candidate of a sweep or that sweep's
+    winner is NOT persisted (reported with ``verified: false``)."""
+    out: List[Dict[str, Any]] = []
+    winners: Dict[str, Dict[str, Any]] = {}
+    for sweep in plan["sweeps"]:
+        res = _run_sweep(sweep, plan["reps"], interpret)
+        out.append(res)
+        if res["verified"] and persist:
+            entry = dict(winners.get(res["store_key"], {}))
+            entry[res["knob"]] = int(res["winner"])
+            entry["source"] = "ia tune"
+            entry[f"{res['knob']}_ms"] = res["winner_ms"]
+            winners[res["store_key"]] = entry
+    saved = None
+    if winners and persist:
+        saved = _store.merge_entries(winners, plan["store"])
+    return {"device_kind": plan["device_kind"], "sweeps": out,
+            "persisted": saved,
+            "all_verified": all(r["verified"] for r in out)}

@@ -1,0 +1,45 @@
+"""Shape buckets: canonical DB row counts for jit-program reuse.
+
+The wavefront runner's program signature depends on the padded DB row
+count, so every distinct A size used to compile a fresh program even
+when the arrays could share one.  ``bucket_rows`` snaps a row count up
+to a small canonical set — powers of two plus the 3*2^k midpoints whose
+power-of-two divisor is still >= 256 (the Pallas row quantum):
+
+    256, 512, 768, 1024, 1536, 2048, 3072, 4096, 6144, 8192, ...
+
+Worst-case padding waste is just above a power of two (1025 -> 1536,
+~1.5x); the geometric spacing keeps the bucket count logarithmic in the
+largest supported image.  Every bucket is a multiple of 256 with a
+power-of-two divisor >= 256, which is exactly what ``_scan_tile`` /
+``pallas_argmin_l2_prepadded`` need for their divisibility contracts.
+
+Bucketing is opt-in (``AnalogyParams.shape_buckets`` or
+``IA_SHAPE_BUCKETS=1``): with it off, pad shapes — and therefore program
+signatures and outputs — are bit-identical to the pre-tune engine.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+
+def bucket_rows(n: int) -> int:
+    """Smallest bucket >= n from {2^k} U {3*2^(k-2) : 2^(k-2) >= 256}."""
+    if n <= 256:
+        return 256
+    k = (n - 1).bit_length()
+    three = 3 << (k - 2)
+    if three >= n and (three & -three) >= 256:
+        return three
+    return 1 << k
+
+
+def buckets_enabled(params: Any = None) -> bool:
+    """Call-time gate: IA_SHAPE_BUCKETS env (non-empty wins outright,
+    falsey spellings disable) > ``params.shape_buckets`` > off."""
+    env = os.environ.get("IA_SHAPE_BUCKETS", "").strip().lower()
+    if env:
+        return env not in ("0", "false", "no", "off")
+    return bool(getattr(params, "shape_buckets", False))

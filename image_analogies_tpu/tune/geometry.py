@@ -1,0 +1,73 @@
+"""Default kernel-geometry math (the pre-tune constants, verbatim).
+
+Every number here is the hard-coded value the engine shipped with before
+the tune/ subsystem existed — the round-5 hand-sweep winners from
+``backends/tpu.py``.  ``resolve.py`` consults the persistent store and
+the environment first and falls back to these functions, so an empty
+store reproduces the legacy geometry bit-for-bit.
+
+This module is PURE: no jax, no env reads, no store I/O — just the
+arithmetic that turns (padded feature width, row count, VMEM budget)
+into tile shapes.  That purity is what makes the defaults testable
+against the legacy constants and reusable by the autotuner's sweep-plan
+builder without touching a device.
+"""
+
+from __future__ import annotations
+
+# Target score-matrix footprint of one Pallas argmin grid step, in
+# elements: tile_n rows x 128-lane feature panels (legacy _ARGMIN_TILE).
+ARGMIN_TILE = 8192
+
+# Packed anchor-scan knobs (legacy _PACKED_TILE_CAP / _PACKED_VMEM_LIMIT,
+# round-5 measured: 4096->5.745s ... 16384->5.084s ... 32768->5.284s).
+DEFAULT_PACKED_TILE_CAP = 16384
+DEFAULT_PACKED_VMEM_LIMIT = 110 * 2 ** 20
+
+
+def round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def default_tile_rows(f: int) -> int:
+    """Rows per Pallas argmin tile for feature width ``f`` (legacy
+    ``_tile_rows``): scale inversely with the padded feature width so the
+    per-tile score block stays near ARGMIN_TILE*128 elements, floored at
+    512 and snapped down to a multiple of 256 (the kernel's row quantum).
+    """
+    fp = max(round_up(f, 128), 128)
+    return max(512, ARGMIN_TILE * 128 // fp // 256 * 256)
+
+
+def scan_tile_rows(npad: int, cap_rows: int) -> int:
+    """Anchor-scan tile height for a DB padded to ``npad`` rows (legacy
+    ``_scan_tile`` with the cap made explicit): the largest power of two
+    that divides npad, bounded by ``cap_rows`` (snapped down to a power
+    of two, floored at 256), then halved until the grid has >= 16 steps
+    so short DBs still pipeline.
+    """
+    p2_npad = npad & (-npad)
+    cap = max(cap_rows, 256)
+    cap = 1 << (cap.bit_length() - 1)
+    tile = min(cap, p2_npad, npad)
+    while npad // tile < 16 and tile >= 256:
+        tile //= 2
+    return tile
+
+
+def vmem_bounded_tile_cap(hb: int, wb: int, n_off: int,
+                          tile_cap: int, vmem_limit: int) -> int:
+    """Packed-scan tile cap bounded by the VMEM budget (legacy
+    ``_packed_tile_cap`` with the two knobs passed in): estimate the
+    plateau query-batch height from the B extent and the candidate
+    window, then cap the DB tile so scratch + both streams fit in ~45%
+    of ``vmem_limit``; never below 256, always a power of two, never
+    above ``tile_cap``.
+    """
+    p5 = int(round(n_off ** 0.5))
+    m_plateau = min(hb, -(-wb // (p5 // 2 + 1)))
+    mp = max(round_up(max(m_plateau, 8), 16), 16)
+    budget = int(0.45 * (vmem_limit or 64 * 2 ** 20))
+    m_cap = max(budget // (mp * 4), 256)
+    m_cap = 1 << (m_cap.bit_length() - 1)
+    return min(tile_cap, m_cap)

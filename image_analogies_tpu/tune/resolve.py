@@ -1,0 +1,298 @@
+"""Geometry resolution: one funnel for every kernel-shape knob.
+
+Call sites that used to read ``_tile_rows`` / ``_PACKED_TILE_CAP`` /
+``_PACKED_VMEM_LIMIT`` directly now ask this module, which resolves a
+:class:`TuneConfig` keyed by ``(device_kind, strategy, dtype, padded-F,
+shape-bucket)`` with per-knob precedence:
+
+    tuner override (thread-local)  >  env var  >  store entry  >  default
+
+- **override**: the autotuner brackets its timed candidates with
+  :func:`override` so the swept value flows through the SAME call sites
+  production uses.
+- **env**: ``IA_TILE_ROWS`` / ``IA_PACKED_TILE`` / ``IA_PACKED_VMEM``,
+  parsed at CALL time (the legacy module-import read silently ignored
+  later changes); invalid values warn once and are ignored.
+- **store**: :mod:`tune.store` entries — exact key first, then the
+  bucket-wildcard key (``...|b*``) so one measured winner can cover all
+  row counts of a device/strategy/dtype/F combination.
+- **default**: :mod:`tune.geometry`, the legacy constants — an empty
+  store with no env reproduces the pre-tune engine bit-for-bit.
+
+Resolution happens on the host at trace time, so the returned ints are
+baked into jit programs exactly like the old constants were.  Every
+resolution records its origin in a process-local provenance registry
+(:func:`provenance_snapshot` — bench.py attaches it to each result dict,
+the run manifest carries the store summary) and bumps
+``tune.store_hits`` / ``tune.fallbacks`` / ``tune.env_overrides``
+counters when a metrics run is active.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import math
+import os
+import sys
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+from image_analogies_tpu.obs import metrics as _metrics
+from image_analogies_tpu.obs import trace as _trace
+from image_analogies_tpu.tune import buckets as _buckets
+from image_analogies_tpu.tune import geometry as _geometry
+from image_analogies_tpu.tune import store as _store
+from image_analogies_tpu.utils import logging as _logging
+
+_ENV_VARS = {
+    "tile_rows": "IA_TILE_ROWS",
+    "packed_tile_cap": "IA_PACKED_TILE",
+    "packed_vmem_limit": "IA_PACKED_VMEM",
+}
+
+_TLS = threading.local()  # .overrides: Dict[str, int] while tuner active
+_LOCK = threading.Lock()
+_PROV: Dict[str, Dict[str, Any]] = {}  # store_key -> provenance record
+_ENV_WARNED: set = set()
+
+
+@dataclass(frozen=True)
+class TuneConfig:
+    """One resolved geometry: the three knobs plus where each came from.
+
+    ``origin`` maps knob -> one of ``override|env|store|store_wildcard|
+    default`` (as a tuple of pairs so the config stays hashable).
+    """
+
+    key: str
+    tile_rows: int
+    packed_tile_cap: int
+    packed_vmem_limit: int
+    origin: Tuple[Tuple[str, str], ...] = field(default=())
+    store_key: str = ""
+
+    def origin_of(self, knob: str) -> str:
+        return dict(self.origin).get(knob, "default")
+
+
+def device_kind() -> str:
+    """Device class for the store key WITHOUT forcing backend init (same
+    peek as obs.trace._device_info); "any" when nothing is known yet —
+    resolution must never be the thing that initializes a device."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return "any"
+    try:
+        backends = sys.modules.get("jax._src.xla_bridge")
+        if backends is None or not getattr(backends, "_backends", None):
+            return "any"
+        devs = jax.devices()
+        return devs[0].device_kind if devs else "any"
+    except Exception:
+        return "any"
+
+
+def make_key(device: str, strategy: str, dtype: str, fp: int,
+             bucket: int) -> str:
+    return f"{device}|{strategy}|{dtype}|f{fp}|b{bucket}"
+
+
+def _env_int(knob: str) -> Optional[int]:
+    var = _ENV_VARS[knob]
+    raw = os.environ.get(var, "").strip()
+    if not raw:
+        return None
+    try:
+        v = int(raw)
+        if v <= 0:
+            raise ValueError(raw)
+        return v
+    except ValueError:
+        with _LOCK:
+            seen = var in _ENV_WARNED
+            _ENV_WARNED.add(var)
+        if not seen:
+            ctx = _trace._CURRENT
+            _logging.emit(
+                {"event": "tune_env_error", "severity": "warning",
+                 "var": var, "value": raw},
+                ctx.log_path if ctx is not None else None)
+        return None
+
+
+@contextlib.contextmanager
+def override(**knobs: int):
+    """Thread-locally pin knobs (the autotuner's sweep lever); nests."""
+    bad = set(knobs) - set(_ENV_VARS)
+    if bad:
+        raise ValueError(f"unknown tune knobs {sorted(bad)}")
+    prev = getattr(_TLS, "overrides", None)
+    merged = dict(prev or {})
+    merged.update(knobs)
+    _TLS.overrides = merged
+    try:
+        yield
+    finally:
+        _TLS.overrides = prev
+
+
+def _record(cfg: TuneConfig, fp: int, bucket: int) -> None:
+    origins = dict(cfg.origin)
+    any_store = any(o.startswith("store") for o in origins.values())
+    any_env = any(o == "env" for o in origins.values())
+    with _LOCK:
+        fresh = cfg.store_key not in _PROV
+        if fresh:
+            _PROV[cfg.store_key] = {
+                "key": cfg.store_key,
+                "tile_rows": cfg.tile_rows,
+                "packed_tile_cap": cfg.packed_tile_cap,
+                "packed_vmem_limit": cfg.packed_vmem_limit,
+                "origin": origins,
+            }
+    if _metrics._ACTIVE:
+        _metrics.inc("tune.store_hits" if any_store else "tune.fallbacks")
+        if any_env:
+            _metrics.inc("tune.env_overrides")
+    if fresh:
+        ctx = _trace._CURRENT
+        if ctx is not None:
+            _logging.emit({"event": "tune_resolved", "key": cfg.store_key,
+                           "tile_rows": cfg.tile_rows,
+                           "packed_tile_cap": cfg.packed_tile_cap,
+                           "packed_vmem_limit": cfg.packed_vmem_limit,
+                           "origin": origins, "fp": fp, "bucket": bucket},
+                          ctx.log_path)
+
+
+def provenance_snapshot() -> Dict[str, Dict[str, Any]]:
+    with _LOCK:
+        return {k: dict(v) for k, v in _PROV.items()}
+
+
+def reset_provenance() -> None:
+    with _LOCK:
+        _PROV.clear()
+
+
+def resolve(*, strategy: str, dtype: str, fp: int, n_rows: int = 0,
+            store: Optional[str] = None) -> TuneConfig:
+    """The TuneConfig for one call site.  ``fp`` is the padded feature
+    width the kernel sees, ``n_rows`` the (padded) DB row count the
+    shape bucket is derived from (0 = unknown -> wildcard bucket)."""
+    fp = max(_geometry.round_up(max(int(fp), 1), 128), 128)
+    bucket = _buckets.bucket_rows(int(n_rows)) if n_rows else 0
+    dev = device_kind()
+    key = make_key(dev, strategy, dtype, fp, bucket)
+    wild = make_key(dev, strategy, dtype, fp, "*")
+
+    entries = _store.load_entries(store)
+    exact = entries.get(key)
+    wildcard = entries.get(wild)
+    overrides = getattr(_TLS, "overrides", None) or {}
+
+    defaults = {
+        "tile_rows": _geometry.default_tile_rows(fp),
+        "packed_tile_cap": _geometry.DEFAULT_PACKED_TILE_CAP,
+        "packed_vmem_limit": _geometry.DEFAULT_PACKED_VMEM_LIMIT,
+    }
+    values: Dict[str, int] = {}
+    origin: Dict[str, str] = {}
+    for knob, dflt in defaults.items():
+        if knob in overrides:
+            values[knob], origin[knob] = int(overrides[knob]), "override"
+            continue
+        env = _env_int(knob)
+        if env is not None:
+            values[knob], origin[knob] = env, "env"
+            continue
+        if exact is not None and knob in exact:
+            values[knob], origin[knob] = int(exact[knob]), "store"
+            continue
+        if wildcard is not None and knob in wildcard:
+            values[knob] = int(wildcard[knob])
+            origin[knob] = "store_wildcard"
+            continue
+        values[knob], origin[knob] = dflt, "default"
+
+    cfg = TuneConfig(key=key, store_key=key,
+                     origin=tuple(sorted(origin.items())), **values)
+    _record(cfg, fp, bucket)
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# Call-site conveniences: each maps one legacy helper onto a resolution.
+
+
+def _norm_dtype(dtype: str) -> str:
+    return {"float32": "f32", "bfloat16": "bf16"}.get(dtype, dtype)
+
+
+def tile_rows(f: int, *, strategy: str = "wavefront", dtype: str = "f32",
+              n_rows: int = 0, store: Optional[str] = None) -> int:
+    """Argmin tile rows for feature width ``f`` (legacy ``_tile_rows``)."""
+    cfg = resolve(strategy=strategy, dtype=_norm_dtype(dtype), fp=f,
+                  n_rows=n_rows, store=store)
+    return cfg.tile_rows
+
+
+def packed_vmem_limit(*, strategy: str = "wavefront",
+                      dtype: str = "packed2", fp: int = 128,
+                      n_rows: int = 0, store: Optional[str] = None) -> int:
+    cfg = resolve(strategy=strategy, dtype=_norm_dtype(dtype), fp=fp,
+                  n_rows=n_rows, store=store)
+    return cfg.packed_vmem_limit
+
+
+def packed_tile_cap(hb: int, wb: int, n_off: int, *,
+                    strategy: str = "wavefront", dtype: str = "packed2",
+                    fp: int = 128, n_rows: int = 0,
+                    store: Optional[str] = None) -> int:
+    """VMEM-bounded packed-scan cap (legacy ``_packed_tile_cap``) with
+    the two budget knobs resolved through the store/env chain."""
+    cfg = resolve(strategy=strategy, dtype=_norm_dtype(dtype), fp=fp,
+                  n_rows=n_rows, store=store)
+    return _geometry.vmem_bounded_tile_cap(
+        hb, wb, n_off, cfg.packed_tile_cap, cfg.packed_vmem_limit)
+
+
+def scan_tile(npad: int, fp: int, cap_rows: int = 0, *,
+              strategy: str = "wavefront", dtype: str = "bf16",
+              store: Optional[str] = None) -> int:
+    """Anchor-scan tile (legacy ``_scan_tile``): cap defaults to half the
+    resolved tile_rows for ``fp``, exactly like the legacy default."""
+    if not cap_rows:
+        cap_rows = tile_rows(fp, strategy=strategy, dtype=dtype,
+                             n_rows=npad, store=store) // 2
+    return _geometry.scan_tile_rows(npad, cap_rows)
+
+
+def snap_tile_to_divisor(tile: int, npad: int) -> int:
+    """Largest value <= tile that divides npad (>=1): belt-and-braces so
+    a store/env-supplied tile can never trip a kernel divisibility
+    assert.  Resolved defaults already divide every legal npad."""
+    tile = max(min(int(tile), int(npad)), 1)
+    g = math.gcd(tile, npad)
+    if g == tile:
+        return tile
+    # largest divisor of npad not exceeding tile
+    best = 1
+    d = 1
+    while d * d <= npad:
+        if npad % d == 0:
+            if d <= tile:
+                best = max(best, d)
+            q = npad // d
+            if q <= tile:
+                best = max(best, q)
+        d += 1
+    return best
+
+
+def manifest_info(store: Optional[str] = None) -> Dict[str, Any]:
+    """Run-manifest extras: where the store lives and how warm it is."""
+    path = _store.store_path(store)
+    entries = _store.load_entries(path)
+    return {"tune_store": path, "tune_entries": len(entries)}

@@ -1,0 +1,162 @@
+"""Persistent tune store: measured geometry winners, keyed per device.
+
+One JSON file holds every tuned ``TuneConfig``:
+
+    {
+      "version": 1,
+      "entries": {
+        "TPU v4|wavefront|packed2|f256|b4096": {
+          "tile_rows": 8192,
+          "packed_tile_cap": 16384,
+          "packed_vmem_limit": 115343360,
+          "source": "ia tune",           # free-form provenance
+          "measured_ms": 5.08            # optional, informational
+        },
+        ...
+      }
+    }
+
+Path precedence: explicit argument > ``IA_TUNE_STORE`` env > the
+repo-local default ``<repo>/.ia_tune.json``.  Loading is cached on
+(path, mtime, size) so the resolution layer can consult the store on
+every call without re-reading the file; a corrupt or invalid store emits
+one ``tune_store_error`` warning record (when a run is active) and
+resolves as empty — never a crash, never partial entries.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from image_analogies_tpu.obs import trace as _trace
+from image_analogies_tpu.utils import logging as _logging
+
+SCHEMA_VERSION = 1
+
+# Integer knobs an entry may carry; each must be a positive int when
+# present.  Unknown keys are allowed (provenance annotations).
+_KNOBS = ("tile_rows", "packed_tile_cap", "packed_vmem_limit")
+
+_LOCK = threading.Lock()
+# path -> ((mtime_ns, size), entries)
+_CACHE: Dict[str, Tuple[Tuple[int, int], Dict[str, Dict[str, Any]]]] = {}
+_WARNED: set = set()  # paths whose corruption was already reported
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def store_path(explicit: Optional[str] = None) -> str:
+    if explicit:
+        return explicit
+    env = os.environ.get("IA_TUNE_STORE", "").strip()
+    if env:
+        return env
+    return os.path.join(_repo_root(), ".ia_tune.json")
+
+
+def invalidate_cache() -> None:
+    with _LOCK:
+        _CACHE.clear()
+        _WARNED.clear()
+
+
+def _warn(path: str, reason: str) -> None:
+    """One tune_store_error warning per corrupt path per process; routed
+    to the active run's log when there is one."""
+    with _LOCK:
+        if path in _WARNED:
+            return
+        _WARNED.add(path)
+    ctx = _trace._CURRENT
+    _logging.emit({"event": "tune_store_error", "severity": "warning",
+                   "path": path, "reason": reason},
+                  ctx.log_path if ctx is not None else None)
+
+
+def validate_entry(entry: Any) -> bool:
+    if not isinstance(entry, dict):
+        return False
+    for k in _KNOBS:
+        if k in entry:
+            v = entry[k]
+            if not isinstance(v, int) or isinstance(v, bool) or v <= 0:
+                return False
+    return True
+
+
+def _parse(raw: Any, path: str) -> Dict[str, Dict[str, Any]]:
+    if not isinstance(raw, dict):
+        _warn(path, "store root is not an object")
+        return {}
+    if raw.get("version") != SCHEMA_VERSION:
+        _warn(path, f"unsupported store version {raw.get('version')!r}")
+        return {}
+    entries = raw.get("entries")
+    if not isinstance(entries, dict):
+        _warn(path, "store has no entries object")
+        return {}
+    out: Dict[str, Dict[str, Any]] = {}
+    for key, entry in entries.items():
+        if isinstance(key, str) and validate_entry(entry):
+            out[key] = entry
+        else:
+            _warn(path, f"invalid entry for key {key!r}")
+    return out
+
+
+def load_entries(path: Optional[str] = None) -> Dict[str, Dict[str, Any]]:
+    """Validated entries of the store at ``path`` (resolved via
+    :func:`store_path`); ``{}`` for missing/corrupt stores."""
+    path = store_path(path)
+    try:
+        st = os.stat(path)
+    except OSError:
+        return {}
+    stamp = (st.st_mtime_ns, st.st_size)
+    with _LOCK:
+        cached = _CACHE.get(path)
+        if cached is not None and cached[0] == stamp:
+            return cached[1]
+    try:
+        with open(path) as f:
+            raw = json.load(f)
+    except (OSError, ValueError) as e:
+        _warn(path, f"unreadable store: {e}")
+        return {}
+    entries = _parse(raw, path)
+    with _LOCK:
+        _CACHE[path] = (stamp, entries)
+    return entries
+
+
+def save_entries(entries: Dict[str, Dict[str, Any]],
+                 path: Optional[str] = None) -> str:
+    """Atomically write ``entries`` (replacing the whole store)."""
+    path = store_path(path)
+    for key, entry in entries.items():
+        if not (isinstance(key, str) and validate_entry(entry)):
+            raise ValueError(f"invalid tune entry for key {key!r}")
+    blob = json.dumps({"version": SCHEMA_VERSION, "entries": entries},
+                      indent=2, sort_keys=True)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(blob + "\n")
+    os.replace(tmp, path)
+    invalidate_cache()
+    return path
+
+
+def merge_entries(new: Dict[str, Dict[str, Any]],
+                  path: Optional[str] = None) -> str:
+    """Merge ``new`` into the store at ``path`` (new keys win)."""
+    merged = dict(load_entries(path))
+    merged.update(new)
+    return save_entries(merged, path)

@@ -1,0 +1,96 @@
+"""Warmup + runtime wiring: persistent compile cache, AOT pre-compile.
+
+``maybe_enable_compile_cache`` points JAX's persistent compilation cache
+at ``AnalogyParams.compile_cache_dir`` (env ``IA_COMPILE_CACHE_DIR``
+overrides) so program compiles survive process restarts — the natural
+partner of shape-bucketing, which collapses the set of signatures worth
+caching.  ``warmup`` runs one tiny-but-real synthesis at a target
+resolution so every jit signature for that shape class is compiled (and,
+with the cache enabled, persisted) before serving traffic; ``ia warmup``
+is its CLI face.  ``apply_runtime_config`` is the one call the engine
+makes per run to apply both this and the devcache budget.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+_CACHE_DIRS_APPLIED: set = set()
+
+
+def compile_cache_dir(params: Any = None) -> Optional[str]:
+    env = os.environ.get("IA_COMPILE_CACHE_DIR", "").strip()
+    if env:
+        return env
+    return getattr(params, "compile_cache_dir", None)
+
+
+def maybe_enable_compile_cache(params: Any = None) -> Optional[str]:
+    """Idempotently enable JAX's persistent compilation cache when
+    configured; returns the dir in effect (None = disabled)."""
+    d = compile_cache_dir(params)
+    if not d or d in _CACHE_DIRS_APPLIED:
+        return d
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", d)
+    # Cache even fast compiles: warmup exists to make serving compiles
+    # zero, not just the slow ones.  Knob names vary across jax
+    # versions; best-effort.
+    for knob, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                      ("jax_persistent_cache_min_entry_size_bytes", -1)):
+        try:
+            jax.config.update(knob, val)
+        except Exception:
+            pass
+    _CACHE_DIRS_APPLIED.add(d)
+    return d
+
+
+def apply_runtime_config(params: Any = None) -> None:
+    """Per-run runtime wiring: compile cache + devcache byte budget."""
+    maybe_enable_compile_cache(params)
+    from image_analogies_tpu.utils import devcache
+
+    mb = getattr(params, "devcache_max_bytes", None)
+    if mb:
+        devcache.set_max_bytes(int(mb))
+
+
+def warmup(params: Any, height: int, width: int, *,
+           exemplar_height: Optional[int] = None,
+           exemplar_width: Optional[int] = None,
+           seed: int = 0) -> Dict[str, Any]:
+    """AOT-compile the jit signatures for a target B resolution by
+    running one real synthesis on synthetic planes.  With shape
+    bucketing on, any image whose per-level row counts land in the same
+    buckets then reuses these programs; with the persistent compile
+    cache configured, later PROCESSES skip the XLA compiles too.
+
+    Returns the compile counters of the warmup run."""
+    import numpy as np
+
+    from image_analogies_tpu.models.analogy import create_image_analogy
+    from image_analogies_tpu.obs import metrics as _metrics
+    from image_analogies_tpu.obs import trace as _trace
+
+    eh = exemplar_height or height
+    ew = exemplar_width or width
+    rng = np.random.RandomState(seed)
+    a = rng.rand(eh, ew).astype(np.float32)
+    ap = rng.rand(eh, ew).astype(np.float32)
+    b = rng.rand(height, width).astype(np.float32)
+    wp = params.replace(metrics=True, checkpoint_dir=None,
+                        resume_from_level=None, save_levels_dir=None)
+    with _trace.run_scope(wp):
+        create_image_analogy(a, ap, b, wp)
+        snap = _metrics.snapshot() or {}
+    counters = snap.get("counters", {})
+    return {"height": height, "width": width,
+        "exemplar": [eh, ew],
+        "levels": wp.levels,
+        "compile_count": counters.get("compile.count", 0),
+        "compile_ms": counters.get("compile.ms", 0),
+        "compile_cache_hits": counters.get("compile.cache_hits", 0),
+        "compile_cache_dir": compile_cache_dir(wp)}

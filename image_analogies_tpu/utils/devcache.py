@@ -19,6 +19,7 @@ jax.clear_caches anyway producing fresh uploads).
 from __future__ import annotations
 
 import hashlib
+import os
 from collections import OrderedDict
 from typing import Optional
 
@@ -26,15 +27,40 @@ import numpy as np
 
 from image_analogies_tpu.obs import metrics as obs_metrics
 
-_MAX_BYTES = 1 << 30  # 1 GiB of cached device inputs
+_DEFAULT_MAX_BYTES = 1 << 30  # 1 GiB of cached device inputs
+_configured_max: Optional[int] = None
 _cache: "OrderedDict[tuple, object]" = OrderedDict()
 _bytes = 0
+
+
+def max_bytes() -> int:
+    """Effective byte budget: env IA_DEVCACHE_BYTES > configured > 1 GiB.
+    Read at call time so tests/operators can flip it on a live process."""
+    env = os.environ.get("IA_DEVCACHE_BYTES", "").strip()
+    if env:
+        try:
+            n = int(env)
+            if n > 0:
+                return n
+        except ValueError:
+            pass
+    if _configured_max:
+        return _configured_max
+    return _DEFAULT_MAX_BYTES
+
+
+def set_max_bytes(n: Optional[int]) -> None:
+    """Configure the budget (AnalogyParams.devcache_max_bytes plumbs
+    here); None restores the default.  Env still wins."""
+    global _configured_max
+    _configured_max = int(n) if n else None
 
 
 def clear() -> None:
     global _bytes
     _cache.clear()
     _bytes = 0
+    obs_metrics.set_gauge("devcache.bytes", 0)
 
 
 def device_put_cached(x, dtype=None):
@@ -70,16 +96,19 @@ def device_put_cached(x, dtype=None):
         _bytes -= arr.nbytes
         _cache.pop(key, None)
         obs_metrics.inc("devcache.dead_evictions")
+        obs_metrics.set_gauge("devcache.bytes", _bytes)
     dev = jax.device_put(jnp.asarray(arr))
     _cache[key] = dev
     _bytes += arr.nbytes
     obs_metrics.inc("devcache.misses")
     obs_metrics.inc("devcache.upload_bytes", arr.nbytes)
-    while _bytes > _MAX_BYTES and _cache:
+    limit = max_bytes()
+    while _bytes > limit and _cache:
         _, old = _cache.popitem(last=False)
         obs_metrics.inc("devcache.evictions")
         try:
             _bytes -= int(np.prod(old.shape)) * old.dtype.itemsize
         except Exception:  # pragma: no cover
             pass
+    obs_metrics.set_gauge("devcache.bytes", _bytes)
     return dev

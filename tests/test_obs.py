@@ -448,16 +448,14 @@ def test_disabled_path_no_records_no_allocations(tmp_path):
 # ------------------------------------------------- shared VMEM tile cap
 
 def test_packed_tile_cap_shrinks_with_wide_b():
-    from image_analogies_tpu.backends.tpu import (
-        _PACKED_TILE_CAP,
-        _packed_tile_cap,
-    )
+    from image_analogies_tpu.tune import resolve as tune
+    from image_analogies_tpu.tune.geometry import DEFAULT_PACKED_TILE_CAP
 
     # north-star geometry (1024^2, 5x5 patches): plateau M ~ 344 keeps
     # the full round-5 tile raise
-    assert _packed_tile_cap(1024, 1024, 25) == _PACKED_TILE_CAP
+    assert tune.packed_tile_cap(1024, 1024, 25) == DEFAULT_PACKED_TILE_CAP
     # a ~4096-wide B plateaus at M ~ 1365: the cap must shrink below the
     # fixed 16384 rows or the (M, tile) f32 block blows the VMEM budget
-    wide = _packed_tile_cap(4096, 4096, 25)
-    assert wide < _PACKED_TILE_CAP
+    wide = tune.packed_tile_cap(4096, 4096, 25)
+    assert wide < DEFAULT_PACKED_TILE_CAP
     assert wide >= 256 and (wide & (wide - 1)) == 0  # power of two

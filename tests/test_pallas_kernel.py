@@ -386,14 +386,15 @@ def test_exact_hi2_level_build_and_anchor_shapes():
     # possible (pallas only dispatches on TPU), but the pad geometry +
     # live-column bookkeeping must hold for any spec; lock the invariants
     # the anchor relies on: 2L <= packed width, live mask matches the
-    # causal structure, _scan_tile divides every realizable npad.
-    from image_analogies_tpu.backends.tpu import _scan_tile, _tile_rows
+    # causal structure, the scan tile divides every realizable npad.
+    from image_analogies_tpu.tune import resolve as tune
+    from image_analogies_tpu.tune.geometry import default_tile_rows
     from image_analogies_tpu.ops.features import spec_for_level
     from image_analogies_tpu.config import AnalogyParams
 
     # (3, 7) gives spec.total=309 -> fp=384, the config whose un-rounded
     # 2730-row build tile used to leave npads with no power-of-2 divisor
-    # above 2 (review round 3) — _tile_rows now rounds to multiples of 256
+    # above 2 (review round 3) — tile_rows now rounds to multiples of 256
     for src_channels, patch in ((1, 5), (3, 5), (1, 7), (3, 7)):
         spec = spec_for_level(AnalogyParams(patch_size=patch), 0, 3,
                               src_channels)
@@ -404,14 +405,14 @@ def test_exact_hi2_level_build_and_anchor_shapes():
         assert l == spec.total - dead
         pk = max((2 * l + 127) // 128 * 128, 128)
         assert 2 * l <= pk
-        assert _tile_rows(spec.total) % 256 == 0
+        assert default_tile_rows(spec.total) % 256 == 0
         # every realizable npad (multiple of the build pad tile, which the
         # backend rounds to multiples of 256) is divisible by the scan tile
         for na in (130, 4096, 6784, 65536, 262144, 1048576):
-            pad_tile = min(_tile_rows(spec.total),
+            pad_tile = min(tune.tile_rows(spec.total),
                            max((na + 255) // 256 * 256, 256))
             npad = (na + pad_tile - 1) // pad_tile * pad_tile
-            tile = _scan_tile(npad, pk)
+            tile = tune.scan_tile(npad, pk)
             assert npad % tile == 0, (na, npad, tile)
             assert tile >= 128  # the halving loop may stop one below 256
 
