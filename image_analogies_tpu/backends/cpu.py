@@ -10,7 +10,10 @@ approximate match when ANN is off.
 
 from __future__ import annotations
 
+import hashlib
+import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Any, Dict, Optional, Tuple
 
@@ -46,13 +49,60 @@ class CpuLevelDB:
     offsets: np.ndarray  # (n_fine, 2) window offsets
 
 
+def _a_side_key(spec, job: LevelJob, use_ann: bool) -> str:
+    """Content digest of everything the A-side build consumes."""
+    h = hashlib.sha1()
+    h.update(repr((spec, job.a_shape, use_ann)).encode())
+    for arr in (job.a_src, job.a_filt, job.a_src_coarse, job.a_filt_coarse,
+                job.a_temporal):
+        if arr is None:
+            h.update(b"-")
+        else:
+            a = np.ascontiguousarray(np.asarray(arr))
+            h.update(str((a.shape, a.dtype)).encode())
+            h.update(a.tobytes())
+    return h.hexdigest()
+
+
 class CpuMatcher(Matcher):
-    def build_features(self, job: LevelJob) -> CpuLevelDB:
-        spec = job.spec
+    # A-side memo: (db, tree, a_filt_flat) keyed by exemplar content.
+    # Per-INSTANCE, so the default engine path (fresh matcher per
+    # create_image_analogy call) is untouched; the win appears when
+    # serve/ shares one backend across a batch with identical exemplars —
+    # the expensive feature build + KD-tree construction then runs once
+    # per level instead of once per request.  Bounded LRU; lock because
+    # serve workers may share an instance across threads.
+    _A_MEMO_CAP = 16
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._a_memo: "OrderedDict[str, tuple]" = OrderedDict()
+        self._a_memo_lock = threading.Lock()
+
+    def _a_side(self, spec, job: LevelJob):
+        use_ann = bool(self.params.use_ann and cKDTree is not None)
+        key = _a_side_key(spec, job, use_ann)
+        with self._a_memo_lock:
+            hit = self._a_memo.get(key)
+            if hit is not None:
+                self._a_memo.move_to_end(key)
+                return hit
         db = build_features_np(
             spec, job.a_src, job.a_filt, job.a_src_coarse, job.a_filt_coarse,
             temporal_fine=job.a_temporal,
         )
+        tree = cKDTree(db) if use_ann else None
+        a_filt_flat = np.asarray(job.a_filt, np.float32).reshape(-1)
+        entry = (db, tree, a_filt_flat)
+        with self._a_memo_lock:
+            self._a_memo[key] = entry
+            while len(self._a_memo) > self._A_MEMO_CAP:
+                self._a_memo.popitem(last=False)
+        return entry
+
+    def build_features(self, job: LevelJob) -> CpuLevelDB:
+        spec = job.spec
+        db, tree, a_filt_flat = self._a_side(spec, job)
         static_q = build_features_np(
             spec, job.b_src, None, job.b_src_coarse, job.b_filt_coarse,
             temporal_fine=job.b_temporal,
@@ -60,12 +110,10 @@ class CpuMatcher(Matcher):
         hb, wb = job.b_shape
         ha, wa = job.a_shape
         flat_idx, valid, written = fine_gather_maps(hb, wb, spec.fine_size)
-        tree = (cKDTree(db) if (self.params.use_ann and cKDTree is not None)
-                else None)
         return CpuLevelDB(
             db=db,
             tree=tree,
-            a_filt_flat=np.asarray(job.a_filt, np.float32).reshape(-1),
+            a_filt_flat=a_filt_flat,
             wa=wa,
             ha=ha,
             static_q=static_q,

@@ -537,8 +537,14 @@ def _cached_sharded_db_builder(mesh, spec, pad_full: bool, npad: int,
 
     def build(a_src, a_filt, a_src_coarse, a_filt_coarse, a_temporal,
               rowsafe):
+        # edge_gather: this program compiles with row-sharded out_shardings,
+        # where the SPMD partitioner miscompiles the edge-pad window build
+        # (every element exactly doubled when the per-shard row count is
+        # not a multiple of the image width) — the clip-gather twin is
+        # bit-identical and partitions correctly (ops/features.py).
         db = build_features_jax(spec, a_src, a_filt, a_src_coarse,
-                                a_filt_coarse, temporal_fine=a_temporal)
+                                a_filt_coarse, temporal_fine=a_temporal,
+                                edge_gather=True)
         if not pad_full:  # batched scores against the rowsafe-masked DB
             db = db.at[:, spec.fine_filt_slice].multiply(rowsafe[None, :])
         dbn = jnp.sum(db * db, axis=1)

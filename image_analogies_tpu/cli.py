@@ -312,6 +312,63 @@ def cmd_warmup(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """Serving scheduler (serve/): micro-batching with admission control,
+    deadlines, and graceful degradation.  --selftest replays a synthetic
+    mixed-shape load and prints the latency/throughput summary; --http
+    binds the optional loopback stdlib front end."""
+    from image_analogies_tpu.serve.server import Server
+    from image_analogies_tpu.serve.types import ServeConfig
+
+    base = PRESETS["oil_filter"]
+    params = _params_from_args(args, base)
+    warmup_sizes = ()
+    if args.warmup:
+        warmup_sizes = tuple(
+            tuple(int(x) for x in chunk.split("x"))
+            for chunk in args.warmup.split(","))
+    cfg = ServeConfig(
+        params=params,
+        queue_depth=args.queue_depth,
+        batch_window_ms=args.batch_window_ms,
+        max_batch=args.max_batch,
+        workers=args.workers,
+        default_deadline_s=(None if args.deadline_ms is None
+                            else args.deadline_ms / 1e3),
+        degrade=not args.no_degrade,
+        request_retries=args.request_retries,
+        warmup_sizes=warmup_sizes,
+    )
+
+    if args.selftest is not None:
+        from image_analogies_tpu.serve import loadgen
+
+        summary = loadgen.selftest(cfg, args.selftest, seed=args.seed,
+                                   deadline_ms=args.deadline_ms)
+        print(loadgen.render(summary))
+        print(json.dumps(summary, sort_keys=True), file=sys.stderr)
+        return 0 if (summary["errors"] == 0
+                     and summary["bit_identical"]) else 1
+
+    if args.http is None:
+        print("serve: pass --selftest N or --http PORT", file=sys.stderr)
+        return 2
+
+    from image_analogies_tpu.serve.http import serve_http
+
+    with Server(cfg) as srv:
+        httpd = serve_http(srv, args.http)
+        print(f"serving on http://127.0.0.1:{args.http} "
+              f"(POST /v1/analogy, GET /healthz); Ctrl-C to drain+exit")
+        try:
+            httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            httpd.shutdown()
+    return 0
+
+
 def cmd_trace(args) -> int:
     """Convert a run-log JSONL into a Chrome/Perfetto trace.json
     (obs/export.py) for chrome://tracing / ui.perfetto.dev."""
@@ -428,6 +485,44 @@ def build_parser() -> argparse.ArgumentParser:
     tn.add_argument("--no-persist", action="store_true",
                     help="measure + verify but do not write the store")
     tn.set_defaults(fn=cmd_tune)
+
+    sv = sub.add_parser("serve",
+                        help="serving scheduler: micro-batched dispatch "
+                             "with admission control, per-request "
+                             "deadlines, and graceful degradation "
+                             "(--selftest N for the synthetic load, "
+                             "--http PORT for the loopback front end)")
+    sv.add_argument("--selftest", type=int, default=None, metavar="N",
+                    help="replay N synthetic mixed-shape requests against "
+                         "a sequential baseline and print the latency/"
+                         "throughput/degradation summary")
+    sv.add_argument("--http", type=int, default=None, metavar="PORT",
+                    help="bind the loopback-only stdlib HTTP front end")
+    sv.add_argument("--queue-depth", type=int, default=32,
+                    help="admission bound; requests beyond it are "
+                         "Rejected(queue_full) immediately")
+    sv.add_argument("--batch-window-ms", type=float, default=4.0,
+                    help="coalescing window once a batch leader is held")
+    sv.add_argument("--max-batch", type=int, default=8)
+    sv.add_argument("--workers", type=int, default=2)
+    sv.add_argument("--deadline-ms", type=float, default=None,
+                    help="default per-request deadline; expired before "
+                         "dispatch -> cancelled, unmeetable -> degraded "
+                         "(fewer levels / coarser patch), flagged in the "
+                         "response")
+    sv.add_argument("--no-degrade", action="store_true",
+                    help="never degrade: unmeetable deadlines run full "
+                         "fidelity anyway (only already-expired requests "
+                         "time out)")
+    sv.add_argument("--request-retries", type=int, default=1,
+                    help="transparent retries around each dispatch on "
+                         "transient device faults")
+    sv.add_argument("--warmup", default=None, metavar="SIZES",
+                    help="comma-separated HxW list (e.g. 64x64,128x128) to "
+                         "AOT-precompile before accepting traffic")
+    sv.add_argument("--seed", type=int, default=0)
+    _add_engine_flags(sv)
+    sv.set_defaults(fn=cmd_serve)
 
     wu = sub.add_parser("warmup",
                         help="AOT-compile jit signatures for a target "

@@ -25,13 +25,22 @@ EXPERIMENTAL_MATCH_MODES = ("scan_rescue", "scan_rescue_1p",
                             "two_pass", "two_pass_1p")
 
 
+def env_truthy(name: str, default: bool = False) -> bool:
+    """Fail-closed boolean env gate: only explicit truthy spellings count,
+    so typos and falsey values ("0", "disabled", ...) never open a gate.
+    Unset returns ``default``.  The one spelling of this check — config
+    gates (IA_EXPERIMENTAL) and serve/ env toggles share it instead of
+    re-deriving their own truthiness rules."""
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    return raw.strip().lower() in ("1", "true", "yes", "on")
+
+
 def experimental_enabled() -> bool:
-    """True when IA_EXPERIMENTAL opts into the non-parity probe modes.
-    FAILS CLOSED: only explicit truthy spellings open the gate, so typos
-    and falsey values ("0", "disabled", ...) never unlock non-parity
-    modes in production."""
-    return (os.environ.get("IA_EXPERIMENTAL", "").strip().lower()
-            in ("1", "true", "yes", "on"))
+    """True when IA_EXPERIMENTAL opts into the non-parity probe modes
+    (fails closed — see :func:`env_truthy`)."""
+    return env_truthy("IA_EXPERIMENTAL")
 
 
 @dataclass(frozen=True)

@@ -152,11 +152,16 @@ def create_image_analogy(
     # log_path is set; joins the enclosing run when video already opened
     # one (single run_id per clip).  The manifest records the tune-store
     # provenance so a report ties results to the geometry they ran with.
+    # Geometry is pinned per INVOCATION (tune pin_scope, reentrant: a
+    # clip's outer per-clip pin wins): every level and retry of this run
+    # bakes the same resolved ints, and a serve/ worker re-dispatching
+    # the same shapes never re-reads the store mid-request.
     with obs_trace.run_scope(params,
                              manifest_extra=tune_resolve.manifest_info()):
-        return _create_image_analogy(a, ap, b, params, backend,
-                                     temporal_prev, remap_anchor,
-                                     keep_levels)
+        with tune_resolve.pin_scope():
+            return _create_image_analogy(a, ap, b, params, backend,
+                                         temporal_prev, remap_anchor,
+                                         keep_levels)
 
 
 def _create_image_analogy(a, ap, b, params, backend, temporal_prev,

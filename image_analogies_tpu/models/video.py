@@ -45,6 +45,7 @@ from image_analogies_tpu.obs import device as obs_device
 from image_analogies_tpu.obs import metrics as obs_metrics
 from image_analogies_tpu.obs import trace as obs_trace
 from image_analogies_tpu.ops import color
+from image_analogies_tpu.tune import resolve as tune_resolve
 from image_analogies_tpu.utils import failure
 from image_analogies_tpu.utils import logging as ialog
 
@@ -360,9 +361,36 @@ def video_analogy(
 ) -> VideoResult:
     # one observability run per CLIP: the per-frame engine calls below
     # join this scope (reentrant run_scope) instead of minting their own
-    # run_ids
+    # run_ids.  Likewise one TUNE resolution per clip: pin_scope caches
+    # the first consult of each geometry key, so every frame batch bakes
+    # identical kernel ints (byte-comparable frame timings) and the
+    # provenance counters record one consult per clip, not per frame.
     with obs_trace.run_scope(params):
-        return _video_analogy(a, ap, frames, params, scheme, backend)
+        with tune_resolve.pin_scope():
+            if len(frames) > 0:
+                _pin_clip_geometry(a, frames, params)
+            return _video_analogy(a, ap, frames, params, scheme, backend)
+
+
+def _pin_clip_geometry(a, frames, params: AnalogyParams) -> None:
+    """Resolve the clip's finest-level kernel geometry up front, inside
+    the clip's pin scope: later per-level/per-frame consults of the same
+    key (the mesh path's ``tune.tile_rows`` calls) return this pinned
+    config without touching the store again."""
+    from image_analogies_tpu.ops.features import spec_for_level
+    from image_analogies_tpu.ops.pyramid import num_feasible_levels
+
+    a_np = np.asarray(a)
+    strategy = "wavefront" if params.strategy == "auto" else params.strategy
+    shapes = [np.asarray(f).shape for f in frames]
+    min_shape = (min([a_np.shape[0]] + [s[0] for s in shapes]),
+                 min([a_np.shape[1]] + [s[1] for s in shapes]))
+    levels = num_feasible_levels(min_shape, params.levels, params.patch_size)
+    src_channels = (1 if params.color_mode == "yiq_transfer"
+                    or a_np.ndim == 2 else a_np.shape[-1])
+    spec = spec_for_level(params, 0, levels, src_channels,
+                          temporal=params.temporal_weight > 0)
+    tune_resolve.tile_rows(spec.total, strategy=strategy, dtype="f32")
 
 
 def _video_analogy(a, ap, frames, params, scheme, backend) -> VideoResult:

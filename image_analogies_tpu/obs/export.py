@@ -31,8 +31,10 @@ from image_analogies_tpu.obs.report import _is_level_stat, load_records
 HOST_TID = 1
 DEVICE_TID = 2
 COMPILE_TID = 3
+SERVE_TID = 4
 
-_TID_NAMES = {HOST_TID: "host", DEVICE_TID: "device", COMPILE_TID: "compile"}
+_TID_NAMES = {HOST_TID: "host", DEVICE_TID: "device", COMPILE_TID: "compile",
+              SERVE_TID: "serve"}
 
 # bookkeeping fields that don't belong in an event's args payload
 _DROP_ARGS = ("ts",)
@@ -42,11 +44,23 @@ def _classify(rec: Dict[str, Any]) -> Tuple[str, int, str, Optional[float]]:
     """(ph, tid, name, dur_ms) of one record."""
     ev = rec.get("event")
     if ev == "span":
-        return "X", HOST_TID, str(rec.get("name", "span")), \
+        tid = (SERVE_TID if rec.get("name") in ("serve_batch",
+                                                "serve_dispatch",
+                                                "serve_warmup")
+               else HOST_TID)
+        return "X", tid, str(rec.get("name", "span")), \
             float(rec.get("wall_ms", 0.0))
     if ev == "compile":
         return "X", COMPILE_TID, f"compile {rec.get('name', '?')}", \
             float(rec.get("ms", 0.0))
+    if ev == "serve_request":
+        # emitted at completion with total_ms = enqueue->done, so the
+        # ph=X interval spans the request's whole lifetime on the serve
+        # track; queue_ms/dispatch_ms ride in args for inspection
+        return ("X", SERVE_TID,
+                f"req {rec.get('request', '?')} "
+                f"{rec.get('status', '?')}",
+                float(rec.get("total_ms", 0.0)))
     if ev is None and _is_level_stat(rec):
         dur = rec.get("ms", rec.get("enqueue_ms", 0.0))
         name = f"L{rec['level']}"

@@ -47,12 +47,33 @@ class Histogram:
         k = max(0, math.frexp(value)[1]) if value > 0 else 0
         self.buckets[k] = self.buckets.get(k, 0) + 1
 
+    def percentile(self, q: float) -> float:
+        """Estimated q-th percentile from the base-2 buckets: the upper
+        edge of the bucket holding that rank, clamped to the observed
+        max.  Coarse by construction (buckets are octaves) but monotone
+        and dependency-free — good enough for serving-latency p50/p95."""
+        if not self.count:
+            return 0.0
+        rank = max(1, math.ceil(q / 100.0 * self.count))
+        cum = 0
+        for k in sorted(self.buckets):
+            cum += self.buckets[k]
+            if cum >= rank:
+                edge = float(2 ** k) if k > 0 else 0.0
+                return min(edge, self.max)
+        return self.max
+
     def summary(self) -> Dict[str, float]:
         if not self.count:
             return {"count": 0, "sum": 0.0, "min": 0.0, "max": 0.0,
                     "mean": 0.0}
+        # buckets ride along (run_end snapshots feed `ia report`'s
+        # batch-size histogram); the empty-histogram summary keeps its
+        # legacy shape.
         return {"count": self.count, "sum": self.total, "min": self.min,
-                "max": self.max, "mean": self.total / self.count}
+                "max": self.max, "mean": self.total / self.count,
+                "buckets": {str(k): v
+                            for k, v in sorted(self.buckets.items())}}
 
 
 class MetricsRegistry:

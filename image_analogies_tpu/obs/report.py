@@ -171,6 +171,7 @@ def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             "store": (manifest or {}).get("tune_store"),
             "store_entries": (manifest or {}).get("tune_entries"),
             "store_hits": int(counters.get("tune.store_hits", 0)),
+            "packaged": int(counters.get("tune.packaged", 0)),
             "fallbacks": int(counters.get("tune.fallbacks", 0)),
             "env_overrides": int(counters.get("tune.env_overrides", 0)),
             "errors": len(tune_errors),
@@ -178,6 +179,46 @@ def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                          ("key", "tile_rows", "packed_tile_cap",
                           "packed_vmem_limit", "origin") if k in r}
                         for r in tune_resolved],
+        }
+
+    # --- serving section (serve_request records + serve.* counters) -------
+    serve_reqs = [r for r in records if r.get("event") == "serve_request"]
+    serve_info: Optional[Dict[str, Any]] = None
+    if serve_reqs or any(k.startswith("serve.") for k in counters):
+        done = [r for r in serve_reqs
+                if r.get("status") in ("ok", "degraded")]
+        lat = sorted(float(r.get("total_ms", 0.0)) for r in done)
+
+        def pct(q):
+            if not lat:
+                return None
+            return lat[min(len(lat) - 1,
+                           int(round(q / 100.0 * (len(lat) - 1))))]
+
+        batch_hist: Dict[int, int] = {}
+        for r in done:
+            bs = int(r.get("batch_size", 1))
+            batch_hist[bs] = batch_hist.get(bs, 0) + 1
+        accepted = int(counters.get("serve.accepted", len(serve_reqs)))
+        rejected = int(counters.get("serve.rejected", 0))
+        offered = accepted + rejected
+        serve_info = {
+            "accepted": accepted,
+            "rejected": rejected,
+            "reject_rate": (rejected / offered) if offered else 0.0,
+            "completed": int(counters.get("serve.completed", len(done))),
+            "degraded": int(counters.get(
+                "serve.degraded",
+                sum(1 for r in done if r.get("status") == "degraded"))),
+            "timeouts": int(counters.get(
+                "serve.timeouts",
+                sum(1 for r in serve_reqs
+                    if r.get("status") == "timeout"))),
+            "errors": int(counters.get("serve.errors", 0)),
+            "p50_ms": pct(50),
+            "p95_ms": pct(95),
+            "batch_size_hist": {str(k): v
+                                for k, v in sorted(batch_hist.items())},
         }
 
     # --- per-device HBM peaks (run_end gauges + streamed hbm records) -----
@@ -204,6 +245,7 @@ def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                               if (hits + misses) else None),
         "compile": compile_info,
         "tune": tune_info,
+        "serve": serve_info,
         "hbm": hbm or None,
         "spans": spans,
         "n_records": len(records),
@@ -263,8 +305,10 @@ def render(an: Dict[str, Any], run_id: Optional[str] = None) -> str:
              "fetch.bytes", "kappa.coherence_px", "kappa.total_px",
              "compile.count", "compile.ms", "compile.cache_hits",
              "xla.flops", "xla.bytes", "tune.store_hits", "tune.fallbacks",
-             "tune.env_overrides"}
-    rest = {k: v for k, v in c.items() if k not in shown and v}
+             "tune.env_overrides", "tune.packaged"}
+    # serve.* counters render in their own serving section below
+    rest = {k: v for k, v in c.items()
+            if k not in shown and v and not k.startswith("serve.")}
     for k in sorted(rest):
         w(f"    {k:<13} {rest[k]:g}")
 
@@ -296,6 +340,7 @@ def render(an: Dict[str, Any], run_id: Optional[str] = None) -> str:
             w(f"    store         {tune['store']} "
               f"({tune.get('store_entries', 0)} entries)")
         w(f"    resolutions   {tune['store_hits']} store / "
+          f"{tune.get('packaged', 0)} packaged / "
           f"{tune['fallbacks']} default / {tune['env_overrides']} env")
         if tune["errors"]:
             w(f"    errors        {tune['errors']} "
@@ -306,6 +351,23 @@ def render(an: Dict[str, Any], run_id: Optional[str] = None) -> str:
             w(f"    {cfg.get('key', '?'):<36} "
               f"tile_rows={cfg.get('tile_rows')} "
               f"cap={cfg.get('packed_tile_cap')} [{origins}]")
+
+    srv = an.get("serve")
+    if srv:
+        w("  serving:")
+        w(f"    admission     {srv['accepted']} accepted / "
+          f"{srv['rejected']} rejected "
+          f"(reject rate {100 * srv['reject_rate']:.1f}%)")
+        w(f"    outcomes      {srv['completed']} completed, "
+          f"{srv['degraded']} degraded, {srv['timeouts']} timeout, "
+          f"{srv['errors']} error")
+        if srv["p50_ms"] is not None:
+            w(f"    latency       p50 {srv['p50_ms']:.1f} ms / "
+              f"p95 {srv['p95_ms']:.1f} ms")
+        if srv["batch_size_hist"]:
+            hist = ", ".join(f"{k}x{v}" for k, v in
+                             srv["batch_size_hist"].items())
+            w(f"    batch sizes   {hist}  (size x count)")
 
     hbm = an.get("hbm")
     if hbm:

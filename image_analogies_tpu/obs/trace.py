@@ -272,6 +272,14 @@ def span(name: str, **attrs: Any):
     return _Span(name, attrs, ctx)
 
 
+def emit_record(record: Dict[str, Any]) -> None:
+    """Emit a structured record into the active run's log.  With no run
+    active it still mirrors to stdlib logging (utils.logging.emit), just
+    without a JSONL destination — callers never need to branch."""
+    ctx = _CURRENT
+    _logging.emit(record, ctx.log_path if ctx is not None else None)
+
+
 def current_span_attrs() -> Optional[Dict[str, Any]]:
     """Merged attrs of this thread's open spans (innermost wins) — lets
     out-of-band records (obs.device compile events) attribute themselves

@@ -31,6 +31,7 @@ bitwise-level agreement (SURVEY.md §4.3).
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
@@ -298,6 +299,30 @@ def extract_patches_jax(img: jax.Array, p: int) -> jax.Array:
     return jnp.stack(cols, axis=-1).reshape(h * w, p * p).astype(jnp.float32)
 
 
+@functools.lru_cache(maxsize=64)
+def _clip_window_idx(h: int, w: int, p: int) -> np.ndarray:
+    """(H*W, p*p) int32 flat indices of edge-clamped windows (= edge pad)."""
+    off = window_offsets(p)
+    ii, jj = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    ci = np.clip(ii.reshape(-1, 1) + off[None, :, 0], 0, h - 1)
+    cj = np.clip(jj.reshape(-1, 1) + off[None, :, 1], 0, w - 1)
+    return (ci * w + cj).astype(np.int32)
+
+
+def extract_patches_jax_gather(img: jax.Array, p: int) -> jax.Array:
+    """Bit-identical twin of `extract_patches_jax` built as ONE clip-index
+    gather instead of pad+shifted slices.  Exists for programs compiled with
+    row-sharded `out_shardings` (the direct-sharded DB builders): XLA's SPMD
+    partitioner miscompiles the edge-pad concatenate chain when the per-shard
+    row count is not a multiple of the image width — every output element
+    comes back exactly doubled (observed on the CPU backend at 10x10/4
+    shards; jax 0.4.37).  A gather carries no halo arithmetic for the
+    partitioner to get wrong, and returns the same values bit-for-bit."""
+    h, w = img.shape
+    idx = jnp.asarray(_clip_window_idx(h, w, p))
+    return img.reshape(-1)[idx].astype(jnp.float32)
+
+
 def build_features_jax(
     spec: FeatureSpec,
     src_fine: jax.Array,
@@ -305,16 +330,24 @@ def build_features_jax(
     src_coarse: Optional[jax.Array],
     filt_coarse: Optional[jax.Array],
     temporal_fine: Optional[jax.Array] = None,
+    edge_gather: bool = False,
 ) -> jax.Array:
-    """JAX mirror of `build_features_np` (same layout, weights, masks)."""
+    """JAX mirror of `build_features_np` (same layout, weights, masks).
+
+    ``edge_gather`` swaps every window extraction to the clip-index gather
+    twin — REQUIRED when this build is compiled with row-sharded
+    out_shardings (see `extract_patches_jax_gather`); values are
+    bit-identical either way."""
+    patches = extract_patches_jax_gather if edge_gather else \
+        extract_patches_jax
     sf = src_fine if src_fine.ndim == 3 else src_fine[..., None]
     h, w, cs = sf.shape
     sw = jnp.asarray(spec.sqrt_weights())
     parts = []
     for c in range(cs):
-        parts.append(extract_patches_jax(sf[..., c], spec.fine_size))
+        parts.append(patches(sf[..., c], spec.fine_size))
     if filt_fine is not None:
-        blk = extract_patches_jax(filt_fine, spec.fine_size)
+        blk = patches(filt_fine, spec.fine_size)
         parts.append(blk * jnp.asarray(spec.fine_causal())[None, :])
     else:
         parts.append(jnp.zeros((h * w, spec.fine_n), jnp.float32))
@@ -324,11 +357,11 @@ def build_features_jax(
         cmap = jnp.asarray(coarse_index_map_np(h, w, hc, wc))
         for c in range(cs):
             parts.append(
-                extract_patches_jax(sc[..., c], spec.coarse_size)[cmap])
+                patches(sc[..., c], spec.coarse_size)[cmap])
         parts.append(
-            extract_patches_jax(filt_coarse, spec.coarse_size)[cmap])
+            patches(filt_coarse, spec.coarse_size)[cmap])
     if spec.temporal_n:
         tp = (jnp.zeros((h, w), jnp.float32) if temporal_fine is None
               else temporal_fine)
-        parts.append(extract_patches_jax(tp, spec.fine_size))
+        parts.append(patches(tp, spec.fine_size))
     return jnp.concatenate(parts, axis=1) * sw[None, :]

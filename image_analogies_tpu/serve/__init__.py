@@ -1,0 +1,38 @@
+"""Transport-agnostic serving subsystem (ROADMAP north star: amortize
+compiles and exemplar work across concurrent callers instead of paying
+one-shot CLI cold dispatch per request).
+
+Layering (each module one concern):
+
+- :mod:`serve.types`    — ServeConfig / Request / Response / Rejected.
+- :mod:`serve.queue`    — thread-safe admission queue (bounded depth,
+  explicit ``Rejected(reason="queue_full")`` backpressure).
+- :mod:`serve.batcher`  — the compatibility key micro-batching groups by
+  (AnalogyParams digest + tune shape buckets + exemplar content).
+- :mod:`serve.degrade`  — deadline cost model: cancel-before-dispatch vs
+  degrade (fewer pyramid levels / coarser patch) decisions.
+- :mod:`serve.worker`   — worker pool owning device dispatch; wraps every
+  engine call in ``utils.failure.run_with_retry``.
+- :mod:`serve.server`   — lifecycle (warmup before traffic, drain on
+  shutdown) + the in-process :class:`Client` API tests use.
+- :mod:`serve.loadgen`  — ``ia serve --selftest N`` synthetic load.
+- :mod:`serve.http`     — optional loopback stdlib ``http.server`` front
+  end (``ia serve --http PORT``); never required by tests.
+
+Everything here is host-side orchestration: no jax imports at module
+scope, no direct jit/pjit anywhere (the grep-lock test enforces it) —
+device work happens only inside the engine via the obs JitShim and
+tune.resolve funnels.
+"""
+
+from image_analogies_tpu.serve.server import Client, Server
+from image_analogies_tpu.serve.types import (
+    DeadlineExceeded,
+    Rejected,
+    Request,
+    Response,
+    ServeConfig,
+)
+
+__all__ = ["Client", "Server", "ServeConfig", "Request", "Response",
+           "Rejected", "DeadlineExceeded"]

@@ -1,0 +1,54 @@
+"""Batch-compatibility key.
+
+Two requests may share one batched invocation iff a single engine
+backend can serve both with identical compiled programs and identical
+exemplar-side work:
+
+- same ``AnalogyParams`` digest (``obs.trace.config_digest`` — the same
+  digest the run manifest records, so batches are auditable from logs);
+- same tune shape-bucket for the exemplar row count (``bucket_rows`` —
+  the granularity at which PR 3's program reuse already keys compiled
+  levels) and for the target;
+- same exemplar *content* (sha1 of the A/A' planes).  This is stricter
+  than the ISSUE's shape-bucket minimum on purpose: sharing a backend
+  across identical exemplars lets the CPU matcher reuse its KD-tree and
+  the TPU devcache its uploads, which is where the batched-throughput
+  win over sequential dispatch comes from.  Requests with equal shapes
+  but different exemplars still run — just as singleton batches.
+
+Odd shapes need no special casing: a key nobody else shares simply
+coalesces with nobody, and the window expires into singleton dispatch.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Tuple
+
+import numpy as np
+
+from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.obs import trace as obs_trace
+from image_analogies_tpu.tune import buckets as tune_buckets
+
+
+def exemplar_digest(a: np.ndarray, ap: np.ndarray) -> str:
+    h = hashlib.sha1()
+    for arr in (a, ap):
+        arr = np.ascontiguousarray(arr)
+        h.update(str(arr.shape).encode())
+        h.update(str(arr.dtype).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()[:12]
+
+
+def batch_key(a: np.ndarray, ap: np.ndarray, b: np.ndarray,
+              params: AnalogyParams) -> Tuple[Any, ...]:
+    a_rows = int(a.shape[0]) * int(a.shape[1])
+    b_rows = int(b.shape[0]) * int(b.shape[1])
+    return (
+        obs_trace.config_digest(params),
+        tune_buckets.bucket_rows(a_rows),
+        tune_buckets.bucket_rows(b_rows),
+        exemplar_digest(a, ap),
+    )

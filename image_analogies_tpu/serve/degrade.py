@@ -1,0 +1,102 @@
+"""Deadline policy: run full, degrade, or cancel-before-dispatch.
+
+Cost model: synthesis work scales ~ target pixels x pyramid levels x
+patch area (the per-pixel candidate scan dominates both backends), so we
+keep one EWMA rate in seconds per (pixel*level*patch^2) unit, updated
+from every completed dispatch.  The prior is deliberately optimistic —
+until we have measurements we'd rather attempt full fidelity and learn
+from the overrun than degrade requests a fresh server could have served
+whole.
+
+The degradation ladder only ever *reduces* fidelity knobs the paper's
+pyramid makes safe to reduce (fewer levels, then the minimum 3x3 patch);
+a degraded response is a valid synthesis, just flagged.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.serve.types import Request
+
+# Optimistic prior (s per pixel*level*patch^2); EWMA weight of new samples.
+_PRIOR_RATE = 1e-7
+_ALPHA = 0.4
+
+
+def work_units(pixels: int, levels: int, patch_size: int) -> float:
+    return float(pixels) * max(1, levels) * patch_size * patch_size
+
+
+class CostModel:
+    """Thread-safe EWMA of observed dispatch cost."""
+
+    def __init__(self, prior_rate: float = _PRIOR_RATE):
+        self._rate = prior_rate
+        self._samples = 0
+        self._lock = threading.Lock()
+
+    def observe(self, units: float, seconds: float) -> None:
+        if units <= 0 or seconds <= 0:
+            return
+        sample = seconds / units
+        with self._lock:
+            if self._samples == 0:
+                self._rate = sample
+            else:
+                self._rate = _ALPHA * sample + (1 - _ALPHA) * self._rate
+            self._samples += 1
+
+    def estimate(self, units: float) -> float:
+        with self._lock:
+            return self._rate * units
+
+
+def _ladder(params: AnalogyParams):
+    """Fidelity configs from full to minimum, each a valid AnalogyParams
+    substitution.  Patch sizes stay odd (engine invariant)."""
+    patches = [params.patch_size]
+    if params.patch_size > 3:
+        patches.append(3)
+    for levels in range(params.levels, 0, -1):
+        for patch in patches:
+            yield levels, patch
+
+
+def plan(req: Request, model: CostModel, *, allow_degrade: bool
+         ) -> Tuple[str, AnalogyParams, Optional[Dict[str, Any]]]:
+    """Decide what to dispatch for ``req`` right now.
+
+    Returns ``(action, params, degraded)`` with action one of:
+    - ``"run"``      — full fidelity fits (or no deadline).
+    - ``"degrade"``  — ``params`` substituted per ``degraded`` dict.
+    - ``"timeout"``  — deadline already expired; cancel before dispatch.
+    """
+    remaining = req.remaining()
+    if remaining is None:
+        return "run", req.params, None
+    if remaining <= 0:
+        return "timeout", req.params, None
+    pixels = int(req.b.shape[0]) * int(req.b.shape[1])
+    full = model.estimate(
+        work_units(pixels, req.params.levels, req.params.patch_size))
+    if full <= remaining or not allow_degrade:
+        return "run", req.params, None
+    for levels, patch in _ladder(req.params):
+        if levels == req.params.levels and patch == req.params.patch_size:
+            continue
+        est = model.estimate(work_units(pixels, levels, patch))
+        if est <= remaining:
+            return ("degrade",
+                    req.params.replace(levels=levels, patch_size=patch),
+                    {"levels": levels, "patch_size": patch,
+                     "estimate_s": round(est, 4),
+                     "full_estimate_s": round(full, 4)})
+    # Nothing fits the deadline; dispatch the cheapest valid config rather
+    # than guaranteeing failure — the response stays flagged as degraded.
+    levels, patch = 1, min(3, req.params.patch_size)
+    return ("degrade", req.params.replace(levels=levels, patch_size=patch),
+            {"levels": levels, "patch_size": patch, "best_effort": True,
+             "full_estimate_s": round(full, 4)})

@@ -1,0 +1,95 @@
+"""Optional loopback HTTP front end (stdlib ``http.server`` only).
+
+Strictly a thin transport over :class:`serve.server.Server` — no logic
+lives here, and nothing in the test suite requires it (the sandbox has
+no DNS; binding is loopback-only by construction).
+
+API:
+  GET  /healthz      -> {"ok": true, "queue_depth": N}
+  POST /v1/analogy   -> body {"a": [[...]], "ap": [[...]], "b": [[...]],
+                        "deadline_ms": optional float}
+                        reply {"request", "status", "bp", "timings", ...}
+
+Planes are nested JSON lists of floats — fine for a loopback demo
+transport, not a production wire format (see ROADMAP follow-ups).
+"""
+
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict
+
+import numpy as np
+
+from image_analogies_tpu.serve.server import Server
+from image_analogies_tpu.serve.types import DeadlineExceeded, Rejected
+
+
+def _make_handler(server: Server):
+    class Handler(BaseHTTPRequestHandler):
+        # Silence per-request stderr chatter; obs records cover it.
+        def log_message(self, fmt, *args):  # noqa: A003
+            pass
+
+        def _reply(self, code: int, payload: Dict[str, Any]) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 - stdlib API
+            if self.path == "/healthz":
+                self._reply(200, {"ok": True,
+                                  "queue_depth": server.queue_depth})
+            else:
+                self._reply(404, {"error": "not_found"})
+
+        def do_POST(self):  # noqa: N802 - stdlib API
+            if self.path != "/v1/analogy":
+                self._reply(404, {"error": "not_found"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(length) or b"{}")
+                a = np.asarray(req["a"], dtype=np.float32)
+                ap = np.asarray(req["ap"], dtype=np.float32)
+                b = np.asarray(req["b"], dtype=np.float32)
+            except (KeyError, ValueError, json.JSONDecodeError) as exc:
+                self._reply(400, {"error": "bad_request", "detail": str(exc)})
+                return
+            deadline_ms = req.get("deadline_ms")
+            try:
+                resp = server.request(
+                    a, ap, b,
+                    deadline_s=None if deadline_ms is None
+                    else float(deadline_ms) / 1e3)
+            except Rejected as exc:
+                self._reply(429, {"error": "rejected", "reason": exc.reason})
+                return
+            except DeadlineExceeded:
+                self._reply(504, {"error": "deadline_exceeded"})
+                return
+            except Exception as exc:  # noqa: BLE001 - surfaced to caller
+                self._reply(500, {"error": "dispatch_failed",
+                                  "detail": str(exc)})
+                return
+            self._reply(200, {
+                "request": resp.request_id,
+                "status": resp.status,
+                "degraded": resp.degraded,
+                "batch_size": resp.batch_size,
+                "timings": {"queue_ms": round(resp.queue_ms, 3),
+                            "dispatch_ms": round(resp.dispatch_ms, 3),
+                            "total_ms": round(resp.total_ms, 3)},
+                "bp": resp.bp.tolist(),
+            })
+
+    return Handler
+
+
+def serve_http(server: Server, port: int) -> ThreadingHTTPServer:
+    """Bind a loopback-only HTTP server; caller runs serve_forever()."""
+    return ThreadingHTTPServer(("127.0.0.1", port), _make_handler(server))

@@ -1,0 +1,99 @@
+"""Bounded admission queue + compatibility-keyed batch pop.
+
+One lock + condition guards a deque.  ``submit`` never blocks: at depth
+it raises :class:`Rejected` immediately (backpressure is the client's
+problem, unbounded memory growth is ours).  ``pop_batch`` is the worker
+side: block for a leader, then coalesce same-key followers for at most
+the batch window.  Requests with different keys are left in place for
+other workers — the scan preserves arrival order per key.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import List, Optional
+
+from image_analogies_tpu.obs import metrics as obs_metrics
+from image_analogies_tpu.serve.types import Rejected, Request
+
+
+class AdmissionQueue:
+    def __init__(self, depth: int):
+        self._depth = depth
+        self._items: collections.deque[Request] = collections.deque()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._closed = False
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def submit(self, req: Request) -> None:
+        with self._lock:
+            if self._closed:
+                obs_metrics.inc("serve.rejected")
+                raise Rejected("shutting_down")
+            if len(self._items) >= self._depth:
+                obs_metrics.inc("serve.rejected")
+                raise Rejected("queue_full")
+            self._items.append(req)
+            obs_metrics.inc("serve.accepted")
+            obs_metrics.max_gauge("serve.queue_depth_peak", len(self._items))
+            obs_metrics.set_gauge("serve.queue_depth", len(self._items))
+            # notify_all: a window-waiting worker may consume a single
+            # notify meant for a leader-waiting one and drop the wakeup.
+            self._cond.notify_all()
+
+    def pop_batch(self, max_batch: int, window_s: float) -> Optional[List[Request]]:
+        """Return a batch of same-key requests, or None when closed+empty.
+
+        The first (oldest) request is the leader and fixes the key; we then
+        wait up to ``window_s`` for same-key followers, waking early whenever
+        a new submit lands.  The leader is held outside the deque during the
+        window, so a second worker calling pop_batch concurrently picks up
+        the next *different*-key request rather than splitting the batch.
+        """
+        with self._lock:
+            while not self._items:
+                if self._closed:
+                    return None
+                self._cond.wait()
+            leader = self._items.popleft()
+            batch = [leader]
+            end = time.monotonic() + max(0.0, window_s)
+            while len(batch) < max_batch:
+                kept: collections.deque[Request] = collections.deque()
+                for item in self._items:
+                    if item.key == leader.key and len(batch) < max_batch:
+                        batch.append(item)
+                    else:
+                        kept.append(item)
+                self._items = kept
+                if len(batch) >= max_batch or self._closed:
+                    break
+                remaining = end - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            now = time.monotonic()
+            for req in batch:
+                req.t_dequeue = now
+            obs_metrics.set_gauge("serve.queue_depth", len(self._items))
+            return batch
+
+    def close(self) -> None:
+        """Stop accepting; wake all workers so they can drain and exit."""
+        with self._lock:
+            self._closed = True
+            self._cond.notify_all()
+
+    def drain_rejected(self) -> List[Request]:
+        """Dump any still-queued requests (non-draining shutdown)."""
+        with self._lock:
+            items = list(self._items)
+            self._items.clear()
+            self._cond.notify_all()
+        return items

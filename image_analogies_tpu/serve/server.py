@@ -1,0 +1,152 @@
+"""Server lifecycle + in-process Client API.
+
+Lifecycle contract:
+
+1. ``start()`` opens one obs run scope for the whole server lifetime
+   (worker threads join it reentrantly — every request's spans, records,
+   and counters land in one run log), runs ``tune.warmup`` AOT
+   precompilation for the configured bucket set, and only then starts
+   accepting traffic.
+2. ``submit()`` is non-blocking: it returns a Future or raises
+   :class:`Rejected` immediately.
+3. ``shutdown()`` stops admission (new submits -> Rejected), drains
+   in-flight and queued work (unless ``drain=False``, which fails queued
+   requests with Rejected("shutting_down")), joins the workers, then
+   closes the run scope so ``run_end`` carries the final counters.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from concurrent.futures import Future
+from typing import Optional
+
+import numpy as np
+
+from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.obs import trace as obs_trace
+from image_analogies_tpu.serve import batcher
+from image_analogies_tpu.serve.degrade import CostModel
+from image_analogies_tpu.serve.queue import AdmissionQueue
+from image_analogies_tpu.serve.types import (
+    Rejected,
+    Request,
+    Response,
+    ServeConfig,
+)
+from image_analogies_tpu.serve.worker import WorkerPool
+from image_analogies_tpu.tune import warmup as tune_warmup
+
+
+class Server:
+    def __init__(self, cfg: ServeConfig):
+        self.cfg = cfg
+        self._queue = AdmissionQueue(cfg.queue_depth)
+        self.cost_model = CostModel()
+        self._pool = WorkerPool(cfg, self._queue, self.cost_model)
+        self._exit = contextlib.ExitStack()
+        self._accepting = False
+        self._started = False
+        self._next_id = 0
+        self._id_lock = threading.Lock()
+        self.warmup_report: list = []
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "Server":
+        if self._started:
+            return self
+        self._started = True
+        # One run scope for the server's lifetime; metrics forced on so
+        # admission/latency counters exist even when params.metrics is
+        # unset (log_path still controls whether records hit disk).
+        scope_params = self.cfg.params.replace(metrics=True)
+        self._exit.enter_context(obs_trace.run_scope(
+            scope_params,
+            manifest_extra={"serve": {
+                "queue_depth": self.cfg.queue_depth,
+                "batch_window_ms": self.cfg.batch_window_ms,
+                "max_batch": self.cfg.max_batch,
+                "workers": self.cfg.workers,
+                "warmup_sizes": [list(s) for s in self.cfg.warmup_sizes],
+            }}))
+        if self.cfg.warmup_sizes:
+            with obs_trace.span("serve_warmup",
+                                sizes=len(self.cfg.warmup_sizes)):
+                self.warmup_report = tune_warmup.warmup_buckets(
+                    self.cfg.params, self.cfg.warmup_sizes)
+        self._pool.start()
+        self._accepting = True
+        return self
+
+    def shutdown(self, drain: bool = True) -> None:
+        if not self._started:
+            return
+        self._accepting = False
+        if not drain:
+            for req in self._queue.drain_rejected():
+                req.future.set_exception(Rejected("shutting_down"))
+        self._queue.close()
+        self._pool.join(self.cfg.drain_timeout_s)
+        self._started = False
+        self._exit.close()
+
+    def __enter__(self) -> "Server":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    # -- request path ------------------------------------------------------
+
+    def submit(self, a: np.ndarray, ap: np.ndarray, b: np.ndarray,
+               params: Optional[AnalogyParams] = None,
+               deadline_s: Optional[float] = None) -> "Future[Response]":
+        """Enqueue one request; returns a Future resolving to a Response
+        (or raising DeadlineExceeded / the dispatch error).  Raises
+        :class:`Rejected` when the server is full or shutting down."""
+        if not self._accepting:
+            raise Rejected("shutting_down")
+        p = params or self.cfg.params
+        if deadline_s is None:
+            deadline_s = self.cfg.default_deadline_s
+        with self._id_lock:
+            self._next_id += 1
+            rid = self._next_id
+        fut: "Future[Response]" = Future()
+        req = Request(
+            request_id=rid,
+            a=np.asarray(a), ap=np.asarray(ap), b=np.asarray(b),
+            params=p,
+            key=batcher.batch_key(a, ap, b, p),
+            future=fut,
+        )
+        if deadline_s is not None:
+            req.deadline = req.t_submit + deadline_s
+        self._queue.submit(req)  # Rejected propagates to the caller
+        return fut
+
+    def request(self, a, ap, b, params=None, deadline_s=None,
+                timeout: Optional[float] = None) -> Response:
+        """Blocking convenience: submit + wait."""
+        return self.submit(a, ap, b, params, deadline_s).result(timeout)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+
+class Client:
+    """In-process client facade — the API tests (and embedders) use.
+    Exists so call sites depend on the request surface, not on server
+    lifecycle internals; a future remote client keeps this interface."""
+
+    def __init__(self, server: Server):
+        self._server = server
+
+    def submit(self, a, ap, b, params=None, deadline_s=None):
+        return self._server.submit(a, ap, b, params, deadline_s)
+
+    def request(self, a, ap, b, params=None, deadline_s=None, timeout=None):
+        return self._server.request(a, ap, b, params, deadline_s, timeout)

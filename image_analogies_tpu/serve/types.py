@@ -1,0 +1,111 @@
+"""Core serving datatypes.
+
+Host-side only: numpy planes in, numpy planes out.  The engine types
+(`AnalogyParams`, `AnalogyResult`) are reused as-is so a served request
+runs the exact code path a CLI run does — bit-identical outputs are an
+acceptance criterion, not an aspiration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from image_analogies_tpu.config import AnalogyParams
+
+
+class Rejected(RuntimeError):
+    """Admission control refused the request (no hang, no unbounded queue).
+
+    ``reason`` is machine-readable: ``"queue_full"`` when the bounded queue
+    is at depth, ``"shutting_down"`` once drain has begun.
+    """
+
+    def __init__(self, reason: str):
+        super().__init__(f"request rejected: {reason}")
+        self.reason = reason
+
+
+class DeadlineExceeded(RuntimeError):
+    """Deadline expired before dispatch; the request was cancelled, never
+    sent to the device."""
+
+    def __init__(self, request_id: int, late_s: float):
+        super().__init__(
+            f"request {request_id} deadline expired {late_s * 1e3:.1f}ms "
+            "before dispatch")
+        self.request_id = request_id
+        self.late_s = late_s
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Scheduler knobs.  ``params`` is the default engine config; requests
+    may carry their own (each distinct digest forms its own batch key)."""
+
+    params: AnalogyParams
+    queue_depth: int = 32          # admission bound; above it -> Rejected
+    batch_window_ms: float = 4.0   # coalescing wait once a leader is held
+    max_batch: int = 8             # requests per batched invocation
+    workers: int = 2
+    default_deadline_s: Optional[float] = None  # None -> no deadline
+    degrade: bool = True           # False -> never degrade, only timeout
+    request_retries: int = 1       # run_with_retry budget around dispatch
+    warmup_sizes: Tuple[Tuple[int, int], ...] = ()  # (h, w) AOT precompile
+    drain_timeout_s: float = 60.0
+
+    def __post_init__(self):
+        if self.queue_depth < 1:
+            raise ValueError("queue_depth must be >= 1")
+        if self.max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        if self.workers < 1:
+            raise ValueError("workers must be >= 1")
+
+
+@dataclasses.dataclass
+class Request:
+    """One enqueued synthesis job.  ``deadline`` is absolute
+    ``time.monotonic()`` seconds (None = unbounded)."""
+
+    request_id: int
+    a: np.ndarray
+    ap: np.ndarray
+    b: np.ndarray
+    params: AnalogyParams
+    key: Tuple[Any, ...]
+    future: "Future[Response]"
+    deadline: Optional[float] = None
+    t_submit: float = dataclasses.field(default_factory=time.monotonic)
+    t_dequeue: Optional[float] = None
+
+    def remaining(self, now: Optional[float] = None) -> Optional[float]:
+        if self.deadline is None:
+            return None
+        return self.deadline - (time.monotonic() if now is None else now)
+
+
+@dataclasses.dataclass
+class Response:
+    """Completed request.  ``degraded`` is None for a full-fidelity run,
+    else the substitutions made to meet the deadline (e.g.
+    ``{"levels": 1, "patch_size": 3}``) — degraded responses are valid
+    outputs, just flagged."""
+
+    request_id: int
+    bp: np.ndarray
+    bp_y: np.ndarray
+    stats: Dict[str, Any]
+    batch_size: int
+    queue_ms: float
+    dispatch_ms: float
+    total_ms: float
+    degraded: Optional[Dict[str, Any]] = None
+
+    @property
+    def status(self) -> str:
+        return "degraded" if self.degraded else "ok"

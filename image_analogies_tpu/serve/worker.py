@@ -1,0 +1,173 @@
+"""Worker pool: owns device dispatch for batches popped off the queue.
+
+One engine backend is constructed per batch and shared by every member —
+the batch key guarantees identical params + exemplar content, so the
+backend's per-level caches (CPU KD-tree memo, TPU devcache/program
+cache) amortize across the batch.  Degraded members run with their own
+substituted params and therefore their own backend; correctness first,
+sharing second.
+
+Every engine call goes through ``utils.failure.run_with_retry`` so an
+injected (or real) transient device failure retries inside the server
+and the client never observes it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from image_analogies_tpu.obs import metrics as obs_metrics
+from image_analogies_tpu.obs import trace as obs_trace
+from image_analogies_tpu.serve import degrade as serve_degrade
+from image_analogies_tpu.serve.queue import AdmissionQueue
+from image_analogies_tpu.serve.types import (
+    DeadlineExceeded,
+    Request,
+    Response,
+    ServeConfig,
+)
+from image_analogies_tpu.utils import failure
+
+
+class WorkerPool:
+    def __init__(self, cfg: ServeConfig, queue: AdmissionQueue,
+                 cost_model: Optional[serve_degrade.CostModel] = None):
+        self._cfg = cfg
+        self._queue = queue
+        self._cost = cost_model or serve_degrade.CostModel()
+        self._threads: List[threading.Thread] = []
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+
+    def start(self) -> None:
+        for i in range(self._cfg.workers):
+            t = threading.Thread(target=self._loop, name=f"ia-serve-{i}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        end = None if timeout is None else time.monotonic() + timeout
+        for t in self._threads:
+            t.join(None if end is None else max(0.0, end - time.monotonic()))
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._queue.pop_batch(self._cfg.max_batch,
+                                          self._cfg.batch_window_ms / 1e3)
+            if batch is None:
+                return
+            self._run_batch(batch)
+
+    def _track_inflight(self, delta: int) -> None:
+        with self._inflight_lock:
+            self._inflight += delta
+            obs_metrics.set_gauge("serve.inflight", self._inflight)
+
+    def _run_batch(self, batch: List[Request]) -> None:
+        self._track_inflight(len(batch))
+        obs_metrics.observe("serve.batch_size", len(batch))
+        try:
+            with obs_trace.span("serve_batch", size=len(batch),
+                                key="/".join(str(k) for k in batch[0].key)):
+                backend = None
+                for req in batch:
+                    backend = self._run_one(req, backend, len(batch))
+        finally:
+            self._track_inflight(-len(batch))
+
+    def _emit_request_record(self, req: Request, status: str, *,
+                             batch_size: int, dispatch_ms: float = 0.0,
+                             degraded=None) -> None:
+        now = time.monotonic()
+        queue_ms = ((req.t_dequeue or now) - req.t_submit) * 1e3
+        obs_trace.emit_record({
+            "event": "serve_request",
+            "request": req.request_id,
+            "status": status,
+            "batch_size": batch_size,
+            "queue_ms": round(queue_ms, 3),
+            "dispatch_ms": round(dispatch_ms, 3),
+            "total_ms": round((now - req.t_submit) * 1e3, 3),
+            "degraded": degraded,
+        })
+
+    def _run_one(self, req: Request, backend, batch_size: int):
+        """Dispatch one request; returns the (possibly newly built) shared
+        backend for subsequent same-batch members."""
+        # Lazy import: keep serve/ importable without touching jax until
+        # a request actually dispatches.
+        from image_analogies_tpu.backends import get_backend
+        from image_analogies_tpu.models.analogy import create_image_analogy
+
+        if not req.future.set_running_or_notify_cancel():
+            return backend  # client cancelled while queued
+
+        action, params, degraded = serve_degrade.plan(
+            req, self._cost, allow_degrade=self._cfg.degrade)
+        if action == "timeout":
+            obs_metrics.inc("serve.timeouts")
+            self._emit_request_record(req, "timeout", batch_size=batch_size)
+            req.future.set_exception(
+                DeadlineExceeded(req.request_id, -(req.remaining() or 0.0)))
+            return backend
+
+        if degraded is not None:
+            # Substituted params -> different compiled programs; do not
+            # share the batch backend.
+            dispatch_backend = get_backend(params)
+        else:
+            backend = backend or get_backend(params)
+            dispatch_backend = backend
+
+        t0 = time.monotonic()
+        try:
+            with obs_trace.span("serve_dispatch", request=req.request_id,
+                                batch_size=batch_size,
+                                degraded=bool(degraded)):
+                result = failure.run_with_retry(
+                    lambda: create_image_analogy(
+                        req.a, req.ap, req.b, params,
+                        backend=dispatch_backend),
+                    retries=self._cfg.request_retries,
+                    context={"scope": "serve", "request": req.request_id},
+                    log_path=self._cfg.params.log_path,
+                    backoff_s=0.0,
+                )
+        except Exception as exc:  # noqa: BLE001 - forwarded to the client
+            obs_metrics.inc("serve.errors")
+            self._emit_request_record(req, "error", batch_size=batch_size,
+                                      dispatch_ms=(time.monotonic() - t0) * 1e3)
+            req.future.set_exception(exc)
+            return backend
+
+        dispatch_s = time.monotonic() - t0
+        pixels = int(req.b.shape[0]) * int(req.b.shape[1])
+        self._cost.observe(
+            serve_degrade.work_units(pixels, params.levels, params.patch_size),
+            dispatch_s)
+
+        now = time.monotonic()
+        resp = Response(
+            request_id=req.request_id,
+            bp=result.bp,
+            bp_y=result.bp_y,
+            stats=result.stats,
+            batch_size=batch_size,
+            queue_ms=((req.t_dequeue or t0) - req.t_submit) * 1e3,
+            dispatch_ms=dispatch_s * 1e3,
+            total_ms=(now - req.t_submit) * 1e3,
+            degraded=degraded,
+        )
+        obs_metrics.inc("serve.completed")
+        if degraded is not None:
+            obs_metrics.inc("serve.degraded")
+        obs_metrics.observe("serve.latency_ms", resp.total_ms)
+        obs_metrics.observe("serve.queue_ms", resp.queue_ms)
+        self._emit_request_record(req, resp.status, batch_size=batch_size,
+                                  dispatch_ms=resp.dispatch_ms,
+                                  degraded=degraded)
+        req.future.set_result(resp)
+        return backend

@@ -5,7 +5,8 @@ Call sites that used to read ``_tile_rows`` / ``_PACKED_TILE_CAP`` /
 :class:`TuneConfig` keyed by ``(device_kind, strategy, dtype, padded-F,
 shape-bucket)`` with per-knob precedence:
 
-    tuner override (thread-local)  >  env var  >  store entry  >  default
+    tuner override (thread-local)  >  env var  >  store entry
+        >  packaged device-class table (tune.tables)  >  default
 
 - **override**: the autotuner brackets its timed candidates with
   :func:`override` so the swept value flows through the SAME call sites
@@ -16,8 +17,13 @@ shape-bucket)`` with per-knob precedence:
 - **store**: :mod:`tune.store` entries — exact key first, then the
   bucket-wildcard key (``...|b*``) so one measured winner can cover all
   row counts of a device/strategy/dtype/F combination.
+- **packaged**: :mod:`tune.tables` per-device-class winners shipped with
+  the package (v4/v5e/v5p), so known hardware skips generic geometry
+  without any local measurement.
 - **default**: :mod:`tune.geometry`, the legacy constants — an empty
-  store with no env reproduces the pre-tune engine bit-for-bit.
+  store with no env reproduces the pre-tune engine bit-for-bit (packaged
+  tables only exist for real TPU device classes, so CPU test runs and
+  unknown devices still hit these defaults).
 
 Resolution happens on the host at trace time, so the returned ints are
 baked into jit programs exactly like the old constants were.  Every
@@ -43,6 +49,7 @@ from image_analogies_tpu.obs import trace as _trace
 from image_analogies_tpu.tune import buckets as _buckets
 from image_analogies_tpu.tune import geometry as _geometry
 from image_analogies_tpu.tune import store as _store
+from image_analogies_tpu.tune import tables as _tables
 from image_analogies_tpu.utils import logging as _logging
 
 _ENV_VARS = {
@@ -122,6 +129,28 @@ def _env_int(knob: str) -> Optional[int]:
 
 
 @contextlib.contextmanager
+def pin_scope():
+    """Pin geometry for a scope: the FIRST resolution of each key walks
+    the full chain (store I/O, provenance counters/records); repeats
+    inside the scope return the pinned config with no consult at all.
+
+    models/video.py brackets each clip with this so a TuneConfig
+    resolves once per clip instead of once per frame batch — frame
+    timings become byte-comparable and the obs provenance counters
+    record exactly one consult per distinct geometry per clip.  Reentrant
+    (an inner scope joins the outer pin cache); thread-local, so serve/
+    workers pinning concurrent requests never share state.
+    """
+    prev = getattr(_TLS, "pins", None)
+    if prev is None:
+        _TLS.pins = {}
+    try:
+        yield
+    finally:
+        _TLS.pins = prev
+
+
+@contextlib.contextmanager
 def override(**knobs: int):
     """Thread-locally pin knobs (the autotuner's sweep lever); nests."""
     bad = set(knobs) - set(_ENV_VARS)
@@ -140,6 +169,7 @@ def override(**knobs: int):
 def _record(cfg: TuneConfig, fp: int, bucket: int) -> None:
     origins = dict(cfg.origin)
     any_store = any(o.startswith("store") for o in origins.values())
+    any_packaged = any(o == "packaged" for o in origins.values())
     any_env = any(o == "env" for o in origins.values())
     with _LOCK:
         fresh = cfg.store_key not in _PROV
@@ -152,7 +182,12 @@ def _record(cfg: TuneConfig, fp: int, bucket: int) -> None:
                 "origin": origins,
             }
     if _metrics._ACTIVE:
-        _metrics.inc("tune.store_hits" if any_store else "tune.fallbacks")
+        if any_store:
+            _metrics.inc("tune.store_hits")
+        elif any_packaged:
+            _metrics.inc("tune.packaged")
+        else:
+            _metrics.inc("tune.fallbacks")
         if any_env:
             _metrics.inc("tune.env_overrides")
     if fresh:
@@ -187,10 +222,18 @@ def resolve(*, strategy: str, dtype: str, fp: int, n_rows: int = 0,
     key = make_key(dev, strategy, dtype, fp, bucket)
     wild = make_key(dev, strategy, dtype, fp, "*")
 
+    overrides = getattr(_TLS, "overrides", None) or {}
+    pins = getattr(_TLS, "pins", None)
+    pin_key = (key, store, tuple(sorted(overrides.items())))
+    if pins is not None:
+        pinned = pins.get(pin_key)
+        if pinned is not None:
+            return pinned
+
     entries = _store.load_entries(store)
     exact = entries.get(key)
     wildcard = entries.get(wild)
-    overrides = getattr(_TLS, "overrides", None) or {}
+    packaged = _tables.lookup(dev, strategy, dtype)
 
     defaults = {
         "tile_rows": _geometry.default_tile_rows(fp),
@@ -214,11 +257,16 @@ def resolve(*, strategy: str, dtype: str, fp: int, n_rows: int = 0,
             values[knob] = int(wildcard[knob])
             origin[knob] = "store_wildcard"
             continue
+        if knob in packaged:
+            values[knob], origin[knob] = int(packaged[knob]), "packaged"
+            continue
         values[knob], origin[knob] = dflt, "default"
 
     cfg = TuneConfig(key=key, store_key=key,
                      origin=tuple(sorted(origin.items())), **values)
     _record(cfg, fp, bucket)
+    if pins is not None:
+        pins[pin_key] = cfg
     return cfg
 
 

@@ -1,0 +1,70 @@
+"""Packaged per-device-class geometry tables (ROADMAP open item).
+
+Measured winners shipped with the package, keyed by TPU device class, so
+a fresh install on known hardware starts from class-appropriate geometry
+instead of the generic computed defaults — `serve` warmup on a v5e pod
+slice should not have to re-measure what every v5e measures.
+
+Precedence note: the issue sketch placed packaged tables between env and
+store, but a persistent-store entry is a winner measured on the
+operator's *actual device and shapes* while a packaged value covers the
+device *class* — letting the class table shadow local measurements would
+make `ia tune` a no-op on any device with a packaged row.  So the chain
+is:  override > env > store > **packaged** > computed default.
+
+Entries mirror the store's partial-knob shape: per class, a ``"*"`` row
+of device-wide constants (VMEM budgets are per-device facts, not
+per-shape), optionally refined by ``"{strategy}|{dtype}"`` rows.  The v4
+row matches :mod:`tune.geometry` by construction — v4 is where the
+round-5 hand sweep that produced those defaults ran; the table makes the
+provenance explicit ("packaged", not "default") without changing values.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+_MIB = 2 ** 20
+
+TABLES: Dict[str, Dict[str, Dict[str, int]]] = {
+    "v4": {
+        # Reference class: the legacy defaults WERE the v4 sweep winners.
+        "*": {"packed_tile_cap": 16384, "packed_vmem_limit": 110 * _MIB},
+    },
+    "v5e": {
+        # 128 MiB VMEM (see pallas guide) but a narrower core than v4:
+        # leave more compiler headroom and keep scan tiles smaller.
+        "*": {"packed_tile_cap": 8192, "packed_vmem_limit": 96 * _MIB},
+        "wavefront|bf16": {"tile_rows": 2048},
+    },
+    "v5p": {
+        # More VMEM headroom + HBM bandwidth: larger tiles amortize the
+        # per-grid-step overhead better.
+        "*": {"packed_tile_cap": 32768, "packed_vmem_limit": 120 * _MIB},
+        "wavefront|bf16": {"tile_rows": 8192},
+    },
+}
+
+
+def device_class(kind: str) -> Optional[str]:
+    """Map a jax ``device_kind`` string to a table class; None when the
+    device has no packaged table (CPU, GPU, unknown TPUs)."""
+    k = (kind or "").lower()
+    if "v5p" in k:
+        return "v5p"
+    if "v5e" in k or "v5 lite" in k or "v5lite" in k:
+        return "v5e"
+    if "v4" in k:
+        return "v4"
+    return None
+
+
+def lookup(kind: str, strategy: str, dtype: str) -> Dict[str, Any]:
+    """Merged packaged knobs for one resolution key ({} = no table)."""
+    cls = device_class(kind)
+    if cls is None:
+        return {}
+    table = TABLES.get(cls, {})
+    merged = dict(table.get("*", {}))
+    merged.update(table.get(f"{strategy}|{dtype}", {}))
+    return merged

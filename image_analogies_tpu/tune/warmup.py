@@ -94,3 +94,10 @@ def warmup(params: Any, height: int, width: int, *,
         "compile_ms": counters.get("compile.ms", 0),
         "compile_cache_hits": counters.get("compile.cache_hits", 0),
         "compile_cache_dir": compile_cache_dir(wp)}
+
+
+def warmup_buckets(params: Any, sizes, *, seed: int = 0):
+    """AOT-precompile a set of (height, width) target sizes — the serve/
+    lifecycle runs this over its configured bucket set before accepting
+    traffic.  Returns one ``warmup`` summary per size."""
+    return [warmup(params, int(h), int(w), seed=seed) for (h, w) in sizes]

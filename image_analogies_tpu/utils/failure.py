@@ -52,16 +52,27 @@ _NON_TRANSIENT_CODES = ("INVALID_ARGUMENT", "FAILED_PRECONDITION",
 
 
 def _is_transient(exc: BaseException) -> bool:
-    """Transient == worth retrying: device/runtime faults, not bugs."""
-    if isinstance(exc, InjectedFailure):
-        return True
-    # jax.errors.JaxRuntimeError wraps XLA/PJRT runtime failures; keep the
-    # check name-based so this works across jax versions without importing
-    # private exception types.
-    for klass in type(exc).__mro__:
-        if klass.__name__ in ("JaxRuntimeError", "XlaRuntimeError"):
-            msg = str(exc)
-            return not any(code in msg for code in _NON_TRANSIENT_CODES)
+    """Transient == worth retrying: device/runtime faults, not bugs.
+
+    Walks ``__cause__``/``__context__`` chains: jax re-raises device
+    faults wrapped in tracing-layer exceptions (and callers sometimes
+    wrap them again), so the transient signal may sit several links deep.
+    A non-transient runtime code anywhere in the chain wins — an
+    INVALID_ARGUMENT stays a bug no matter what it was wrapped in.
+    """
+    seen = set()
+    while exc is not None and id(exc) not in seen:
+        seen.add(id(exc))
+        if isinstance(exc, InjectedFailure):
+            return True
+        # jax.errors.JaxRuntimeError wraps XLA/PJRT runtime failures; keep
+        # the check name-based so this works across jax versions without
+        # importing private exception types.
+        for klass in type(exc).__mro__:
+            if klass.__name__ in ("JaxRuntimeError", "XlaRuntimeError"):
+                msg = str(exc)
+                return not any(code in msg for code in _NON_TRANSIENT_CODES)
+        exc = exc.__cause__ or exc.__context__
     return False
 
 

@@ -149,6 +149,80 @@ def test_nontransient_errors_not_retried():
     assert calls["n"] == 1  # no retry on programming errors
 
 
+def test_retry_exhaustion_surfaces_original_exception():
+    """A persistent transient fault exhausts the budget and the caller
+    sees the ORIGINAL exception object — not a wrapper, not a generic
+    retry error — so upstream handlers keep their type checks."""
+    from image_analogies_tpu.utils import failure
+
+    class XlaRuntimeError(RuntimeError):  # name-matched as transient
+        pass
+
+    raised = []
+
+    def always_down():
+        exc = XlaRuntimeError("UNAVAILABLE: device lost")
+        raised.append(exc)
+        raise exc
+
+    with pytest.raises(XlaRuntimeError) as ei:
+        failure.run_with_retry(always_down, retries=2, backoff_s=0.0)
+    assert len(raised) == 3  # initial attempt + 2 retries
+    assert ei.value is raised[-1]
+
+
+def test_is_transient_walks_exception_chains():
+    """jax re-raises device faults wrapped in tracing-layer exceptions:
+    the transient signal (or a non-transient status code) must be found
+    through __cause__/__context__ chains, and cycles must terminate."""
+    from image_analogies_tpu.utils import failure
+
+    class XlaRuntimeError(RuntimeError):
+        pass
+
+    def chained(inner):
+        try:
+            try:
+                raise inner
+            except Exception as e:
+                raise RuntimeError("engine wrapper") from e
+        except RuntimeError as outer:
+            return outer
+
+    assert failure._is_transient(chained(XlaRuntimeError("UNAVAILABLE: x")))
+    assert failure._is_transient(chained(failure.InjectedFailure("synth")))
+    # a non-transient status code stays a bug no matter the wrapping
+    assert not failure._is_transient(
+        chained(XlaRuntimeError("INVALID_ARGUMENT: bad shape")))
+    assert not failure._is_transient(chained(ValueError("plain bug")))
+    # self-referential chains terminate via the cycle guard
+    loop = RuntimeError("loop")
+    loop.__context__ = loop
+    assert not failure._is_transient(loop)
+
+
+def test_retry_wrapper_inert_when_injection_disabled(monkeypatch):
+    """Disarmed injector + clean fn: the wrapper is a plain passthrough —
+    one call, no metric or log activity on the success path."""
+    from image_analogies_tpu.obs import metrics as obs_metrics
+    from image_analogies_tpu.utils import failure
+
+    assert failure._INJECT["n"] == 0
+
+    def touched(*a, **k):
+        raise AssertionError("metrics touched on the clean path")
+
+    monkeypatch.setattr(obs_metrics, "inc", touched)
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+        return 42
+
+    assert failure.run_with_retry(fn, retries=3) == 42
+    assert calls["n"] == 1
+
+
 def test_ssim_properties(rng):
     x = rng.uniform(0, 1, (32, 32))
     assert ssim(x, x) == pytest.approx(1.0, abs=1e-9)

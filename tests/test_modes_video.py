@@ -115,6 +115,40 @@ def test_video_temporal_term_increases_frame_coherence(small):
     assert dt <= d0 + 1e-6, (dt, d0)
 
 
+def test_video_clip_pins_tune_geometry_once(small, monkeypatch, tmp_path):
+    """Satellite: a clip resolves its kernel geometry ONCE up front and
+    pins it — provenance counters record exactly one consult per clip,
+    so frame batches inside the clip can never diverge mid-run."""
+    from image_analogies_tpu.obs import metrics as obs_metrics
+    from image_analogies_tpu.obs import trace as obs_trace
+    from image_analogies_tpu.tune import resolve as tune
+    from image_analogies_tpu.tune import store as tune_store
+
+    for var in ("IA_TILE_ROWS", "IA_PACKED_TILE", "IA_PACKED_VMEM"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("IA_TUNE_STORE", str(tmp_path / "no_store.json"))
+    tune_store.invalidate_cache()
+    tune.reset_provenance()
+
+    a, ap, _ = small
+    r = np.random.default_rng(2)
+    frames = [np.clip(a + 0.02 * r.standard_normal(a.shape), 0, 1)
+              .astype(np.float32) for _ in range(3)]
+    p = _params(levels=2, temporal_weight=1.0, metrics=True)
+    # outer scope joins video_analogy's own run reentrantly, so the
+    # counters stay readable after each clip returns
+    with obs_trace.run_scope(p):
+        video_analogy(a, ap, frames, p, scheme="sequential")
+        snap1 = obs_metrics.snapshot()
+        video_analogy(a, ap, frames, p, scheme="sequential")
+        snap2 = obs_metrics.snapshot()
+    # one consult for clip 1, one more for clip 2: pinning is per-clip,
+    # not a process-global memo that would mask store updates
+    assert snap1["counters"]["tune.fallbacks"] == 1
+    assert snap2["counters"]["tune.fallbacks"] == 2
+    assert "tune.store_hits" not in snap1["counters"]
+
+
 def test_video_flicker_metric(small):
     a, ap, _ = small
     r = np.random.default_rng(0)

@@ -1,0 +1,341 @@
+"""serve/ subsystem (ISSUE 4): admission control, micro-batching,
+deadlines, graceful degradation, failure retry, telemetry.
+
+Acceptance invariants locked here:
+
+- queue-full submits get Rejected("queue_full") immediately — no hang,
+  no unbounded queue growth;
+- an already-expired deadline is cancelled BEFORE dispatch
+  (DeadlineExceeded), an unmeetable-but-live deadline yields a valid
+  response flagged as degraded;
+- an injected transient device failure retries inside the server and the
+  client never observes an error;
+- batched responses are bit-identical to singleton engine dispatch;
+- serving telemetry flows end-to-end: serve_request records + spans in
+  the run log, a "serving" section in `ia report`, a serve track in
+  `ia trace` output;
+- serve/ never calls jit/pjit/pmap directly (grep lock) — all device
+  work goes through the engine entry point.
+"""
+
+import json
+import os
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.models.analogy import create_image_analogy
+from image_analogies_tpu.serve import (
+    DeadlineExceeded,
+    Rejected,
+    Server,
+    ServeConfig,
+)
+from image_analogies_tpu.serve.worker import WorkerPool
+from tests.conftest import make_pair
+
+
+@pytest.fixture(autouse=True)
+def _disarm_fault_injector():
+    yield
+    from image_analogies_tpu.utils import failure
+
+    failure.inject_failures(0)
+
+
+def _params(**kw):
+    kw.setdefault("levels", 2)
+    kw.setdefault("backend", "cpu")
+    return AnalogyParams(**kw)
+
+
+def _cfg(params=None, **kw):
+    return ServeConfig(params=params or _params(), **kw)
+
+
+def _wait_until(pred, timeout=10.0):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if pred():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+def _gate_workers(monkeypatch):
+    """Block every worker batch until the returned event is set — makes
+    queue-occupancy tests deterministic."""
+    gate = threading.Event()
+    orig = WorkerPool._run_batch
+
+    def gated(self, batch):
+        gate.wait(30)
+        orig(self, batch)
+
+    monkeypatch.setattr(WorkerPool, "_run_batch", gated)
+    return gate
+
+
+# ------------------------------------------------ admission control
+
+
+def test_queue_full_rejected_immediately(monkeypatch):
+    gate = _gate_workers(monkeypatch)
+    cfg = _cfg(queue_depth=2, workers=1, max_batch=1, batch_window_ms=0.0)
+    a, ap, b = make_pair(10, 10, seed=1)
+    with Server(cfg) as srv:
+        first = srv.submit(a, ap, b)
+        # the single worker pops the leader and blocks on the gate...
+        assert _wait_until(lambda: srv.queue_depth == 0)
+        queued = [srv.submit(a, ap, b) for _ in range(2)]  # ...queue fills
+        t0 = time.monotonic()
+        with pytest.raises(Rejected) as ei:
+            srv.submit(a, ap, b)
+        assert ei.value.reason == "queue_full"
+        assert time.monotonic() - t0 < 1.0  # immediate, not a blocked wait
+        gate.set()
+        for fut in [first] + queued:
+            assert fut.result(timeout=60).bp is not None
+
+
+def test_submit_after_shutdown_rejected():
+    cfg = _cfg(workers=1)
+    srv = Server(cfg).start()
+    srv.shutdown()
+    a, ap, b = make_pair(8, 8, seed=2)
+    with pytest.raises(Rejected) as ei:
+        srv.submit(a, ap, b)
+    assert ei.value.reason == "shutting_down"
+
+
+def test_shutdown_without_drain_fails_queued(monkeypatch):
+    gate = _gate_workers(monkeypatch)
+    cfg = _cfg(queue_depth=8, workers=1, max_batch=1, batch_window_ms=0.0)
+    a, ap, b = make_pair(10, 10, seed=3)
+    srv = Server(cfg).start()
+    inflight = srv.submit(a, ap, b)
+    assert _wait_until(lambda: srv.queue_depth == 0)
+    queued = srv.submit(a, ap, b)
+    threading.Timer(0.2, gate.set).start()
+    srv.shutdown(drain=False)
+    with pytest.raises(Rejected) as ei:
+        queued.result(timeout=1)
+    assert ei.value.reason == "shutting_down"
+    # the in-flight request still completes normally during drain
+    assert inflight.result(timeout=60).bp is not None
+
+
+# --------------------------------------- micro-batching + bit-identity
+
+
+def test_batch_coalesces_and_matches_singleton_dispatch():
+    """Same-exemplar burst coalesces into one batch; every response is
+    bit-identical to a direct engine call for the same request."""
+    params = _params()
+    a, ap, _ = make_pair(14, 14, seed=4)
+    rng = np.random.default_rng(4)
+    targets = [rng.random((14, 14), dtype=np.float32).astype(np.float32)
+               for _ in range(3)]
+    singleton = [create_image_analogy(a, ap, b, params).bp for b in targets]
+
+    # max_batch == burst size: the window closes the moment the batch is
+    # complete, so a generous window costs nothing and removes timing luck.
+    cfg = _cfg(params=params, workers=1, max_batch=3,
+               batch_window_ms=2000.0)
+    with Server(cfg) as srv:
+        futs = [srv.submit(a, ap, b) for b in targets]
+        resps = [f.result(timeout=120) for f in futs]
+    assert [r.batch_size for r in resps] == [3, 3, 3]
+    assert all(r.status == "ok" and r.degraded is None for r in resps)
+    for resp, ref in zip(resps, singleton):
+        np.testing.assert_array_equal(resp.bp, ref)
+
+
+def test_incompatible_params_do_not_share_a_batch():
+    params = _params()
+    a, ap, b = make_pair(12, 12, seed=5)
+    cfg = _cfg(params=params, workers=1, max_batch=4, batch_window_ms=500.0)
+    with Server(cfg) as srv:
+        f1 = srv.submit(a, ap, b)
+        f2 = srv.submit(a, ap, b, params=params.replace(kappa=9.0))
+        r1, r2 = f1.result(timeout=120), f2.result(timeout=120)
+    # different params digest -> different batch keys -> singleton batches
+    assert r1.batch_size == 1 and r2.batch_size == 1
+
+
+# --------------------------------------------- deadlines + degradation
+
+
+def test_expired_deadline_cancelled_before_dispatch():
+    cfg = _cfg(workers=1)
+    a, ap, b = make_pair(12, 12, seed=6)
+    with Server(cfg) as srv:
+        fut = srv.submit(a, ap, b, deadline_s=0.0)  # expired at submit
+        with pytest.raises(DeadlineExceeded) as ei:
+            fut.result(timeout=60)
+    assert ei.value.request_id == 1
+
+
+def test_unmeetable_deadline_degrades_but_serves():
+    """With a measured cost model that says full fidelity cannot meet the
+    deadline, the request is served at reduced fidelity and flagged —
+    never silently dropped."""
+    params = _params(levels=2, patch_size=5)
+    a, ap, b = make_pair(16, 16, seed=7)
+    cfg = _cfg(params=params, workers=1, max_batch=1, batch_window_ms=0.0)
+    with Server(cfg) as srv:
+        # seed the EWMA at 1e-3 s/unit: full fidelity (16*16*2*25 units)
+        # estimates 12.8s against a 5s deadline, the 3x3 ladder rungs fit
+        srv.cost_model.observe(1000.0, 1.0)
+        resp = srv.request(a, ap, b, deadline_s=5.0, timeout=120)
+    assert resp.status == "degraded"
+    assert resp.degraded is not None
+    assert resp.degraded["patch_size"] == 3
+    assert resp.degraded["levels"] <= params.levels
+    assert resp.bp.shape == b.shape
+    assert np.isfinite(np.asarray(resp.bp)).all()
+
+
+def test_no_degrade_config_runs_full_fidelity():
+    params = _params(levels=2)
+    a, ap, b = make_pair(12, 12, seed=8)
+    cfg = _cfg(params=params, workers=1, degrade=False)
+    with Server(cfg) as srv:
+        srv.cost_model.observe(1000.0, 1.0)  # same pessimistic model
+        resp = srv.request(a, ap, b, deadline_s=5.0, timeout=120)
+    assert resp.status == "ok" and resp.degraded is None
+    np.testing.assert_array_equal(
+        resp.bp, create_image_analogy(a, ap, b, params).bp)
+
+
+# ------------------------------------------------ failure injection
+
+
+def test_injected_transient_failure_retried_transparently(tmp_path):
+    """SURVEY.md §5.3 in the serving path: the worker's retry wrapper
+    absorbs a transient fault; the client sees a clean, correct result."""
+    from image_analogies_tpu.utils import failure
+
+    log = str(tmp_path / "serve.jsonl")
+    params = _params(log_path=log)
+    a, ap, b = make_pair(12, 12, seed=9)
+    clean = create_image_analogy(a, ap, b, _params())
+    cfg = _cfg(params=params, workers=1, request_retries=2)
+    with Server(cfg) as srv:
+        failure.inject_failures(1)  # the first wrapped dispatch dies
+        resp = srv.request(a, ap, b, timeout=120)
+    assert resp.status == "ok"
+    np.testing.assert_array_equal(resp.bp_y, clean.bp_y)
+    recs = [json.loads(l) for l in open(log) if l.strip()]
+    retries = [r for r in recs if r.get("event") == "level_retry"
+               and r.get("scope") == "serve"]
+    assert len(retries) == 1 and retries[0]["error"] == "InjectedFailure"
+    errors = [r for r in recs if r.get("event") == "serve_request"
+              and r.get("status") == "error"]
+    assert not errors
+
+
+# --------------------------------------------------- selftest smoke
+
+
+def test_selftest_smoke_zero_drops_bit_identical():
+    """Fast tier-1 slice of `ia serve --selftest`: every request admitted
+    and completed, outputs bit-identical to the sequential baseline."""
+    from image_analogies_tpu.serve import loadgen
+
+    cfg = _cfg(workers=2, max_batch=4, batch_window_ms=25.0)
+    summary = loadgen.selftest(cfg, 4, seed=0,
+                               shapes=((12, 12), (14, 14)))
+    assert summary["rejected"] == 0
+    assert summary["errors"] == 0 and summary["timeouts"] == 0
+    assert summary["completed"] == 4 and summary["degraded"] == 0
+    assert summary["bit_identical"] is True
+    assert sum(int(v) for v in summary["batch_size_hist"].values()) == 4
+
+
+# ------------------------------------------- telemetry end-to-end
+
+
+def test_cli_selftest_report_and_trace(tmp_path, capsys):
+    """`ia serve --selftest` writes a run log whose serving telemetry
+    survives the whole obs pipeline: `ia report` renders the serving
+    section, `ia trace` exports serve-track events."""
+    from image_analogies_tpu.cli import main
+    from image_analogies_tpu.obs import export as obs_export
+
+    log = str(tmp_path / "serve.jsonl")
+    rc = main(["serve", "--selftest", "3", "--workers", "1",
+               "--max-batch", "3", "--batch-window-ms", "50",
+               "--levels", "2", "--backend", "cpu", "--log-path", log])
+    captured = capsys.readouterr()
+    assert rc == 0, captured.err
+    assert "selftest: 3 requests" in captured.out
+    assert "bit-identical to singleton dispatch: True" in captured.out
+
+    rc = main(["report", log])
+    assert rc == 0
+    rep = capsys.readouterr().out
+    assert "serving:" in rep
+    assert "admission" in rep and "p50" in rep
+
+    out = str(tmp_path / "trace.json")
+    rc = main(["trace", log, "-o", out])
+    assert rc == 0
+    capsys.readouterr()
+    trace = json.load(open(out))
+    serve_events = [e for e in trace["traceEvents"]
+                    if e.get("tid") == obs_export.SERVE_TID]
+    reqs = [e for e in serve_events
+            if e["ph"] == "X" and e["name"].startswith("req ")]
+    assert len(reqs) == 3  # one interval per served request
+    names = {e.get("args", {}).get("name") for e in trace["traceEvents"]
+             if e["ph"] == "M"}
+    assert "serve" in names  # the serve track is labeled
+
+
+def test_server_scope_counters_in_report(tmp_path):
+    """Server lifetime = one obs run: run_end carries the admission and
+    outcome counters `ia report --json` aggregates."""
+    from image_analogies_tpu.obs import report as obs_report
+
+    log = str(tmp_path / "run.jsonl")
+    params = _params(log_path=log)
+    a, ap, b = make_pair(12, 12, seed=10)
+    cfg = _cfg(params=params, workers=1)
+    with Server(cfg) as srv:
+        srv.request(a, ap, b, timeout=120)
+        with pytest.raises(DeadlineExceeded):
+            srv.request(a, ap, b, deadline_s=0.0, timeout=60)
+    an = obs_report.analyze(obs_report.load_records(log))
+    srv_info = an["serve"]
+    assert srv_info is not None
+    assert srv_info["accepted"] == 2 and srv_info["rejected"] == 0
+    assert srv_info["completed"] == 1 and srv_info["timeouts"] == 1
+    assert srv_info["p50_ms"] > 0
+
+
+# ------------------------------------------------------- grep locks
+
+
+def test_serve_never_calls_jit_directly():
+    """serve/ is a host-side scheduler: all device work goes through the
+    engine entry point (which owns jit/sharding), and no serve module
+    imports jax at module scope — `import serve` must stay cheap."""
+    import image_analogies_tpu.serve as serve_pkg
+
+    root = os.path.dirname(serve_pkg.__file__)
+    # call syntax, so prose mentions in docstrings don't trip the lock
+    forbidden = re.compile(r"\bjax\.jit\s*\(|\bpjit\s*\(|\bjax\.pmap\s*\(")
+    toplevel_jax = re.compile(r"^(import jax|from jax)", re.MULTILINE)
+    for name in sorted(os.listdir(root)):
+        if not name.endswith(".py"):
+            continue
+        with open(os.path.join(root, name)) as f:
+            src = f.read()
+        assert not forbidden.findall(src), f"serve/{name} calls jit/pjit"
+        assert not toplevel_jax.findall(src), (
+            f"serve/{name} imports jax at module scope")

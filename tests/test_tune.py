@@ -228,6 +228,102 @@ def test_buckets_enabled_env_wins(monkeypatch):
     assert not buckets.buckets_enabled(p_on)
 
 
+# --------------------------------------------------- packaged tables
+
+
+def test_packaged_table_for_known_device_class(monkeypatch):
+    """Satellite: known TPU classes resolve shipped per-class geometry
+    (origin "packaged"); unknown devices keep the computed defaults."""
+    monkeypatch.setattr(tune, "device_kind", lambda: "TPU v5e")
+    cfg = tune.resolve(strategy="wavefront", dtype="bf16", fp=256,
+                       n_rows=500)
+    assert cfg.tile_rows == 2048
+    assert cfg.origin_of("tile_rows") == "packaged"
+    assert cfg.packed_tile_cap == 8192
+    assert cfg.origin_of("packed_tile_cap") == "packaged"
+
+    monkeypatch.setattr(tune, "device_kind", lambda: "cpu")
+    cfg2 = tune.resolve(strategy="wavefront", dtype="bf16", fp=256)
+    assert all(o == "default" for _, o in cfg2.origin)
+
+
+def test_v4_packaged_row_matches_legacy_defaults(monkeypatch):
+    """The v4 table is the reference sweep: values equal the legacy
+    constants, only the provenance label changes."""
+    monkeypatch.setattr(tune, "device_kind", lambda: "TPU v4")
+    cfg = tune.resolve(strategy="wavefront", dtype="packed2", fp=256)
+    assert cfg.packed_tile_cap == geometry.DEFAULT_PACKED_TILE_CAP
+    assert cfg.packed_vmem_limit == geometry.DEFAULT_PACKED_VMEM_LIMIT
+    assert cfg.origin_of("packed_tile_cap") == "packaged"
+    # tile_rows has no v4 row -> still the computed default
+    assert cfg.origin_of("tile_rows") == "default"
+
+
+def test_store_beats_packaged_and_counters(monkeypatch, tmp_path):
+    """Precedence: a locally measured store entry shadows the shipped
+    class value; counters distinguish the two origins."""
+    monkeypatch.setattr(tune, "device_kind", lambda: "TPU v5p")
+    p = AnalogyParams(metrics=True)
+    with obs_trace.run_scope(p):
+        cfg = tune.resolve(strategy="wavefront", dtype="bf16", fp=256)
+        snap = obs_metrics.snapshot()
+    assert cfg.tile_rows == 8192  # v5p wavefront|bf16 packaged row
+    assert snap["counters"]["tune.packaged"] == 1
+    assert "tune.fallbacks" not in snap["counters"]
+
+    path = str(tmp_path / "measured.json")
+    key = tune.make_key("TPU v5p", "wavefront", "bf16", 256, "*")
+    tune_store.save_entries({key: {"tile_rows": 1234}}, path)
+    monkeypatch.setenv("IA_TUNE_STORE", path)
+    tune_store.invalidate_cache()
+    with obs_trace.run_scope(p):
+        cfg2 = tune.resolve(strategy="wavefront", dtype="bf16", fp=256)
+        snap2 = obs_metrics.snapshot()
+    assert cfg2.tile_rows == 1234
+    assert cfg2.origin_of("tile_rows") == "store_wildcard"
+    # un-measured knobs still fall through to the packaged class row
+    assert cfg2.origin_of("packed_tile_cap") == "packaged"
+    assert snap2["counters"]["tune.store_hits"] == 1
+
+
+def test_device_class_mapping():
+    from image_analogies_tpu.tune import tables
+
+    assert tables.device_class("TPU v4") == "v4"
+    assert tables.device_class("TPU v5e") == "v5e"
+    assert tables.device_class("TPU v5 lite") == "v5e"
+    assert tables.device_class("TPU v5p") == "v5p"
+    assert tables.device_class("cpu") is None
+    assert tables.device_class("") is None
+    assert tables.lookup("cpu", "wavefront", "f32") == {}
+
+
+# ------------------------------------------------------ pin scope
+
+
+def test_pin_scope_single_consult_per_key(monkeypatch, tmp_path):
+    """Inside pin_scope a key resolves once; repeats return the pinned
+    config with no store consult and no counter/record activity."""
+    p = AnalogyParams(metrics=True)
+    with obs_trace.run_scope(p):
+        with tune.pin_scope():
+            first = tune.tile_rows(128, n_rows=500)
+            again = tune.tile_rows(128, n_rows=500)
+            tune.tile_rows(512, n_rows=500)  # distinct key -> consult
+            snap = obs_metrics.snapshot()
+    assert first == again
+    assert snap["counters"]["tune.fallbacks"] == 2  # not 3
+
+    # reentrant: an inner scope joins the outer pin cache
+    with obs_trace.run_scope(p):
+        with tune.pin_scope():
+            tune.tile_rows(128, n_rows=500)
+            with tune.pin_scope():
+                tune.tile_rows(128, n_rows=500)
+            snap2 = obs_metrics.snapshot()
+    assert snap2["counters"]["tune.fallbacks"] == 1
+
+
 # ----------------------------------------------------------- grep lock
 
 
