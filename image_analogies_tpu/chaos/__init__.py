@@ -1,0 +1,40 @@
+"""Seeded fault-injection plane + resilience drills (ISSUE 5).
+
+Hertzmann §3 makes the pyramid level the natural recovery unit, and the
+engine already has level-granular retry + checkpoints — but recovery
+paths that are never driven under realistic, reproducible fault
+schedules are robust only by assertion.  This package is the machinery
+that proves them:
+
+- :mod:`chaos.plan`   — :class:`ChaosPlan`: a seed plus per-site fault
+  rules (probability or explicit call schedule, fault kind).  Same seed
+  ⇒ same fault schedule, so CI drills are reproducible.
+- :mod:`chaos.inject` — the injection plane.  Engine layers register
+  *sites* (``chaos.site("level.dispatch", ...)``) at their boundaries;
+  each site is a named no-op when chaos is disarmed (one module-bool
+  check, no metric/log/lock activity — the same zero-cost-off contract
+  obs/ holds).
+- :mod:`chaos.faults` — the fault kinds: transient errors, OOM-style
+  ``RESOURCE_EXHAUSTED`` runtime errors, latency spikes / hangs,
+  checkpoint byte corruption, worker-thread crashes.
+- :mod:`chaos.runner` — ``ia chaos`` drills: run a workload under a
+  plan and assert the resilience invariants (bit-identical output, no
+  lost or hung request, queue drains, counters reconcile).
+
+No module here imports jax — the plane is pure host-side control flow;
+sites are data-driven (grep-locked in tests/test_chaos.py).
+"""
+
+from image_analogies_tpu.chaos.inject import (  # noqa: F401
+    arm,
+    armed,
+    disarm,
+    injected_total,
+    plan_scope,
+    plan_seed,
+    site,
+    snapshot,
+)
+from image_analogies_tpu.chaos.plan import ChaosPlan, SiteRule  # noqa: F401
+
+FAULT_KINDS = ("transient", "oom", "latency", "corrupt", "crash")

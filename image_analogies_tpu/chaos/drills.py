@@ -1,0 +1,79 @@
+"""Drill workloads: small, deterministic, CPU-friendly jobs the runner
+executes under a fault plan.
+
+Everything here is seeded numpy — the SAME inputs and params are used
+for the clean reference run and the chaos run, so "bit-identical output"
+is a meaningful assertion, not a tolerance check.  jax is only touched
+inside the engine calls (lazy imports keep chaos/ importable — and
+grep-locked jax-free — on any host).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def make_inputs(size: Tuple[int, int] = (20, 20), seed: int = 7
+                ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Deterministic (A, A', B) planes for one synthesis."""
+    h, w = size
+    rng = np.random.RandomState(seed)
+    return (rng.rand(h, w).astype(np.float32),
+            rng.rand(h, w).astype(np.float32),
+            rng.rand(h, w).astype(np.float32))
+
+
+def image_params(*, levels: int = 2, retries: int = 3,
+                 checkpoint_dir: Optional[str] = None,
+                 dispatch_timeout_s: float = 0.0):
+    """Small CPU engine config for image drills.  Patch 3 / tiny planes:
+    a drill exercises control flow, not throughput."""
+    from image_analogies_tpu.config import AnalogyParams
+
+    return AnalogyParams(backend="cpu", levels=levels, patch_size=3,
+                         coarse_patch_size=3, level_retries=retries,
+                         checkpoint_dir=checkpoint_dir,
+                         dispatch_timeout_s=dispatch_timeout_s,
+                         metrics=True)
+
+
+def run_image(a: np.ndarray, ap: np.ndarray, b: np.ndarray, params
+              ) -> np.ndarray:
+    """One engine synthesis; returns the host bp plane."""
+    from image_analogies_tpu.models.analogy import create_image_analogy
+
+    return np.asarray(create_image_analogy(a, ap, b, params).bp)
+
+
+def make_serve_load(n: int, size: Tuple[int, int] = (12, 12), seed: int = 7
+                    ) -> List[Dict[str, np.ndarray]]:
+    """N batch-compatible requests (shared exemplars, distinct targets)."""
+    rng = np.random.RandomState(seed)
+    h, w = size
+    a = rng.rand(h, w).astype(np.float32)
+    ap = rng.rand(h, w).astype(np.float32)
+    return [{"index": i, "a": a, "ap": ap,
+             "b": rng.rand(h, w).astype(np.float32)}
+            for i in range(n)]
+
+
+def serve_config(*, workers: int = 2, max_batch: int = 4,
+                 crash_requeues: int = 1, breaker_threshold: int = 5,
+                 deadline_ordering: bool = True):
+    """Small CPU serve config for serve drills."""
+    from image_analogies_tpu.serve.types import ServeConfig
+
+    return ServeConfig(
+        params=image_params(levels=1, retries=0),
+        queue_depth=64,
+        batch_window_ms=2.0,
+        max_batch=max_batch,
+        workers=workers,
+        request_retries=2,
+        crash_requeues=crash_requeues,
+        breaker_threshold=breaker_threshold,
+        deadline_ordering=deadline_ordering,
+        drain_timeout_s=60.0,
+    )

@@ -1,0 +1,86 @@
+"""Fault implementations — what an armed site actually does.
+
+Raising kinds throw exception types chosen to exercise the REAL
+classification paths, not shortcuts:
+
+- ``transient`` raises :class:`ChaosTransient`, a subclass of
+  ``utils.failure.InjectedFailure`` — the retry wrapper's canonical
+  synthetic transient.
+- ``oom`` raises a class literally named ``XlaRuntimeError`` carrying a
+  ``RESOURCE_EXHAUSTED`` status message, so ``failure._is_transient``'s
+  name-based jax-error matching (and its status-code filter) is the
+  thing under test, exactly as a real device OOM would hit it.
+- ``crash`` raises :class:`WorkerCrash` — deliberately NOT transient:
+  retry wrappers must not absorb it; the serve worker's crash
+  containment (batch requeue) is the only recovery path.
+
+``corrupt`` is not raised at all: the site returns the ``"corrupt"``
+directive and the call site (checkpoint save) applies
+:func:`corrupt_file` — deterministic byte flips seeded by the plan, so
+the same plan always produces the same corruption.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import random
+
+from image_analogies_tpu.utils.failure import InjectedFailure
+
+
+def stream_seed(*parts) -> int:
+    """Stable int seed from mixed parts.  ``hash()`` of a str is
+    randomized per process (PYTHONHASHSEED), so seeding Random with a
+    tuple containing site names would silently break the cross-process
+    determinism contract — digest instead."""
+    digest = hashlib.sha256(repr(parts).encode()).digest()
+    return int.from_bytes(digest[:8], "big")
+
+
+class ChaosTransient(InjectedFailure):
+    """Injected transient device fault (retryable by design)."""
+
+
+class XlaRuntimeError(RuntimeError):
+    """Injected runtime error whose NAME is what the transient classifier
+    keys on — messages carry an XLA status code so both the retryable
+    (RESOURCE_EXHAUSTED) and bug (INVALID_ARGUMENT) branches are
+    reachable from drills."""
+
+
+class WorkerCrash(RuntimeError):
+    """Injected worker-thread crash: non-transient on purpose."""
+
+
+def oom_error(site: str, visit: int) -> XlaRuntimeError:
+    return XlaRuntimeError(
+        f"RESOURCE_EXHAUSTED: chaos oom at {site} (visit {visit}): "
+        "attempting to allocate 9.99G hbm")
+
+
+def corrupt_file(path: str, seed: int, n_flips: int = 16) -> int:
+    """Deterministically flip ``n_flips`` bytes of ``path`` in place.
+
+    Returns the number of bytes flipped (0 when the file is empty or
+    missing — corruption of nothing is a no-op, not an error).  Flips
+    land in the back half of the file so container headers survive and
+    the damage surfaces as payload corruption (truncated/garbled npz),
+    the realistic partial-write failure mode.
+    """
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return 0
+    if size == 0:
+        return 0
+    rng = random.Random(stream_seed(seed, os.path.basename(path), size))
+    offsets = sorted({rng.randrange(size // 2, size)
+                      for _ in range(min(n_flips, size))})
+    with open(path, "r+b") as f:
+        for off in offsets:
+            f.seek(off)
+            b = f.read(1)
+            f.seek(off)
+            f.write(bytes([b[0] ^ 0xFF]))
+    return len(offsets)

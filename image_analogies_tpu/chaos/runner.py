@@ -1,0 +1,326 @@
+"""Drill runner — ``ia chaos``: run workloads under fault plans and
+assert the resilience invariants.
+
+A drill is: clean reference run (disarmed) → chaos run (armed plan) →
+invariant checks.  The invariants are the PR's acceptance criteria, not
+soft goals:
+
+- **bit-identical output** — recovery must reproduce the clean run's
+  planes exactly (CPU backend; the engine is deterministic, so equality
+  is the right assertion);
+- **nothing lost** — every serve submit resolves to exactly one of
+  ok / degraded / timeout / rejected, the queue drains, worker threads
+  survive;
+- **counters reconcile** — every injection is visible in the recovery
+  counters it caused (retries, watchdog timeouts, quarantines, worker
+  crashes).  An injection that no counter accounts for means a fault
+  path silently swallowed something.
+
+``selftest`` runs one canonical drill per fault kind plus a
+schedule-determinism check (same seed ⇒ same fault schedule).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from image_analogies_tpu.chaos import drills, inject
+from image_analogies_tpu.chaos.plan import ChaosPlan, SiteRule
+
+# Fault kind -> canonical drill plan.  Schedules (not probabilities) so
+# each selftest drill injects exactly once at a known visit.
+_KIND_NOTES = {
+    "transient": "level retry absorbs an injected transient",
+    "oom": "RESOURCE_EXHAUSTED classifies transient via the real path",
+    "latency": "watchdog converts a wedged dispatch into a retry",
+    "corrupt": "checksum catches damaged checkpoint; quarantine+recompute",
+    "crash": "worker crash containment requeues the batch",
+}
+
+
+def plan_for_kind(kind: str, seed: int = 0) -> ChaosPlan:
+    if kind == "transient":
+        sites = (("level.dispatch", SiteRule(kind="transient",
+                                             schedule=(0,))),)
+    elif kind == "oom":
+        sites = (("level.dispatch", SiteRule(kind="oom", schedule=(1,))),)
+    elif kind == "latency":
+        sites = (("level.dispatch", SiteRule(kind="latency", schedule=(0,),
+                                             latency_ms=200.0, hang=True)),)
+    elif kind == "corrupt":
+        sites = (("ckpt.save", SiteRule(kind="corrupt", schedule=(0,))),)
+    elif kind == "crash":
+        sites = (("serve.dispatch", SiteRule(kind="crash", schedule=(0,))),)
+    else:
+        raise ValueError(f"unknown fault kind {kind!r}")
+    return ChaosPlan(seed=seed, sites=sites, name=f"selftest-{kind}")
+
+
+def _wants_serve(plan: ChaosPlan) -> bool:
+    return any(name.startswith("serve.") for name, _ in plan.sites)
+
+
+def _counters(ctx) -> Dict[str, float]:
+    return dict(ctx.registry.snapshot()["counters"]) if ctx else {}
+
+
+def _reconcile(plan: ChaosPlan, counters: Dict[str, float]) -> List[str]:
+    """Per-kind accounting: every injection must be matched by the
+    recovery counter it should have caused.  Returns failure strings."""
+    problems = []
+
+    def want(name: str, expected: float) -> None:
+        got = counters.get(name, 0)
+        if got != expected:
+            problems.append(f"{name}={got} != expected {expected}")
+
+    by_kind: Dict[str, float] = {}
+    for key, val in counters.items():
+        if key.startswith("chaos.injected."):
+            by_kind[key.split(".", 2)[2]] = val
+    injected = counters.get("chaos.injected", 0)
+    if sum(by_kind.values()) != injected:
+        problems.append("per-kind chaos counters do not sum to total")
+    # Expectations come from the PLAN (per-site injection counters x each
+    # site's rule), because the same kind recovers differently by
+    # placement: transient/oom under the level retry wrapper retry; a
+    # hang surfaces as a watchdog timeout first, THEN retries; a plain
+    # (non-hang) latency spike recovers by itself; corruption surfaces at
+    # load as a quarantine; a crash as a contained worker crash.  A
+    # raising kind at a serve batch boundary is contained as a crash
+    # regardless of its class — the containment layer can't tell.
+    retries = watchdogs = quarantines = crashes = 0.0
+    for name, rule in plan.sites:
+        n = counters.get(f"chaos.site.{name}", 0)
+        if not n:
+            continue
+        if name == "serve.admit":
+            continue  # surfaces synchronously to the client; no recovery
+        if name in ("serve.dispatch",) and rule.kind in (
+                "transient", "oom", "crash"):
+            crashes += n
+        elif rule.kind in ("transient", "oom"):
+            retries += n
+        elif rule.kind == "latency" and rule.hang:
+            watchdogs += n
+            retries += n
+        elif rule.kind == "corrupt":
+            quarantines += n
+        elif rule.kind == "crash":
+            crashes += n
+    if retries:
+        want("level_retry", retries)
+    if watchdogs:
+        want("watchdog.timeouts", watchdogs)
+    if quarantines:
+        want("ckpt.quarantined", quarantines)
+    if crashes:
+        want("serve.worker_crashes", crashes)
+    return problems
+
+
+def drill_image(plan: ChaosPlan, *, seed: int = 7,
+                size=(20, 20), workdir: Optional[str] = None
+                ) -> Dict[str, Any]:
+    """Single-image drill: clean run, chaos run (and for checkpoint
+    corruption a third resume run hitting the quarantine path), then the
+    invariants."""
+    from image_analogies_tpu.obs import trace as obs_trace
+
+    a, ap, b = drills.make_inputs(size, seed)
+    corrupting = any(r.kind == "corrupt" for _, r in plan.sites)
+    hanging = any(r.kind == "latency" and r.hang for _, r in plan.sites)
+
+    clean = drills.run_image(a, ap, b, drills.image_params(retries=0))
+
+    with tempfile.TemporaryDirectory(dir=workdir) as tmp:
+        params = drills.image_params(
+            retries=3,
+            checkpoint_dir=os.path.join(tmp, "ckpt"),
+            # a hang only recovers when something bounds the wait; give
+            # the watchdog a deadline well under the injected latency
+            dispatch_timeout_s=0.05 if hanging else 0.0)
+        with obs_trace.run_scope(params) as ctx:
+            with inject.plan_scope(plan):
+                chaos_bp = drills.run_image(a, ap, b, params)
+                snap = inject.snapshot()
+            resumed_bp = None
+            if corrupting:
+                # resume run (disarmed): hits the damaged file, must
+                # quarantine + recompute to the identical result
+                resumed_bp = drills.run_image(
+                    a, ap, b, params.replace(resume_from_level=0))
+            counters = _counters(ctx)
+
+    identical = bool(np.array_equal(clean, chaos_bp))
+    if resumed_bp is not None:
+        identical = identical and bool(np.array_equal(clean, resumed_bp))
+    problems = [] if identical else ["output differs from clean run"]
+    problems += _reconcile(plan, counters)
+    injected = sum(st["injected"] for st in snap.values())
+    if injected == 0:
+        problems.append("plan injected nothing (dead drill)")
+    return {
+        "workload": "image",
+        "plan": plan.to_dict(),
+        "injected": injected,
+        "sites": snap,
+        "counters": {k: v for k, v in counters.items()
+                     if k.startswith(("chaos.", "level_retry", "retry.",
+                                      "watchdog.", "ckpt."))},
+        "identical": identical,
+        "ok": not problems,
+        "problems": problems,
+    }
+
+
+def drill_serve(plan: ChaosPlan, *, n: int = 6, seed: int = 7
+                ) -> Dict[str, Any]:
+    """Serve drill: burst-submit n requests under the plan; every future
+    must resolve to exactly one known outcome, outputs must match direct
+    engine runs, the queue must drain, and counters must reconcile."""
+    from image_analogies_tpu.obs import trace as obs_trace
+    from image_analogies_tpu.serve.server import Server
+    from image_analogies_tpu.serve.types import DeadlineExceeded, Rejected
+
+    cfg = drills.serve_config()
+    load = drills.make_serve_load(n, seed=seed)
+    baseline = {item["index"]: drills.run_image(
+        item["a"], item["ap"], item["b"], cfg.params)
+        for item in load}
+
+    outcomes: Dict[int, str] = {}
+    responses: Dict[int, Any] = {}
+    unknown_errors: Dict[int, str] = {}
+    with obs_trace.run_scope(cfg.params) as ctx:
+        with inject.plan_scope(plan):
+            with Server(cfg) as srv:
+                futures = {}
+                for item in load:
+                    try:
+                        futures[item["index"]] = srv.submit(
+                            item["a"], item["ap"], item["b"])
+                    except Exception as exc:  # noqa: BLE001 - admission faults
+                        # injected admission faults surface synchronously,
+                        # like any admission refusal
+                        outcomes[item["index"]] = (
+                            "rejected" if isinstance(exc, Rejected)
+                            else "submit_fault")
+                for idx, fut in futures.items():
+                    try:
+                        responses[idx] = fut.result(timeout=120)
+                        outcomes[idx] = responses[idx].status
+                    except Rejected:
+                        outcomes[idx] = "rejected"
+                    except DeadlineExceeded:
+                        outcomes[idx] = "timeout"
+                    except BaseException as exc:  # noqa: BLE001 - audited
+                        outcomes[idx] = "error"
+                        unknown_errors[idx] = repr(exc)
+                drained = srv.queue_depth == 0
+            snap = inject.snapshot()
+        counters = _counters(ctx)
+
+    problems = []
+    if len(outcomes) != n:
+        problems.append(f"{n - len(outcomes)} requests never resolved")
+    if unknown_errors:
+        problems.append(f"unexpected errors: {unknown_errors}")
+    if not drained:
+        problems.append("queue did not drain")
+    identical = all(
+        np.array_equal(responses[i].bp, baseline[i])
+        for i in responses if responses[i].degraded is None)
+    if not identical:
+        problems.append("served output differs from direct engine run")
+    problems += _reconcile(plan, counters)
+    injected = sum(st["injected"] for st in snap.values())
+    if injected == 0:
+        problems.append("plan injected nothing (dead drill)")
+    tally: Dict[str, int] = {}
+    for o in outcomes.values():
+        tally[o] = tally.get(o, 0) + 1
+    return {
+        "workload": "serve",
+        "plan": plan.to_dict(),
+        "injected": injected,
+        "sites": snap,
+        "outcomes": tally,
+        "counters": {k: v for k, v in counters.items()
+                     if k.startswith(("chaos.", "serve.", "level_retry",
+                                      "retry.", "watchdog."))},
+        "identical": identical,
+        "ok": not problems,
+        "problems": problems,
+    }
+
+
+def run_drill(plan: ChaosPlan, **kw) -> Dict[str, Any]:
+    """Dispatch a plan to the workload its sites target."""
+    if _wants_serve(plan):
+        return drill_serve(plan, **kw)
+    return drill_image(plan, **kw)
+
+
+def check_determinism(seed: int = 0) -> Dict[str, Any]:
+    """Same seed ⇒ same fault schedule: run a probabilistic plan's
+    decision stream twice (no workload needed — the stream is a pure
+    function of (plan, visit sequence)) and compare."""
+    plan = ChaosPlan(seed=seed, sites=(
+        ("level.dispatch", SiteRule(kind="latency", p=0.5, latency_ms=0.0)),
+        ("devcache.upload", SiteRule(kind="latency", p=0.3,
+                                     latency_ms=0.0)),
+    ), name="determinism")
+    runs = []
+    for _ in range(2):
+        with inject.plan_scope(plan):
+            for _visit in range(64):
+                inject.site("level.dispatch")
+                inject.site("devcache.upload")
+            runs.append(inject.snapshot())
+    ok = runs[0] == runs[1]
+    return {"workload": "determinism", "plan": plan.to_dict(),
+            "injected": sum(st["injected"] for st in runs[0].values()),
+            "ok": ok,
+            "problems": [] if ok else [f"schedules differ: {runs}"]}
+
+
+def selftest(seed: int = 0, kinds: Optional[Sequence[str]] = None
+             ) -> Dict[str, Any]:
+    """One canonical drill per fault kind + the determinism check."""
+    from image_analogies_tpu.chaos import FAULT_KINDS
+
+    reports = []
+    for kind in (kinds or FAULT_KINDS):
+        plan = plan_for_kind(kind, seed)
+        report = run_drill(plan)
+        report["kind"] = kind
+        report["note"] = _KIND_NOTES.get(kind, "")
+        reports.append(report)
+    det = check_determinism(seed)
+    det["kind"] = "determinism"
+    det["note"] = "same seed, same schedule"
+    reports.append(det)
+    return {"seed": seed, "ok": all(r["ok"] for r in reports),
+            "reports": reports}
+
+
+def render(result: Dict[str, Any]) -> str:
+    lines = [f"chaos selftest (seed {result['seed']}): "
+             f"{'PASS' if result['ok'] else 'FAIL'}"]
+    for r in result["reports"]:
+        status = "ok " if r["ok"] else "FAIL"
+        line = (f"  [{status}] {r.get('kind', r['plan'].get('name', '?')):12s}"
+                f" injected={r.get('injected', 0)}")
+        if "outcomes" in r:
+            line += f" outcomes={r['outcomes']}"
+        if r.get("note"):
+            line += f"  ({r['note']})"
+        lines.append(line)
+        for p in r.get("problems", []):
+            lines.append(f"         ! {p}")
+    return "\n".join(lines)

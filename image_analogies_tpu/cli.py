@@ -94,6 +94,12 @@ def _add_engine_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--level-retries", type=int, default=None,
                    help="retry a level on transient device faults this many "
                         "times (level-granular recovery, SURVEY.md 5.3)")
+    p.add_argument("--dispatch-timeout-s", type=float, default=None,
+                   help="watchdog deadline around each level's device "
+                        "dispatch; a wedged dispatch raises a TRANSIENT "
+                        "WatchdogTimeout (recovered by --level-retries) "
+                        "instead of hanging the run.  0 = inline, no "
+                        "watchdog thread")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--resume-from-level", type=int, default=None)
     p.add_argument("--log-path", default=None)
@@ -129,7 +135,8 @@ def _params_from_args(args, base: AnalogyParams) -> AnalogyParams:
     kw = {}
     for name in ("levels", "kappa", "backend", "strategy", "match_mode",
                  "db_shards", "data_shards", "refine_passes",
-                 "level_retries", "checkpoint_dir", "resume_from_level",
+                 "level_retries", "dispatch_timeout_s", "checkpoint_dir",
+                 "resume_from_level",
                  "log_path", "profile_dir", "save_levels_dir",
                  "compile_cache_dir"):
         v = getattr(args, name)
@@ -322,6 +329,13 @@ def cmd_serve(args) -> int:
 
     base = PRESETS["oil_filter"]
     params = _params_from_args(args, base)
+    # --deadline-ms: scalar -> the server-wide default; comma list (mixed
+    # load, "none" entries = undeadlined) -> cycled per selftest request.
+    deadline_ms = None
+    if args.deadline_ms is not None:
+        parts = [None if p.lower() in ("none", "") else float(p)
+                 for p in str(args.deadline_ms).split(",")]
+        deadline_ms = parts[0] if len(parts) == 1 else tuple(parts)
     warmup_sizes = ()
     if args.warmup:
         warmup_sizes = tuple(
@@ -333,18 +347,22 @@ def cmd_serve(args) -> int:
         batch_window_ms=args.batch_window_ms,
         max_batch=args.max_batch,
         workers=args.workers,
-        default_deadline_s=(None if args.deadline_ms is None
-                            else args.deadline_ms / 1e3),
+        default_deadline_s=(deadline_ms / 1e3
+                            if isinstance(deadline_ms, (int, float))
+                            else None),
         degrade=not args.no_degrade,
         request_retries=args.request_retries,
         warmup_sizes=warmup_sizes,
+        deadline_ordering=not args.no_deadline_ordering,
+        breaker_threshold=args.breaker_threshold,
+        cost_persist=not args.no_cost_persist,
     )
 
     if args.selftest is not None:
         from image_analogies_tpu.serve import loadgen
 
         summary = loadgen.selftest(cfg, args.selftest, seed=args.seed,
-                                   deadline_ms=args.deadline_ms)
+                                   deadline_ms=deadline_ms)
         print(loadgen.render(summary))
         print(json.dumps(summary, sort_keys=True), file=sys.stderr)
         return 0 if (summary["errors"] == 0
@@ -367,6 +385,39 @@ def cmd_serve(args) -> int:
         finally:
             httpd.shutdown()
     return 0
+
+
+def cmd_chaos(args) -> int:
+    """Seeded fault-injection drills (chaos/): run a workload under a
+    fault plan and assert full recovery — bit-identical output, no lost
+    or hung request, and injection counters reconciled against the
+    recovery counters they should have caused.  --selftest runs one
+    canonical drill per fault kind plus the schedule-determinism check;
+    --plan FILE replays a custom ChaosPlan JSON."""
+    from image_analogies_tpu.chaos import ChaosPlan
+    from image_analogies_tpu.chaos import runner as chaos_runner
+
+    if args.selftest:
+        kinds = args.kinds.split(",") if args.kinds else None
+        result = chaos_runner.selftest(seed=args.seed, kinds=kinds)
+    elif args.plan:
+        try:
+            plan = ChaosPlan.load(args.plan)
+        except (OSError, ValueError) as exc:
+            print(f"chaos: bad plan {args.plan}: {exc}", file=sys.stderr)
+            return 2
+        report = chaos_runner.run_drill(plan)
+        report.setdefault("kind", plan.name or "plan")
+        result = {"seed": plan.seed, "ok": report["ok"],
+                  "reports": [report]}
+    else:
+        print("chaos: pass --plan FILE or --selftest", file=sys.stderr)
+        return 2
+    print(chaos_runner.render(result))
+    if args.json:
+        print(json.dumps(result, sort_keys=True, default=str),
+              file=sys.stderr)
+    return 0 if result["ok"] else 1
 
 
 def cmd_trace(args) -> int:
@@ -505,11 +556,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="coalescing window once a batch leader is held")
     sv.add_argument("--max-batch", type=int, default=8)
     sv.add_argument("--workers", type=int, default=2)
-    sv.add_argument("--deadline-ms", type=float, default=None,
+    sv.add_argument("--deadline-ms", default=None,
                     help="default per-request deadline; expired before "
                          "dispatch -> cancelled, unmeetable -> degraded "
                          "(fewer levels / coarser patch), flagged in the "
-                         "response")
+                         "response.  With --selftest a comma list (e.g. "
+                         "300,none) cycles per request — a mixed-deadline "
+                         "load exercising the queue's EDF ordering")
     sv.add_argument("--no-degrade", action="store_true",
                     help="never degrade: unmeetable deadlines run full "
                          "fidelity anyway (only already-expired requests "
@@ -520,9 +573,46 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--warmup", default=None, metavar="SIZES",
                     help="comma-separated HxW list (e.g. 64x64,128x128) to "
                          "AOT-precompile before accepting traffic")
+    sv.add_argument("--no-deadline-ordering", action="store_true",
+                    help="pop batch leaders FIFO instead of earliest-"
+                         "deadline-first (EDF with an aging bound is the "
+                         "default; it cuts timeout rate under mixed-"
+                         "deadline load)")
+    sv.add_argument("--breaker-threshold", type=int, default=5,
+                    help="consecutive dispatch failures that trip the "
+                         "worker circuit breaker (fail-fast "
+                         "Rejected(circuit_open) until a half-open probe "
+                         "succeeds); 0 disables")
+    sv.add_argument("--no-cost-persist", action="store_true",
+                    help="do not persist the measured degrade cost rate "
+                         "to the tune store at shutdown (persistence "
+                         "seeds the next server's admission estimates)")
     sv.add_argument("--seed", type=int, default=0)
     _add_engine_flags(sv)
     sv.set_defaults(fn=cmd_serve)
+
+    ch = sub.add_parser("chaos",
+                        help="seeded fault-injection drills: run a "
+                             "workload under a fault plan and assert "
+                             "bit-identical recovery, no lost requests, "
+                             "and injection/recovery counter "
+                             "reconciliation")
+    ch.add_argument("--plan", default=None, metavar="FILE",
+                    help="ChaosPlan JSON (seed + per-site fault rules) "
+                         "to replay against the matching drill workload")
+    ch.add_argument("--selftest", action="store_true",
+                    help="one canonical drill per fault kind "
+                         "(transient, oom, latency, corrupt, crash) plus "
+                         "the same-seed schedule-determinism check")
+    ch.add_argument("--kinds", default=None,
+                    help="comma-separated fault-kind subset for "
+                         "--selftest (default: all)")
+    ch.add_argument("--seed", type=int, default=0,
+                    help="plan seed — same seed, same fault schedule")
+    ch.add_argument("--json", action="store_true",
+                    help="also print the full machine-readable report "
+                         "to stderr")
+    ch.set_defaults(fn=cmd_chaos)
 
     wu = sub.add_parser("warmup",
                         help="AOT-compile jit signatures for a target "

@@ -166,6 +166,12 @@ class AnalogyParams:
     # device/runtime faults (level granularity — combine with checkpoint_dir
     # so a process restart after exhausted retries loses at most one level).
     level_retries: int = 0
+    # Watchdog around each level's device dispatch: > 0 runs the dispatch
+    # on a helper thread and raises a TRANSIENT WatchdogTimeout when it
+    # exceeds this many seconds (a wedged op becomes a retry, not a hung
+    # process).  0 (default) dispatches inline — no thread, no overhead.
+    # Pair with level_retries so the timeout actually recovers.
+    dispatch_timeout_s: float = 0.0
     # §5.5 observability vs pipelining: with True (default) the driver
     # synchronizes after each level so per-level `ms` / `pixels_per_s`
     # stats measure real device time.  False lets all levels' device work

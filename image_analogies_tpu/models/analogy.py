@@ -15,6 +15,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from image_analogies_tpu import chaos
 from image_analogies_tpu.backends import get_backend
 from image_analogies_tpu.backends.base import LevelJob
 from image_analogies_tpu.config import AnalogyParams
@@ -238,12 +239,22 @@ def _create_image_analogy(a, ap, b, params, backend, temporal_prev,
                 t0 = time.perf_counter()
 
                 def _level():
+                    chaos.site("level.dispatch", level=level)
                     db = backend.build_features(job)
                     return backend.synthesize_level(db, job)
 
+                def _dispatch():
+                    # watchdog wraps the whole dispatch INSIDE the retry
+                    # body: a wedged op raises WatchdogTimeout (transient)
+                    # and the retry wrapper re-runs the level instead of
+                    # the process hanging.  timeout 0 = inline, no thread.
+                    return failure.run_with_watchdog(
+                        _level, params.dispatch_timeout_s,
+                        context={"level": level}, log_path=params.log_path)
+
                 # §5.3: transient device faults retry at level granularity
                 bp, s, st = failure.run_with_retry(
-                    _level, retries=params.level_retries,
+                    _dispatch, retries=params.level_retries,
                     context={"level": level}, log_path=params.log_path)
                 st["total_ms"] = (time.perf_counter() - t0) * 1e3
                 # bp/s may be DEVICE arrays (TPU backend): levels chain

@@ -32,9 +32,10 @@ HOST_TID = 1
 DEVICE_TID = 2
 COMPILE_TID = 3
 SERVE_TID = 4
+CHAOS_TID = 5
 
 _TID_NAMES = {HOST_TID: "host", DEVICE_TID: "device", COMPILE_TID: "compile",
-              SERVE_TID: "serve"}
+              SERVE_TID: "serve", CHAOS_TID: "chaos"}
 
 # bookkeeping fields that don't belong in an event's args payload
 _DROP_ARGS = ("ts",)
@@ -61,6 +62,16 @@ def _classify(rec: Dict[str, Any]) -> Tuple[str, int, str, Optional[float]]:
                 f"req {rec.get('request', '?')} "
                 f"{rec.get('status', '?')}",
                 float(rec.get("total_ms", 0.0)))
+    if ev in ("chaos_inject", "ckpt_quarantined", "watchdog_timeout",
+              "retry_exhausted", "serve_worker_crash", "breaker_open",
+              "breaker_half_open", "breaker_closed"):
+        # fault-plane instants on their own track: injections line up
+        # visually against the retries/quarantines/crashes they caused
+        if ev == "chaos_inject":
+            name = f"inject {rec.get('kind', '?')} @{rec.get('site', '?')}"
+        else:
+            name = str(ev)
+        return "i", CHAOS_TID, name, None
     if ev is None and _is_level_stat(rec):
         dur = rec.get("ms", rec.get("enqueue_ms", 0.0))
         name = f"L{rec['level']}"

@@ -221,6 +221,41 @@ def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                                 for k, v in sorted(batch_hist.items())},
         }
 
+    # --- chaos section (chaos_inject records + chaos.* counters) ----------
+    # The reconciliation ledger: injections on the left, the recovery
+    # counters they caused on the right.  A drill (or an operator reading
+    # a run log) checks the two sides account for each other.
+    chaos_injects = [r for r in records if r.get("event") == "chaos_inject"]
+    chaos_info: Optional[Dict[str, Any]] = None
+    if chaos_injects or any(k.startswith("chaos.") for k in counters):
+        by_site: Dict[str, int] = {}
+        by_kind: Dict[str, int] = {}
+        for name, v in counters.items():
+            if name.startswith("chaos.site."):
+                by_site[name.split("chaos.site.", 1)[1]] = int(v)
+            elif name.startswith("chaos.injected."):
+                by_kind[name.split("chaos.injected.", 1)[1]] = int(v)
+        for cr in chaos_injects:  # records fill in when counters are off
+            by_site.setdefault(str(cr.get("site")), 0)
+            by_kind.setdefault(str(cr.get("kind")), 0)
+        chaos_info = {
+            "injected": int(counters.get("chaos.injected",
+                                         len(chaos_injects))),
+            "by_site": by_site,
+            "by_kind": by_kind,
+            "recovery": {
+                "level_retry": int(counters.get("level_retry", 0)),
+                "retry_exhausted": int(counters.get("retry.exhausted", 0)),
+                "watchdog_timeouts": int(counters.get("watchdog.timeouts",
+                                                      0)),
+                "ckpt_quarantined": int(counters.get("ckpt.quarantined", 0)),
+                "worker_crashes": int(counters.get("serve.worker_crashes",
+                                                   0)),
+                "requeued": int(counters.get("serve.requeued", 0)),
+                "breaker_trips": int(counters.get("serve.breaker.trips", 0)),
+            },
+        }
+
     # --- per-device HBM peaks (run_end gauges + streamed hbm records) -----
     gauges: Dict[str, float] = {}
     if run_end:
@@ -246,6 +281,7 @@ def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "compile": compile_info,
         "tune": tune_info,
         "serve": serve_info,
+        "chaos": chaos_info,
         "hbm": hbm or None,
         "spans": spans,
         "n_records": len(records),
@@ -306,9 +342,12 @@ def render(an: Dict[str, Any], run_id: Optional[str] = None) -> str:
              "compile.count", "compile.ms", "compile.cache_hits",
              "xla.flops", "xla.bytes", "tune.store_hits", "tune.fallbacks",
              "tune.env_overrides", "tune.packaged"}
-    # serve.* counters render in their own serving section below
+    # serve.*/chaos.* and the recovery counters render in their own
+    # serving/chaos sections below
     rest = {k: v for k, v in c.items()
-            if k not in shown and v and not k.startswith("serve.")}
+            if k not in shown and v
+            and not k.startswith(("serve.", "chaos.", "watchdog.",
+                                  "ckpt.", "retry."))}
     for k in sorted(rest):
         w(f"    {k:<13} {rest[k]:g}")
 
@@ -368,6 +407,26 @@ def render(an: Dict[str, Any], run_id: Optional[str] = None) -> str:
             hist = ", ".join(f"{k}x{v}" for k, v in
                              srv["batch_size_hist"].items())
             w(f"    batch sizes   {hist}  (size x count)")
+
+    cha = an.get("chaos")
+    if cha:
+        w("  chaos:")
+        kinds = ", ".join(f"{k}x{v}" for k, v in
+                          sorted(cha["by_kind"].items()))
+        sites = ", ".join(f"{k}x{v}" for k, v in
+                          sorted(cha["by_site"].items()))
+        w(f"    injected      {cha['injected']}  ({kinds or '-'})")
+        if sites:
+            w(f"    sites         {sites}")
+        rec = cha["recovery"]
+        w(f"    recovery      {rec['level_retry']} retries "
+          f"({rec['retry_exhausted']} exhausted), "
+          f"{rec['watchdog_timeouts']} watchdog timeouts, "
+          f"{rec['ckpt_quarantined']} ckpt quarantined")
+        if rec["worker_crashes"] or rec["requeued"] or rec["breaker_trips"]:
+            w(f"    containment   {rec['worker_crashes']} worker crashes, "
+              f"{rec['requeued']} requeued, "
+              f"{rec['breaker_trips']} breaker trips")
 
     hbm = an.get("hbm")
     if hbm:

@@ -41,6 +41,7 @@ from image_analogies_tpu.backends.tpu import (
     batched_scan_core,
     wavefront_scan_core,
 )
+from image_analogies_tpu import chaos
 from image_analogies_tpu.obs import device as obs_device
 from image_analogies_tpu.obs import metrics as obs_metrics
 from image_analogies_tpu.ops.pallas_match import bf16_split3
@@ -220,6 +221,7 @@ def multichip_level_step(
     with `backends.tpu.make_level_template` (the step reads DB rows and A'
     values only through the sharded inputs, so the template must carry
     placeholders, never full per-chip DB arrays)."""
+    chaos.site("mesh.step", frames=int(frame_static_q.shape[0]))
     t_total = frame_static_q.shape[0]
     data_shards = mesh.shape["data"]
     db_shards = mesh.shape["db"]

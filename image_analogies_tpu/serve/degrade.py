@@ -11,6 +11,14 @@ whole.
 The degradation ladder only ever *reduces* fidelity knobs the paper's
 pyramid makes safe to reduce (fewer levels, then the minimum 3x3 patch);
 a degraded response is a valid synthesis, just flagged.
+
+The EWMA's STARTING rate is no longer hardwired: :func:`load_prior`
+seeds it from the tune store (this device's last serve run persisted its
+learned rate there), falling back to the packaged per-device-class rate
+(tune/tables.py) and only then to the optimistic default — so a restarted
+server makes informed degrade decisions from its first request instead
+of re-learning the device from scratch.  Provenance is counted as
+``serve.cost_prior.{store,packaged,default}``.
 """
 
 from __future__ import annotations
@@ -20,6 +28,8 @@ from typing import Any, Dict, Optional, Tuple
 
 from image_analogies_tpu.config import AnalogyParams
 from image_analogies_tpu.serve.types import Request
+from image_analogies_tpu.tune import store as tune_store
+from image_analogies_tpu.tune import tables as tune_tables
 
 # Optimistic prior (s per pixel*level*patch^2); EWMA weight of new samples.
 _PRIOR_RATE = 1e-7
@@ -31,11 +41,19 @@ def work_units(pixels: int, levels: int, patch_size: int) -> float:
 
 
 class CostModel:
-    """Thread-safe EWMA of observed dispatch cost."""
+    """Thread-safe EWMA of observed dispatch cost.
 
-    def __init__(self, prior_rate: float = _PRIOR_RATE):
+    A ``seeded`` prior (loaded from the store/packaged tables) is treated
+    as a real past measurement: the first observed sample BLENDS into it
+    instead of replacing it — only the hardwired optimistic default is
+    discarded wholesale on first contact with reality.
+    """
+
+    def __init__(self, prior_rate: float = _PRIOR_RATE,
+                 seeded: bool = False):
         self._rate = prior_rate
-        self._samples = 0
+        self._seeded = seeded
+        self._samples = 1 if seeded else 0
         self._lock = threading.Lock()
 
     def observe(self, units: float, seconds: float) -> None:
@@ -52,6 +70,72 @@ class CostModel:
     def estimate(self, units: float) -> float:
         with self._lock:
             return self._rate * units
+
+    @property
+    def rate(self) -> float:
+        with self._lock:
+            return self._rate
+
+    @property
+    def samples(self) -> int:
+        with self._lock:
+            return self._samples
+
+    @property
+    def real_samples(self) -> int:
+        """Observed (non-seed) samples — what persistence gates on."""
+        with self._lock:
+            return self._samples - (1 if self._seeded else 0)
+
+
+def cost_key(params: AnalogyParams) -> str:
+    """Tune-store key for this (backend, device class) pair's serve cost
+    rate.  Device kind is read from jax lazily and best-effort — serve/
+    stays importable (and this resolvable) without a working backend."""
+    cls = "any"
+    if params.backend == "tpu":
+        try:
+            import jax
+
+            cls = tune_tables.device_class(
+                jax.devices()[0].device_kind) or "any"
+        except Exception:  # pragma: no cover - no backend available
+            cls = "any"
+    return f"serve_cost|{params.backend}|{cls}"
+
+
+def load_prior(params: AnalogyParams) -> Tuple[float, str]:
+    """Resolve the EWMA's starting rate: ``(rate, provenance)`` with
+    provenance one of ``store`` (a previous serve run on this device
+    persisted its learned rate), ``packaged`` (per-device-class rate
+    shipped with the package), ``default`` (the optimistic hardwired
+    prior)."""
+    key = cost_key(params)
+    entry = tune_store.load_entries().get(key)
+    if entry is not None:
+        rate = entry.get("cost_rate")
+        if isinstance(rate, (int, float)) and rate > 0:
+            return float(rate), "store"
+    cls = key.rsplit("|", 1)[1]
+    packaged = tune_tables.COST_RATES.get(f"{params.backend}|{cls}")
+    if packaged:
+        return packaged, "packaged"
+    return _PRIOR_RATE, "default"
+
+
+def persist_rate(model: CostModel, params: AnalogyParams) -> Optional[str]:
+    """Write the model's learned rate into the tune store (the next
+    server's ``store`` prior).  No-op without real observations — a prior
+    that never met traffic must not launder itself into a measurement."""
+    if model.real_samples < 1:
+        return None
+    key = cost_key(params)
+    tune_store.merge_entries({key: {
+        "cost_rate": model.rate,
+        "source": "serve",
+        "samples": model.samples,
+    }})
+    return key
 
 
 def _ladder(params: AnalogyParams):

@@ -51,13 +51,27 @@ def make_load(n: int, shapes: Sequence[Tuple[int, int]], seed: int
 
 
 def selftest(cfg: ServeConfig, n: int, *, seed: int = 0,
-             deadline_ms: Optional[float] = None,
+             deadline_ms: Optional[Any] = None,
              shapes: Sequence[Tuple[int, int]] = DEFAULT_SHAPES
              ) -> Dict[str, Any]:
-    """Run the synthetic load end-to-end; returns the summary dict."""
+    """Run the synthetic load end-to-end; returns the summary dict.
+
+    ``deadline_ms`` may be a scalar (every request gets it) or a sequence
+    cycled per request — a MIXED-deadline load (e.g. ``(300, None)``)
+    interleaves tight-deadline traffic with undeadlined bulk, which is
+    what the queue's EDF ordering exists for: the summary's timeout count
+    under such a load is the thing deadline ordering lowers."""
     from image_analogies_tpu.models.analogy import create_image_analogy
 
     load = make_load(n, shapes, seed)
+
+    def deadline_s(i: int) -> Optional[float]:
+        if deadline_ms is None:
+            return None
+        if isinstance(deadline_ms, (int, float)):
+            return deadline_ms / 1e3
+        v = deadline_ms[i % len(deadline_ms)]
+        return None if v is None else v / 1e3
 
     # Sequential baseline: one-at-a-time engine calls, fresh backend each
     # (exactly what N independent `ia run` invocations would pay).
@@ -80,8 +94,7 @@ def selftest(cfg: ServeConfig, n: int, *, seed: int = 0,
             try:
                 futures[item["index"]] = srv.submit(
                     item["a"], item["ap"], item["b"],
-                    deadline_s=None if deadline_ms is None
-                    else deadline_ms / 1e3)
+                    deadline_s=deadline_s(item["index"]))
             except Rejected:
                 rejected += 1
         for idx, fut in futures.items():

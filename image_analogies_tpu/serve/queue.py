@@ -6,6 +6,13 @@ problem, unbounded memory growth is ours).  ``pop_batch`` is the worker
 side: block for a leader, then coalesce same-key followers for at most
 the batch window.  Requests with different keys are left in place for
 other workers — the scan preserves arrival order per key.
+
+Leader selection is deadline-aware (EDF) when ``deadline_ordering`` is
+on: the earliest-deadline waiter leads, so tight deadlines dispatch
+ahead of slack FIFO traffic instead of timing out behind it.  Starvation
+is bounded, not assumed away: once the OLDEST waiter has queued longer
+than ``age_bound_s`` it leads regardless of deadlines, so undeadlined
+traffic always makes progress.
 """
 
 from __future__ import annotations
@@ -15,13 +22,17 @@ import threading
 import time
 from typing import List, Optional
 
+from image_analogies_tpu import chaos
 from image_analogies_tpu.obs import metrics as obs_metrics
 from image_analogies_tpu.serve.types import Rejected, Request
 
 
 class AdmissionQueue:
-    def __init__(self, depth: int):
+    def __init__(self, depth: int, deadline_ordering: bool = False,
+                 age_bound_s: float = 5.0):
         self._depth = depth
+        self._deadline_ordering = deadline_ordering
+        self._age_bound_s = age_bound_s
         self._items: collections.deque[Request] = collections.deque()
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -32,6 +43,10 @@ class AdmissionQueue:
             return len(self._items)
 
     def submit(self, req: Request) -> None:
+        # admission-layer fault injection (drills): a raising kind here
+        # surfaces synchronously to the submitting client, like any other
+        # admission refusal — never a half-enqueued request.
+        chaos.site("serve.admit", request=req.request_id)
         with self._lock:
             if self._closed:
                 obs_metrics.inc("serve.rejected")
@@ -47,11 +62,39 @@ class AdmissionQueue:
             # notify meant for a leader-waiting one and drop the wakeup.
             self._cond.notify_all()
 
+    def _take_leader(self) -> Request:
+        """Remove and return the leader (lock held, deque non-empty).
+
+        FIFO by default; with deadline ordering the earliest-deadline
+        waiter leads (ties + undeadlined keep arrival order), UNLESS the
+        oldest waiter has aged past the bound — then it leads no matter
+        what, so EDF reordering can delay it by at most the bound.
+        """
+        if not self._deadline_ordering or len(self._items) == 1:
+            return self._items.popleft()
+        now = time.monotonic()
+        oldest = min(range(len(self._items)),
+                     key=lambda i: self._items[i].t_submit)
+        if now - self._items[oldest].t_submit > self._age_bound_s:
+            obs_metrics.inc("serve.aging_promotions")
+            idx = oldest
+        else:
+            idx = min(range(len(self._items)),
+                      key=lambda i: (
+                          self._items[i].deadline
+                          if self._items[i].deadline is not None
+                          else float("inf"),
+                          self._items[i].t_submit))
+        self._items.rotate(-idx)
+        leader = self._items.popleft()
+        self._items.rotate(idx)
+        return leader
+
     def pop_batch(self, max_batch: int, window_s: float) -> Optional[List[Request]]:
         """Return a batch of same-key requests, or None when closed+empty.
 
-        The first (oldest) request is the leader and fixes the key; we then
-        wait up to ``window_s`` for same-key followers, waking early whenever
+        The leader (see :meth:`_take_leader`) fixes the key; we then wait
+        up to ``window_s`` for same-key followers, waking early whenever
         a new submit lands.  The leader is held outside the deque during the
         window, so a second worker calling pop_batch concurrently picks up
         the next *different*-key request rather than splitting the batch.
@@ -61,7 +104,7 @@ class AdmissionQueue:
                 if self._closed:
                     return None
                 self._cond.wait()
-            leader = self._items.popleft()
+            leader = self._take_leader()
             batch = [leader]
             end = time.monotonic() + max(0.0, window_s)
             while len(batch) < max_batch:
@@ -83,6 +126,18 @@ class AdmissionQueue:
                 req.t_dequeue = now
             obs_metrics.set_gauge("serve.queue_depth", len(self._items))
             return batch
+
+    def requeue(self, req: Request) -> None:
+        """Put an already-admitted request back at the FRONT of the queue
+        (crash containment).  Bypasses the depth bound on purpose — the
+        request holds an admission slot it never released; rejecting it
+        here would lose it.  Works even after close() so a crash during
+        drain still resolves every future."""
+        with self._lock:
+            self._items.appendleft(req)
+            obs_metrics.inc("serve.requeued")
+            obs_metrics.set_gauge("serve.queue_depth", len(self._items))
+            self._cond.notify_all()
 
     def close(self) -> None:
         """Stop accepting; wake all workers so they can drain and exit."""

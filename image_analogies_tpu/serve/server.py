@@ -25,8 +25,10 @@ from typing import Optional
 import numpy as np
 
 from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.obs import metrics as obs_metrics
 from image_analogies_tpu.obs import trace as obs_trace
 from image_analogies_tpu.serve import batcher
+from image_analogies_tpu.serve import degrade as serve_degrade
 from image_analogies_tpu.serve.degrade import CostModel
 from image_analogies_tpu.serve.queue import AdmissionQueue
 from image_analogies_tpu.serve.types import (
@@ -42,8 +44,15 @@ from image_analogies_tpu.tune import warmup as tune_warmup
 class Server:
     def __init__(self, cfg: ServeConfig):
         self.cfg = cfg
-        self._queue = AdmissionQueue(cfg.queue_depth)
-        self.cost_model = CostModel()
+        self._queue = AdmissionQueue(
+            cfg.queue_depth,
+            deadline_ordering=cfg.deadline_ordering,
+            age_bound_s=cfg.ordering_age_bound_s)
+        # Seed the degrade cost EWMA: store (this device's persisted
+        # rate) > packaged class table > optimistic default.
+        rate, self.cost_prior_source = serve_degrade.load_prior(cfg.params)
+        self.cost_model = CostModel(
+            rate, seeded=self.cost_prior_source != "default")
         self._pool = WorkerPool(cfg, self._queue, self.cost_model)
         self._exit = contextlib.ExitStack()
         self._accepting = False
@@ -70,7 +79,11 @@ class Server:
                 "max_batch": self.cfg.max_batch,
                 "workers": self.cfg.workers,
                 "warmup_sizes": [list(s) for s in self.cfg.warmup_sizes],
+                "deadline_ordering": self.cfg.deadline_ordering,
+                "breaker_threshold": self.cfg.breaker_threshold,
+                "cost_prior": self.cost_prior_source,
             }}))
+        obs_metrics.inc(f"serve.cost_prior.{self.cost_prior_source}")
         if self.cfg.warmup_sizes:
             with obs_trace.span("serve_warmup",
                                 sizes=len(self.cfg.warmup_sizes)):
@@ -89,6 +102,11 @@ class Server:
                 req.future.set_exception(Rejected("shutting_down"))
         self._queue.close()
         self._pool.join(self.cfg.drain_timeout_s)
+        if self.cfg.cost_persist:
+            try:
+                serve_degrade.persist_rate(self.cost_model, self.cfg.params)
+            except Exception:  # pragma: no cover - persistence best-effort
+                pass
         self._started = False
         self._exit.close()
 

@@ -22,7 +22,9 @@ class Rejected(RuntimeError):
     """Admission control refused the request (no hang, no unbounded queue).
 
     ``reason`` is machine-readable: ``"queue_full"`` when the bounded queue
-    is at depth, ``"shutting_down"`` once drain has begun.
+    is at depth, ``"shutting_down"`` once drain has begun,
+    ``"circuit_open"`` while the dispatch circuit breaker is tripped,
+    ``"worker_crash"`` when a crashed worker exhausted the requeue budget.
     """
 
     def __init__(self, reason: str):
@@ -57,6 +59,30 @@ class ServeConfig:
     request_retries: int = 1       # run_with_retry budget around dispatch
     warmup_sizes: Tuple[Tuple[int, int], ...] = ()  # (h, w) AOT precompile
     drain_timeout_s: float = 60.0
+    # Deadline-aware batch pop: the leader is the earliest-deadline
+    # request instead of the oldest, so tight-deadline traffic dispatches
+    # first.  Undeadlined (or slack) requests are protected by the aging
+    # bound: once the oldest waiter's queue age exceeds
+    # ``ordering_age_bound_s`` it is promoted to leader regardless of
+    # deadlines — EDF can reorder, never starve.
+    deadline_ordering: bool = True
+    ordering_age_bound_s: float = 5.0
+    # Dispatch circuit breaker (serve/breaker.py): this many CONSECUTIVE
+    # batch-dispatch failures trip it open (0 disables); while open,
+    # requests fail fast with Rejected("circuit_open") instead of burning
+    # workers, and one probe per cooldown tests recovery.
+    breaker_threshold: int = 5
+    breaker_cooldown_s: float = 1.0
+    # Persist the learned cost-model rate into the tune store on shutdown
+    # so the NEXT server seeds its degrade estimates from it
+    # (provenance "store").  Off by default: tests and embedders should
+    # not write store files unless asked; `ia serve` enables it.
+    cost_persist: bool = False
+    # A crashed worker thread (an escape below the per-request handler)
+    # requeues its batch's unresolved requests up to this many times each
+    # before failing them with Rejected("worker_crash") — no request is
+    # ever silently lost, and a poison request can't requeue forever.
+    crash_requeues: int = 1
 
     def __post_init__(self):
         if self.queue_depth < 1:
@@ -65,6 +91,10 @@ class ServeConfig:
             raise ValueError("max_batch must be >= 1")
         if self.workers < 1:
             raise ValueError("workers must be >= 1")
+        if self.breaker_threshold < 0 or self.crash_requeues < 0:
+            raise ValueError("breaker_threshold/crash_requeues must be >= 0")
+        if self.ordering_age_bound_s < 0:
+            raise ValueError("ordering_age_bound_s must be >= 0")
 
 
 @dataclasses.dataclass
@@ -82,6 +112,7 @@ class Request:
     deadline: Optional[float] = None
     t_submit: float = dataclasses.field(default_factory=time.monotonic)
     t_dequeue: Optional[float] = None
+    requeues: int = 0  # crash-containment requeue count (bounded)
 
     def remaining(self, now: Optional[float] = None) -> Optional[float]:
         if self.deadline is None:

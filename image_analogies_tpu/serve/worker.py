@@ -10,6 +10,17 @@ sharing second.
 Every engine call goes through ``utils.failure.run_with_retry`` so an
 injected (or real) transient device failure retries inside the server
 and the client never observes it.
+
+Two containment layers sit around that:
+
+- a shared :class:`serve.breaker.CircuitBreaker` — consecutive dispatch
+  failures trip it and further requests fail fast with
+  ``Rejected("circuit_open")`` instead of burning workers;
+- crash containment in the worker loop — an escape below the
+  per-request handler (a genuine worker crash) is caught, the batch's
+  unresolved requests are requeued (bounded per request) or failed with
+  ``Rejected("worker_crash")``, and the thread SURVIVES.  No request is
+  ever lost to a crashed thread, and the pool never shrinks.
 """
 
 from __future__ import annotations
@@ -18,12 +29,15 @@ import threading
 import time
 from typing import List, Optional
 
+from image_analogies_tpu import chaos
 from image_analogies_tpu.obs import metrics as obs_metrics
 from image_analogies_tpu.obs import trace as obs_trace
 from image_analogies_tpu.serve import degrade as serve_degrade
+from image_analogies_tpu.serve.breaker import CircuitBreaker
 from image_analogies_tpu.serve.queue import AdmissionQueue
 from image_analogies_tpu.serve.types import (
     DeadlineExceeded,
+    Rejected,
     Request,
     Response,
     ServeConfig,
@@ -37,6 +51,8 @@ class WorkerPool:
         self._cfg = cfg
         self._queue = queue
         self._cost = cost_model or serve_degrade.CostModel()
+        self.breaker = CircuitBreaker(cfg.breaker_threshold,
+                                      cfg.breaker_cooldown_s)
         self._threads: List[threading.Thread] = []
         self._inflight = 0
         self._inflight_lock = threading.Lock()
@@ -59,7 +75,29 @@ class WorkerPool:
                                           self._cfg.batch_window_ms / 1e3)
             if batch is None:
                 return
-            self._run_batch(batch)
+            try:
+                self._run_batch(batch)
+            except BaseException as exc:  # noqa: BLE001 - crash containment
+                self._contain_crash(batch, exc)
+
+    def _contain_crash(self, batch: List[Request], exc: BaseException) -> None:
+        """An escape below the per-request handler killed this batch run.
+        Resolve every unresolved member — requeue (bounded) or fail — and
+        keep the thread alive."""
+        obs_metrics.inc("serve.worker_crashes")
+        obs_trace.emit_record({"event": "serve_worker_crash",
+                               "error": type(exc).__name__,
+                               "detail": str(exc)[:200],
+                               "batch_size": len(batch)})
+        for req in batch:
+            if req.future.done():
+                continue
+            if req.requeues < self._cfg.crash_requeues:
+                req.requeues += 1
+                self._queue.requeue(req)
+            else:
+                obs_metrics.inc("serve.rejected")
+                req.future.set_exception(Rejected("worker_crash"))
 
     def _track_inflight(self, delta: int) -> None:
         with self._inflight_lock:
@@ -67,6 +105,10 @@ class WorkerPool:
             obs_metrics.set_gauge("serve.inflight", self._inflight)
 
     def _run_batch(self, batch: List[Request]) -> None:
+        # batch-level fault injection (drills): raising kinds here model a
+        # worker dying below the per-request handler — they escape into
+        # _loop's crash containment, which must resolve every member.
+        chaos.site("serve.dispatch", batch=len(batch))
         self._track_inflight(len(batch))
         obs_metrics.observe("serve.batch_size", len(batch))
         try:
@@ -102,8 +144,14 @@ class WorkerPool:
         from image_analogies_tpu.backends import get_backend
         from image_analogies_tpu.models.analogy import create_image_analogy
 
-        if not req.future.set_running_or_notify_cancel():
-            return backend  # client cancelled while queued
+        try:
+            if not req.future.set_running_or_notify_cancel():
+                return backend  # client cancelled while queued
+        except RuntimeError:
+            # already RUNNING: this request was requeued by crash
+            # containment after its first dispatch started — proceed.
+            if req.future.done():
+                return backend
 
         action, params, degraded = serve_degrade.plan(
             req, self._cost, allow_degrade=self._cfg.degrade)
@@ -112,6 +160,13 @@ class WorkerPool:
             self._emit_request_record(req, "timeout", batch_size=batch_size)
             req.future.set_exception(
                 DeadlineExceeded(req.request_id, -(req.remaining() or 0.0)))
+            return backend
+
+        if not self.breaker.allow():
+            # circuit open: fail fast, no dispatch, no retry burn
+            obs_metrics.inc("serve.rejected")
+            self._emit_request_record(req, "rejected", batch_size=batch_size)
+            req.future.set_exception(Rejected("circuit_open"))
             return backend
 
         if degraded is not None:
@@ -137,12 +192,14 @@ class WorkerPool:
                     backoff_s=0.0,
                 )
         except Exception as exc:  # noqa: BLE001 - forwarded to the client
+            self.breaker.record_failure()
             obs_metrics.inc("serve.errors")
             self._emit_request_record(req, "error", batch_size=batch_size,
                                       dispatch_ms=(time.monotonic() - t0) * 1e3)
             req.future.set_exception(exc)
             return backend
 
+        self.breaker.record_success()
         dispatch_s = time.monotonic() - t0
         pixels = int(req.b.shape[0]) * int(req.b.shape[1])
         self._cost.observe(

@@ -46,6 +46,21 @@ TABLES: Dict[str, Dict[str, Dict[str, int]]] = {
 }
 
 
+# Packaged serve cost-rate priors (seconds per pixel*level*patch^2 work
+# unit — serve/degrade.py's EWMA), keyed "{backend}|{class}".  Same idea
+# as the geometry tables: a fresh server on known hardware should start
+# its deadline estimates from a class-appropriate rate, not the generic
+# optimistic prior.  A store entry (this device's own measured rate)
+# always wins over these.  No cpu row on purpose: host speed varies too
+# much across machines for a packaged number to beat the default-then-
+# learn path.
+COST_RATES: Dict[str, float] = {
+    "tpu|v4": 4.0e-9,
+    "tpu|v5e": 8.0e-9,
+    "tpu|v5p": 2.5e-9,
+}
+
+
 def device_class(kind: str) -> Optional[str]:
     """Map a jax ``device_kind`` string to a table class; None when the
     device has no packaged table (CPU, GPU, unknown TPUs)."""

@@ -10,9 +10,15 @@ from __future__ import annotations
 
 import hashlib
 import os
+import zipfile
 from typing import Optional, Tuple
 
 import numpy as np
+
+from image_analogies_tpu import chaos
+from image_analogies_tpu.chaos import faults as chaos_faults
+from image_analogies_tpu.obs import metrics as obs_metrics
+from image_analogies_tpu.obs import trace as obs_trace
 
 
 def level_path(ckpt_dir: str, level: int) -> str:
@@ -33,7 +39,8 @@ def run_digest(params, a_shape, b_shape) -> str:
         # not parity-equivalent to exact_hi/wavefront.
         if k not in ("checkpoint_dir", "resume_from_level", "profile_dir",
                      "log_path", "db_shards", "data_shards", "level_retries",
-                     "save_levels_dir", "level_sync", "metrics")),
+                     "save_levels_dir", "level_sync", "metrics",
+                     "dispatch_timeout_s")),
         tuple(a_shape), tuple(b_shape)))
     return hashlib.sha256(payload.encode()).hexdigest()[:16]
 
@@ -48,26 +55,83 @@ def clip_digest(params, a_shape, b_shape, n_frames: int, phase: str) -> str:
         f"{base}:clip:{n_frames}:{phase}".encode()).hexdigest()[:16]
 
 
+def _payload_checksum(bp: np.ndarray, s: np.ndarray,
+                      digest: str = "") -> str:
+    """sha256 over the two payload planes (shape + dtype + bytes) AND the
+    stored run digest: the integrity seal stored INSIDE the npz, checked
+    on load.  The run digest answers "is this the same run config?"; the
+    checksum answers "did these exact bytes survive the round trip?" —
+    partial writes and bit rot fail the second even when the first still
+    matches.  The digest rides inside the seal so rot landing on the
+    digest field itself reads as damage, not as a stale checkpoint."""
+    h = hashlib.sha256()
+    for arr in (np.ascontiguousarray(bp), np.ascontiguousarray(s)):
+        h.update(repr((arr.shape, str(arr.dtype))).encode())
+        h.update(arr.tobytes())
+    h.update(digest.encode())
+    return h.hexdigest()[:32]
+
+
+def quarantine(path: str) -> str:
+    """Move a damaged checkpoint aside as ``<path>.corrupt`` (never
+    deleted: the bytes are evidence) and record the event.  Returns the
+    quarantine path."""
+    qpath = path + ".corrupt"
+    os.replace(path, qpath)
+    obs_metrics.inc("ckpt.quarantined")
+    obs_trace.emit_record({"event": "ckpt_quarantined", "path": path})
+    return qpath
+
+
 def save_level(ckpt_dir: str, level: int, bp: np.ndarray,
                s: np.ndarray, digest: str = "") -> str:
+    # raising kinds fire here (before any bytes move); the "corrupt"
+    # directive is captured now but applied AFTER the atomic commit —
+    # modeling a write that LOOKED successful yet left damaged bytes,
+    # the failure mode the load-side checksum exists for.
+    directive = chaos.site("ckpt.save", level=level)
     os.makedirs(ckpt_dir, exist_ok=True)
     path = level_path(ckpt_dir, level)
     tmp = path + ".tmp.npz"
-    np.savez(tmp, level=level, bp=bp, s=s, digest=digest)
+    np.savez(tmp, level=level, bp=bp, s=s, digest=digest,
+             checksum=_payload_checksum(bp, s, digest))
     os.replace(tmp, path)
+    if directive == "corrupt":
+        chaos_faults.corrupt_file(path, chaos.plan_seed() or 0)
     return path
 
 
 def load_level(ckpt_dir: str, level: int, digest: str = ""
                ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
-    """Returns (bp, s) or None when missing OR stale: a checkpoint whose
-    recorded digest disagrees with the current run's is skipped (the level
-    recomputes) instead of resuming with wrong planes."""
+    """Returns (bp, s) or None when missing, stale, or damaged.
+
+    Stale (digest mismatch) is a clean skip: the file is intact, it just
+    belongs to a different run config — it stays on disk.  Damaged
+    (unreadable container, missing arrays, checksum mismatch) is
+    quarantined: renamed to ``.corrupt`` so the next run doesn't trip on
+    it again, counted in ``ckpt.quarantined``, and the level recomputes.
+    """
+    chaos.site("ckpt.load", level=level)
     path = level_path(ckpt_dir, level)
     if not os.path.exists(path):
         return None
-    with np.load(path) as z:
-        stored = str(z["digest"]) if "digest" in z.files else ""
-        if digest and stored != digest:
-            return None
-        return z["bp"].astype(np.float32), z["s"].astype(np.int32)
+    try:
+        with np.load(path) as z:
+            stored = str(z["digest"]) if "digest" in z.files else ""
+            bp = z["bp"].astype(np.float32)
+            s = z["s"].astype(np.int32)
+            # integrity BEFORE staleness: a failed seal is damage no
+            # matter which field the rot landed on (a genuinely stale
+            # file still carries a self-consistent seal)
+            if "checksum" in z.files:
+                want = str(z["checksum"])
+                got = _payload_checksum(z["bp"], z["s"], stored)
+                if want != got:
+                    raise ValueError(
+                        f"checkpoint payload checksum mismatch at {path}")
+            if digest and stored != digest:
+                return None
+    except (zipfile.BadZipFile, OSError, ValueError, KeyError, EOFError):
+        quarantine(path)
+        return None
+    return bp, s

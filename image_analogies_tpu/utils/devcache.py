@@ -25,6 +25,7 @@ from typing import Optional
 
 import numpy as np
 
+from image_analogies_tpu import chaos
 from image_analogies_tpu.obs import metrics as obs_metrics
 
 _DEFAULT_MAX_BYTES = 1 << 30  # 1 GiB of cached device inputs
@@ -97,6 +98,7 @@ def device_put_cached(x, dtype=None):
         _cache.pop(key, None)
         obs_metrics.inc("devcache.dead_evictions")
         obs_metrics.set_gauge("devcache.bytes", _bytes)
+    chaos.site("devcache.upload", nbytes=arr.nbytes)
     dev = jax.device_put(jnp.asarray(arr))
     _cache[key] = dev
     _bytes += arr.nbytes

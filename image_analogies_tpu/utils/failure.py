@@ -22,6 +22,8 @@ recovery paths are exercised deterministically in CI without real faults.
 
 from __future__ import annotations
 
+import random
+import threading
 import time
 from typing import Any, Callable, Optional
 
@@ -34,6 +36,12 @@ _INJECT = {"n": 0}
 
 class InjectedFailure(RuntimeError):
     """Synthetic transient fault raised by `inject_failures`."""
+
+
+class WatchdogTimeout(RuntimeError):
+    """A watchdogged dispatch exceeded its deadline: the op is presumed
+    wedged and the timeout surfaces as a TRANSIENT fault (retryable) —
+    a hang becomes a retry instead of a stuck process."""
 
 
 def inject_failures(n: int) -> None:
@@ -63,7 +71,7 @@ def _is_transient(exc: BaseException) -> bool:
     seen = set()
     while exc is not None and id(exc) not in seen:
         seen.add(id(exc))
-        if isinstance(exc, InjectedFailure):
+        if isinstance(exc, (InjectedFailure, WatchdogTimeout)):
             return True
         # jax.errors.JaxRuntimeError wraps XLA/PJRT runtime failures; keep
         # the check name-based so this works across jax versions without
@@ -76,6 +84,30 @@ def _is_transient(exc: BaseException) -> bool:
     return False
 
 
+def backoff_delay(attempt: int, *, backoff_s: float = 0.5,
+                  backoff_cap_s: float = 8.0,
+                  jitter_seed: Optional[int] = None) -> float:
+    """Delay before retry ``attempt`` (1-based): capped exponential with
+    deterministic seeded jitter.
+
+    Base doubles per attempt (``backoff_s * 2**(attempt-1)``) and is
+    capped at ``backoff_cap_s`` — the old linear, unjittered
+    ``backoff_s * attempt`` both hammered a struggling device early and
+    synchronized every retrying caller into lockstep thundering herds.
+    Jitter multiplies by [0.5, 1.0) drawn from ``Random((jitter_seed,
+    attempt))``: the same (seed, attempt) always sleeps the same time,
+    so drills and tests stay reproducible while distinct seeds (serve
+    workers pass their request id) de-correlate.
+    """
+    base = min(backoff_s * (2.0 ** max(attempt - 1, 0)), backoff_cap_s)
+    if base <= 0:
+        return 0.0
+    # arithmetic combine (not a tuple): tuple seeding goes through
+    # hash() — deprecated, and unstable across processes for str parts
+    frac = random.Random((jitter_seed or 0) * 1000003 + attempt).random()
+    return base * (0.5 + 0.5 * frac)
+
+
 def run_with_retry(
     fn: Callable[[], Any],
     *,
@@ -83,12 +115,17 @@ def run_with_retry(
     context: Optional[dict] = None,
     log_path: Optional[str] = None,
     backoff_s: float = 0.5,
+    backoff_cap_s: float = 8.0,
+    jitter_seed: Optional[int] = None,
 ) -> Any:
     """Run `fn()`, retrying up to `retries` times on transient faults.
 
     Each detected fault emits a `level_retry` JSONL record (utils/logging)
-    with the error type and attempt number.  Non-transient exceptions and
-    faults beyond the retry budget propagate unchanged.
+    with the error type and attempt number; retry delays follow
+    :func:`backoff_delay` (capped exponential, seeded jitter).
+    Non-transient exceptions propagate unchanged; a fault beyond the
+    budget bumps ``retry.exhausted`` and propagates the ORIGINAL
+    exception (callers keep their type checks).
     """
     attempt = 0
     while True:
@@ -98,7 +135,19 @@ def run_with_retry(
                 raise InjectedFailure("synthetic fault (inject_failures)")
             return fn()
         except BaseException as exc:  # noqa: BLE001 - filtered below
-            if not _is_transient(exc) or attempt >= retries:
+            if not _is_transient(exc):
+                raise
+            if attempt >= retries:
+                if retries > 0:
+                    # only a real exhausted BUDGET counts: retries=0
+                    # callers never opted into recovery at all
+                    obs_metrics.inc("retry.exhausted")
+                    ialog.emit({
+                        "event": "retry_exhausted",
+                        "attempts": attempt + 1,
+                        "error": type(exc).__name__,
+                        **(context or {}),
+                    }, log_path)
                 raise
             attempt += 1
             obs_metrics.inc("level_retry")
@@ -120,4 +169,59 @@ def run_with_retry(
                 # same poisoned device state; retries must re-upload
             except Exception:  # pragma: no cover - cache clear is best-effort
                 pass
-            time.sleep(backoff_s * attempt)
+            time.sleep(backoff_delay(attempt, backoff_s=backoff_s,
+                                     backoff_cap_s=backoff_cap_s,
+                                     jitter_seed=jitter_seed))
+
+
+def run_with_watchdog(
+    fn: Callable[[], Any],
+    timeout_s: float,
+    *,
+    context: Optional[dict] = None,
+    log_path: Optional[str] = None,
+) -> Any:
+    """Run ``fn()`` with a wall-clock watchdog.
+
+    The body runs on a daemon thread; if it has not finished within
+    ``timeout_s`` the caller raises :class:`WatchdogTimeout` — which
+    `_is_transient` treats as retryable, so a wedged device op surfaces
+    inside `run_with_retry` as one more transient fault instead of
+    hanging the process.  Python threads cannot be killed: the wedged
+    body is ABANDONED (its eventual result or error is swallowed and
+    counted as ``watchdog.abandoned``), which is safe here because the
+    retry path already re-materializes inputs (cache clears) before
+    re-running.  With ``timeout_s <= 0`` the body runs inline —
+    zero-thread, zero-cost passthrough.
+    """
+    if timeout_s <= 0:
+        return fn()
+    box: dict = {}
+    done = threading.Event()
+
+    def _body():
+        try:
+            box["result"] = fn()
+        except BaseException as exc:  # noqa: BLE001 - forwarded or swallowed
+            box["error"] = exc
+        finally:
+            if done.is_set():  # already timed out: late completion
+                obs_metrics.inc("watchdog.abandoned")
+            done.set()
+
+    t = threading.Thread(target=_body, name="ia-watchdog-body", daemon=True)
+    t.start()
+    if not done.wait(timeout_s):
+        done.set()  # mark abandoned BEFORE the body finishes
+        obs_metrics.inc("watchdog.timeouts")
+        ialog.emit({
+            "event": "watchdog_timeout",
+            "timeout_s": timeout_s,
+            **(context or {}),
+        }, log_path)
+        raise WatchdogTimeout(
+            f"dispatch exceeded watchdog timeout {timeout_s:g}s "
+            "(op presumed wedged; surfacing as transient)")
+    if "error" in box:
+        raise box["error"]
+    return box["result"]

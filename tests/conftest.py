@@ -30,6 +30,19 @@ import numpy as np
 import pytest
 
 
+@pytest.fixture(autouse=True)
+def _disarm_fault_planes():
+    """Fault injection is process-global (utils.failure's counter injector
+    AND the chaos plan): reset both after every test so a failing test can
+    never leak armed synthetic faults into unrelated tests."""
+    yield
+    from image_analogies_tpu import chaos
+    from image_analogies_tpu.utils import failure
+
+    failure.inject_failures(0)
+    chaos.disarm()
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(1234)

@@ -64,6 +64,51 @@ def test_stale_checkpoint_not_resumed(tmp_path, rng):
     assert ckpt.load_level(ckdir, 7, digest="abc") is None
 
 
+def test_corrupt_checkpoint_quarantined_and_recomputed(tmp_path, rng):
+    """Damaged checkpoint bytes (payload OR metadata fields) must fail
+    the integrity seal, be quarantined as `.corrupt`, and make the
+    loader return None so the level recomputes — never resume garbage,
+    never trip on the same file twice."""
+    from image_analogies_tpu.chaos import faults as chaos_faults
+
+    bp = rng.uniform(0, 1, (8, 9)).astype(np.float32)
+    s = rng.integers(0, 72, (8, 9)).astype(np.int32)
+    path = ckpt.save_level(str(tmp_path), 1, bp, s, digest="d1gest")
+    assert chaos_faults.corrupt_file(path, seed=0) > 0
+    assert ckpt.load_level(str(tmp_path), 1, digest="d1gest") is None
+    assert not os.path.exists(path)
+    assert os.path.exists(path + ".corrupt")  # evidence kept, not deleted
+    # the quarantined path no longer collides: a fresh save + load works
+    ckpt.save_level(str(tmp_path), 1, bp, s, digest="d1gest")
+    out = ckpt.load_level(str(tmp_path), 1, digest="d1gest")
+    np.testing.assert_array_equal(out[0], bp)
+
+
+def test_truncated_checkpoint_quarantined(tmp_path, rng):
+    """A partial write (file cut mid-stream) is damage, not staleness."""
+    bp = rng.uniform(0, 1, (8, 9)).astype(np.float32)
+    s = rng.integers(0, 72, (8, 9)).astype(np.int32)
+    path = ckpt.save_level(str(tmp_path), 3, bp, s)
+    blob = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(blob[:len(blob) // 2])
+    assert ckpt.load_level(str(tmp_path), 3) is None
+    assert os.path.exists(path + ".corrupt")
+
+
+def test_stale_checkpoint_skipped_not_quarantined(tmp_path, rng):
+    """Digest mismatch on an INTACT file stays a clean skip: the file
+    belongs to another run config and must survive untouched."""
+    bp = rng.uniform(0, 1, (8, 9)).astype(np.float32)
+    s = rng.integers(0, 72, (8, 9)).astype(np.int32)
+    path = ckpt.save_level(str(tmp_path), 4, bp, s, digest="old-config")
+    assert ckpt.load_level(str(tmp_path), 4, digest="new-config") is None
+    assert os.path.exists(path)  # still there...
+    assert not os.path.exists(path + ".corrupt")  # ...and not quarantined
+    out = ckpt.load_level(str(tmp_path), 4, digest="old-config")
+    np.testing.assert_array_equal(out[0], bp)
+
+
 def test_structured_log_records(tmp_path, rng):
     a, ap, b = make_pair(12, 12, seed=5)
     log = str(tmp_path / "log.jsonl")
@@ -221,6 +266,103 @@ def test_retry_wrapper_inert_when_injection_disabled(monkeypatch):
 
     assert failure.run_with_retry(fn, retries=3) == 42
     assert calls["n"] == 1
+
+
+def test_backoff_delay_deterministic_jittered_capped():
+    """Retry pacing: capped exponential with seeded jitter — same
+    (seed, attempt) always sleeps the same, delays stay in
+    [base/2, base), and the cap bounds the worst case."""
+    from image_analogies_tpu.utils import failure
+
+    kw = dict(backoff_s=0.5, backoff_cap_s=8.0)
+    d1 = [failure.backoff_delay(a, jitter_seed=7, **kw)
+          for a in range(1, 10)]
+    d2 = [failure.backoff_delay(a, jitter_seed=7, **kw)
+          for a in range(1, 10)]
+    d3 = [failure.backoff_delay(a, jitter_seed=8, **kw)
+          for a in range(1, 10)]
+    assert d1 == d2          # deterministic per seed
+    assert d1 != d3          # seeds de-correlate (thundering herd)
+    assert 0.25 <= d1[0] < 0.5       # attempt 1: base 0.5, jitter [.5, 1)
+    for a, d in enumerate(d1, start=1):
+        base = min(0.5 * 2 ** (a - 1), 8.0)
+        assert base / 2 <= d < base or d == pytest.approx(base)
+    assert d1[-1] <= 8.0             # capped, not 0.5 * 2**8 = 128
+    assert failure.backoff_delay(3, backoff_s=0.0) == 0.0
+
+
+def test_retry_exhausted_counter_and_record(tmp_path):
+    """Beyond-budget transients bump retry.exhausted (the reconciliation
+    ledger's 'gave up' column) and log a retry_exhausted record."""
+    from image_analogies_tpu.config import AnalogyParams
+    from image_analogies_tpu.obs import trace as obs_trace
+    from image_analogies_tpu.utils import failure
+
+    log = str(tmp_path / "run.jsonl")
+    params = AnalogyParams(backend="cpu", metrics=True, log_path=log)
+    failure.inject_failures(5)
+    with obs_trace.run_scope(params) as ctx:
+        with pytest.raises(failure.InjectedFailure):
+            failure.run_with_retry(lambda: "never", retries=1,
+                                   backoff_s=0.0, log_path=log)
+        counters = dict(ctx.registry.snapshot()["counters"])
+    assert counters["retry.exhausted"] == 1
+    assert counters["level_retry"] == 1  # the one absorbed retry
+    recs = [json.loads(l) for l in open(log) if l.strip()]
+    assert any(r.get("event") == "retry_exhausted" for r in recs)
+
+
+def test_watchdog_times_out_wedged_dispatch():
+    """A wedged dispatch surfaces as WatchdogTimeout well before the
+    wedge resolves — and the timeout classifies TRANSIENT, so the level
+    retry wrapper is its recovery path."""
+    import time
+
+    from image_analogies_tpu.utils import failure
+
+    t0 = time.monotonic()
+    with pytest.raises(failure.WatchdogTimeout):
+        failure.run_with_watchdog(lambda: time.sleep(1.0), 0.05)
+    assert time.monotonic() - t0 < 0.9  # surfaced early, not after the wedge
+    assert failure._is_transient(failure.WatchdogTimeout("wedged"))
+
+
+def test_watchdog_retry_recovers_wedge():
+    """watchdog + retry composed (the engine's dispatch wrapping): first
+    attempt wedges past the deadline, second completes; callers see the
+    clean result."""
+    import time
+
+    from image_analogies_tpu.utils import failure
+
+    calls = {"n": 0}
+
+    def body():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            time.sleep(0.6)
+        return "recovered"
+
+    def dispatch():
+        return failure.run_with_watchdog(body, 0.05)
+
+    assert failure.run_with_retry(dispatch, retries=2,
+                                  backoff_s=0.0) == "recovered"
+    assert calls["n"] == 2
+
+
+def test_watchdog_zero_timeout_runs_inline():
+    from image_analogies_tpu.utils import failure
+
+    ident = []
+    import threading
+
+    def body():
+        ident.append(threading.current_thread())
+        return 7
+
+    assert failure.run_with_watchdog(body, 0.0) == 7
+    assert ident == [threading.main_thread()]  # no helper thread spawned
 
 
 def test_ssim_properties(rng):

@@ -1,0 +1,209 @@
+"""chaos/ — seeded fault-injection plane + resilience drills.
+
+Tier-1 invariants locked here:
+
+- one canonical drill per fault kind passes end-to-end: bit-identical
+  recovery, no lost or hung request, and the injection counters
+  reconcile against the recovery counters they caused;
+- same seed ⇒ same fault schedule (determinism is the whole point of a
+  *seeded* fault plane: a failing drill must replay);
+- disarmed sites are provably inert — no metric, no record, no
+  directive, just `None` (the obs/ off-path contract);
+- chaos/ never imports jax at module scope and never calls jit/pjit
+  (grep lock) — plans must arm on any host, device or not;
+- plans round-trip through JSON, and the `ia chaos` CLI wires the
+  whole thing together.
+"""
+
+import json
+import os
+import re
+
+import pytest
+
+from image_analogies_tpu import chaos
+from image_analogies_tpu.chaos import inject, runner
+from image_analogies_tpu.chaos.plan import ChaosPlan, SiteRule
+
+# ------------------------------------------------- drills (per kind)
+
+
+@pytest.mark.parametrize("kind", chaos.FAULT_KINDS)
+def test_drill_recovers_per_fault_kind(kind):
+    """The seeded smoke `ia chaos --selftest` runs in CI: one canonical
+    plan per fault kind, each asserting full recovery."""
+    report = runner.run_drill(runner.plan_for_kind(kind, seed=0))
+    assert report["ok"], report["problems"]
+    assert report["injected"] >= 1
+    assert report["identical"] is True
+
+
+def test_same_seed_same_schedule():
+    det = runner.check_determinism(seed=3)
+    assert det["ok"], det["problems"]
+    assert det["injected"] > 0
+
+
+# ------------------------------------------------- the injection plane
+
+
+def test_disarmed_site_is_inert(monkeypatch):
+    """Disarmed = production: a site visit must not touch metrics, the
+    run log, locks' state, or return a directive."""
+    from image_analogies_tpu.obs import metrics as obs_metrics
+    from image_analogies_tpu.obs import trace as obs_trace
+
+    assert not chaos.armed()
+
+    def touched(*a, **k):
+        raise AssertionError("chaos site touched obs while disarmed")
+
+    monkeypatch.setattr(obs_metrics, "inc", touched)
+    monkeypatch.setattr(obs_trace, "emit_record", touched)
+    assert chaos.site("level.dispatch", level=0) is None
+    assert chaos.site("ckpt.save") is None
+    assert chaos.snapshot() == {}
+    assert chaos.injected_total() == 0
+    assert chaos.plan_seed() is None
+
+
+def test_max_faults_caps_probabilistic_rule():
+    plan = ChaosPlan(seed=1, sites=(
+        ("level.dispatch", SiteRule(kind="latency", p=1.0, latency_ms=0.0,
+                                    max_faults=2)),))
+    with inject.plan_scope(plan):
+        for _ in range(10):
+            inject.site("level.dispatch")
+        snap = inject.snapshot()
+    assert snap["level.dispatch"] == {"visits": 10, "injected": 2}
+
+
+def test_unplanned_site_passes_through():
+    plan = ChaosPlan(seed=1, sites=(
+        ("ckpt.save", SiteRule(kind="corrupt", schedule=(0,))),))
+    with inject.plan_scope(plan):
+        assert inject.site("level.dispatch") is None  # no rule -> no-op
+        assert inject.site("ckpt.save") == "corrupt"  # directive returned
+        assert inject.site("ckpt.save") is None       # schedule spent
+
+
+def test_plan_scope_disarms_even_on_error():
+    plan = runner.plan_for_kind("transient")
+    with pytest.raises(RuntimeError):
+        with inject.plan_scope(plan):
+            assert chaos.armed()
+            raise RuntimeError("drill body died")
+    assert not chaos.armed()
+    assert chaos.plan_seed() is None
+
+
+# ------------------------------------------------------ plan format
+
+
+def test_plan_json_roundtrip():
+    plan = ChaosPlan(seed=42, name="rt", sites=(
+        ("level.dispatch", SiteRule(kind="transient", p=0.5, max_faults=2)),
+        ("ckpt.save", SiteRule(kind="corrupt", schedule=(0, 3))),
+        ("serve.dispatch", SiteRule(kind="latency", latency_ms=10.0,
+                                    hang=True, schedule=(1,))),
+    ))
+    assert ChaosPlan.from_json(json.dumps(plan.to_dict())) == plan
+
+
+def test_plan_validation():
+    with pytest.raises(ValueError):
+        SiteRule(kind="meteor")
+    with pytest.raises(ValueError):
+        SiteRule(kind="transient", p=1.5)
+    with pytest.raises(ValueError):
+        ChaosPlan.from_dict({"sites": {"x": {"p": 0.5}}})  # no kind
+    with pytest.raises(ValueError):
+        ChaosPlan.from_dict([])  # not an object
+
+
+# ------------------------------------------------------- telemetry
+
+
+def test_chaos_telemetry_in_report_and_trace(tmp_path):
+    """An injection under an observed run surfaces in `ia report`'s
+    chaos section and on the trace's chaos track."""
+    from image_analogies_tpu.config import AnalogyParams
+    from image_analogies_tpu.obs import export as obs_export
+    from image_analogies_tpu.obs import report as obs_report
+    from image_analogies_tpu.obs import trace as obs_trace
+
+    log = str(tmp_path / "run.jsonl")
+    params = AnalogyParams(backend="cpu", metrics=True, log_path=log)
+    plan = ChaosPlan(seed=0, sites=(
+        ("level.dispatch", SiteRule(kind="latency", p=1.0,
+                                    latency_ms=0.0)),))
+    with obs_trace.run_scope(params):
+        with inject.plan_scope(plan):
+            inject.site("level.dispatch", level=0)
+
+    an = obs_report.analyze(obs_report.load_records(log))
+    assert an["chaos"] is not None
+    assert an["chaos"]["injected"] == 1
+    assert an["chaos"]["by_site"] == {"level.dispatch": 1}
+    assert an["chaos"]["by_kind"] == {"latency": 1}
+    assert "chaos:" in obs_report.report(log)
+
+    out = str(tmp_path / "trace.json")
+    obs_export.export_trace(log, out)
+    trace = json.load(open(out))
+    hits = [e for e in trace["traceEvents"]
+            if e.get("tid") == obs_export.CHAOS_TID and e["ph"] == "i"]
+    assert [e["name"] for e in hits] == ["inject latency @level.dispatch"]
+
+
+# ------------------------------------------------------------- CLI
+
+
+def test_cli_chaos_selftest_smoke(capsys):
+    from image_analogies_tpu.cli import main
+
+    rc = main(["chaos", "--selftest", "--kinds", "transient", "--seed", "1"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "PASS" in out and "determinism" in out
+
+
+def test_cli_chaos_plan_file(tmp_path, capsys):
+    from image_analogies_tpu.cli import main
+
+    path = str(tmp_path / "plan.json")
+    with open(path, "w") as f:
+        json.dump(runner.plan_for_kind("oom", seed=2).to_dict(), f)
+    rc = main(["chaos", "--plan", path])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "PASS" in out
+
+
+def test_cli_chaos_requires_plan_or_selftest(capsys):
+    from image_analogies_tpu.cli import main
+
+    assert main(["chaos"]) == 2
+    assert "pass --plan FILE or --selftest" in capsys.readouterr().err
+
+
+# ------------------------------------------------------- grep locks
+
+
+def test_chaos_package_is_jax_free():
+    """chaos/ must arm (and stay zero-cost disarmed) on any host: no
+    module-scope jax import anywhere, no direct jit/pjit calls ever.
+    Engine work in drills goes through lazy engine imports."""
+    import image_analogies_tpu.chaos as chaos_pkg
+
+    root = os.path.dirname(chaos_pkg.__file__)
+    forbidden = re.compile(r"\bjax\.jit\s*\(|\bpjit\s*\(|\bjax\.pmap\s*\(")
+    toplevel_jax = re.compile(r"^(import jax|from jax)", re.MULTILINE)
+    for name in sorted(os.listdir(root)):
+        if not name.endswith(".py"):
+            continue
+        with open(os.path.join(root, name)) as f:
+            src = f.read()
+        assert not forbidden.findall(src), f"chaos/{name} calls jit/pjit"
+        assert not toplevel_jax.findall(src), (
+            f"chaos/{name} imports jax at module scope")

@@ -318,6 +318,230 @@ def test_server_scope_counters_in_report(tmp_path):
     assert srv_info["p50_ms"] > 0
 
 
+# --------------------------------------- deadline-aware (EDF) ordering
+
+
+def _mk_req(rid, key, deadline=None, age_s=0.0):
+    from concurrent.futures import Future
+
+    from image_analogies_tpu.serve.types import Request
+
+    req = Request(request_id=rid, a=None, ap=None, b=None, params=None,
+                  key=(key,), future=Future())
+    req.t_submit -= age_s
+    if deadline is not None:
+        req.deadline = req.t_submit + age_s + deadline
+    return req
+
+
+def test_edf_pop_order_tight_deadlines_first():
+    """Distinct-key waiters pop earliest-deadline-first; undeadlined
+    traffic sorts last (but see the aging test: never starves)."""
+    from image_analogies_tpu.serve.queue import AdmissionQueue
+
+    q = AdmissionQueue(8, deadline_ordering=True, age_bound_s=60.0)
+    q.submit(_mk_req(1, "a"))                  # no deadline
+    q.submit(_mk_req(2, "b", deadline=9.0))    # slack
+    q.submit(_mk_req(3, "c", deadline=0.5))    # tight
+    order = [q.pop_batch(1, 0.0)[0].request_id for _ in range(3)]
+    assert order == [3, 2, 1]
+
+
+def test_fifo_when_deadline_ordering_off():
+    from image_analogies_tpu.serve.queue import AdmissionQueue
+
+    q = AdmissionQueue(8, deadline_ordering=False)
+    q.submit(_mk_req(1, "a"))
+    q.submit(_mk_req(2, "b", deadline=0.5))
+    order = [q.pop_batch(1, 0.0)[0].request_id for _ in range(2)]
+    assert order == [1, 2]
+
+
+def test_aging_bound_prevents_starvation():
+    """Once the oldest waiter has queued past the bound it leads no
+    matter what — EDF can reorder by at most age_bound_s."""
+    from image_analogies_tpu.serve.queue import AdmissionQueue
+
+    q = AdmissionQueue(8, deadline_ordering=True, age_bound_s=5.0)
+    q.submit(_mk_req(1, "a", age_s=10.0))      # undeadlined, aged out
+    q.submit(_mk_req(2, "b", deadline=0.1))    # tight deadline
+    assert q.pop_batch(1, 0.0)[0].request_id == 1  # promoted past EDF
+    assert q.pop_batch(1, 0.0)[0].request_id == 2
+
+
+def test_loadgen_mixed_deadline_load_accounts_for_everything():
+    """The EDF satellite's load shape: tight-deadline traffic interleaved
+    with undeadlined bulk.  Every request resolves to exactly one
+    outcome and full-fidelity outputs stay bit-identical."""
+    from image_analogies_tpu.serve import loadgen
+
+    cfg = _cfg(workers=2, max_batch=2, batch_window_ms=5.0)
+    summary = loadgen.selftest(cfg, 4, seed=1,
+                               deadline_ms=(10_000, None),
+                               shapes=((12, 12),))
+    assert summary["errors"] == 0
+    resolved = (summary["completed"] + summary["degraded"]
+                + summary["timeouts"] + summary["rejected"])
+    assert resolved == 4
+    assert summary["bit_identical"] is True
+
+
+# ------------------------------------------------- circuit breaker
+
+
+def test_breaker_state_machine_with_fake_clock():
+    from image_analogies_tpu.serve.breaker import CircuitBreaker
+
+    now = {"t": 0.0}
+    br = CircuitBreaker(threshold=2, cooldown_s=10.0,
+                        clock=lambda: now["t"])
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    assert br.state == "closed"        # 1 < threshold
+    br.record_success()
+    br.record_failure()
+    assert br.state == "closed"        # success reset the streak
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "open"          # 2 consecutive -> tripped
+    assert not br.allow()              # fast fail inside cooldown
+    now["t"] = 11.0
+    assert br.allow()                  # half-open: the ONE probe slot
+    assert not br.allow()              # second caller: still fast fail
+    br.record_failure()                # probe failed
+    assert br.state == "open"          # fresh cooldown
+    now["t"] = 22.0
+    assert br.allow()
+    br.record_success()                # probe succeeded
+    assert br.state == "closed" and br.allow()
+
+
+def test_breaker_threshold_zero_disabled():
+    from image_analogies_tpu.serve.breaker import CircuitBreaker
+
+    br = CircuitBreaker(threshold=0, cooldown_s=1.0)
+    for _ in range(50):
+        br.record_failure()
+    assert br.state == "closed" and br.allow()
+
+
+def test_breaker_trips_server_and_recovers():
+    """End-to-end: consecutive dispatch failures trip the breaker, later
+    requests fail fast with Rejected("circuit_open") — no retry burn —
+    and a successful probe after the cooldown closes it again."""
+    from image_analogies_tpu.utils import failure
+
+    a, ap, b = make_pair(10, 10, seed=20)
+    cfg = _cfg(workers=1, max_batch=1, batch_window_ms=0.0,
+               request_retries=0, breaker_threshold=2,
+               breaker_cooldown_s=30.0)
+    with Server(cfg) as srv:
+        failure.inject_failures(2)
+        for _ in range(2):  # two consecutive dispatch failures
+            with pytest.raises(failure.InjectedFailure):
+                srv.request(a, ap, b, timeout=60)
+        assert srv._pool.breaker.state == "open"
+        t0 = time.monotonic()
+        with pytest.raises(Rejected) as ei:
+            srv.request(a, ap, b, timeout=60)
+        assert ei.value.reason == "circuit_open"
+        assert time.monotonic() - t0 < 5.0  # fast fail, not a dispatch
+        # elapse the cooldown without sleeping 30s (white-box nudge)
+        srv._pool.breaker._opened_at -= 60.0
+        resp = srv.request(a, ap, b, timeout=120)  # the half-open probe
+        assert resp.status == "ok"
+        assert srv._pool.breaker.state == "closed"
+
+
+# ----------------------------------------------- crash containment
+
+
+def test_worker_crash_requeue_exhausted_rejects():
+    """crash_requeues=0: a crashed batch fails its members with
+    Rejected("worker_crash") — resolved, never lost — and the worker
+    thread survives to serve the next request."""
+    from image_analogies_tpu.chaos import inject
+    from image_analogies_tpu.chaos.plan import ChaosPlan, SiteRule
+
+    a, ap, b = make_pair(10, 10, seed=21)
+    cfg = _cfg(workers=1, max_batch=1, batch_window_ms=0.0,
+               crash_requeues=0, breaker_threshold=0)
+    plan = ChaosPlan(seed=0, sites=(
+        ("serve.dispatch", SiteRule(kind="crash", schedule=(0,))),))
+    with Server(cfg) as srv:
+        with inject.plan_scope(plan):
+            with pytest.raises(Rejected) as ei:
+                srv.request(a, ap, b, timeout=60)
+            assert ei.value.reason == "worker_crash"
+            # the thread survived: the next request dispatches normally
+            assert srv.request(a, ap, b, timeout=120).status == "ok"
+
+
+# ----------------------------------------------- cost-model priors
+
+
+def test_cost_prior_store_roundtrip(tmp_path, monkeypatch):
+    """cost_persist: a server's learned rate lands in the tune store and
+    seeds the NEXT server's degrade estimates (provenance "store")."""
+    from image_analogies_tpu.tune import store as tune_store
+
+    monkeypatch.setenv("IA_TUNE_STORE", str(tmp_path / "tune.json"))
+    params = _params(levels=1)
+    a, ap, b = make_pair(10, 10, seed=22)
+
+    srv = Server(_cfg(params=params, workers=1, cost_persist=True)).start()
+    assert srv.cost_prior_source == "default"  # cpu: no store, no table
+    srv.request(a, ap, b, timeout=120)         # one REAL observation
+    learned = srv.cost_model.rate
+    srv.shutdown()
+
+    entry = tune_store.load_entries().get("serve_cost|cpu|any")
+    assert entry is not None and entry["cost_rate"] == pytest.approx(learned)
+
+    srv2 = Server(_cfg(params=params, workers=1)).start()
+    try:
+        assert srv2.cost_prior_source == "store"
+        assert srv2.cost_model.rate == pytest.approx(learned)
+        assert srv2.cost_model.samples == 1      # seeded counts as history
+        assert srv2.cost_model.real_samples == 0  # ...but not as evidence
+    finally:
+        srv2.shutdown()
+
+
+def test_cost_persist_off_by_default(tmp_path, monkeypatch):
+    monkeypatch.setenv("IA_TUNE_STORE", str(tmp_path / "tune.json"))
+    params = _params(levels=1)
+    a, ap, b = make_pair(10, 10, seed=23)
+    with Server(_cfg(params=params, workers=1)) as srv:
+        srv.request(a, ap, b, timeout=120)
+    assert not os.path.exists(str(tmp_path / "tune.json"))
+
+
+def test_cost_prior_packaged_table(tmp_path, monkeypatch):
+    from image_analogies_tpu.serve import degrade as serve_degrade
+    from image_analogies_tpu.tune import tables as tune_tables
+
+    monkeypatch.setenv("IA_TUNE_STORE", str(tmp_path / "empty.json"))
+    monkeypatch.setitem(tune_tables.COST_RATES, "cpu|any", 5e-9)
+    rate, src = serve_degrade.load_prior(_params())
+    assert src == "packaged" and rate == 5e-9
+
+
+def test_seeded_cost_model_blends_first_sample():
+    """A store/packaged prior is a real past measurement: the first
+    observation BLENDS into it; only the hardwired default is replaced
+    wholesale on first contact."""
+    from image_analogies_tpu.serve.degrade import CostModel
+
+    seeded = CostModel(1e-3, seeded=True)
+    seeded.observe(1.0, 2e-3)  # sample rate 2e-3
+    assert 1e-3 < seeded.rate < 2e-3  # EWMA blend, not replacement
+
+    fresh = CostModel()  # optimistic default, unseeded
+    fresh.observe(1.0, 2e-3)
+    assert fresh.rate == pytest.approx(2e-3)  # replaced outright
+
+
 # ------------------------------------------------------- grep locks
 
 
