@@ -53,10 +53,143 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import sys
 import time
 
 import numpy as np
+
+# --- regression sentry (`ia bench --check`) ---------------------------------
+#
+# The BENCH_r0N.json archive the driver keeps per round is a wall-clock
+# trajectory; these helpers turn it into a gate: parse each round's
+# headline number, group by metric (r01 measured the 256^2 oil config,
+# r02+ the 1024^2 north star — they must never be compared), and fail
+# when a fresh number regresses more than a threshold past the best
+# (lowest) same-metric point.  Everything here is jax-free and runs in
+# milliseconds — `ia bench --check --dry-run` rides in tier-1 so the
+# parsing of the archive formats can never silently rot.
+
+# r03-r05 have parsed=null and a head-truncated tail that cuts off the
+# headline "value" field; the north-star per-config block survives, so
+# this regex recovers the wall-clock from the raw text.
+_NORTH_STAR_RE = re.compile(
+    r'"north_star_1024_seed7"\s*:\s*\{\s*"tpu_s"\s*:\s*([0-9.eE+-]+)')
+_BENCH_FILE_RE = re.compile(r"^BENCH_r(\d+)\.json$")
+
+
+def _metric_key(metric: str) -> str:
+    """Comparable-config key of a headline metric string: its first
+    token ("1024x1024", "256x256") — rounds measuring different configs
+    must not gate each other."""
+    parts = str(metric).split()
+    return parts[0] if parts else "unknown"
+
+
+def extract_headline(doc: dict):
+    """Headline wall-clock of one BENCH_r0N.json driver doc, or None.
+
+    Tries, in order: the driver's ``parsed`` dict; the last full JSON
+    line in ``tail`` carrying a ``value`` field; a regex over the raw
+    tail for the north-star per-config block (survives the driver's
+    head-truncation of long tails).
+    """
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict) and "value" in parsed:
+        return {"value": float(parsed["value"]),
+                "metric_key": _metric_key(parsed.get("metric", "")),
+                "source": "parsed"}
+    tail = doc.get("tail") or ""
+    for line in reversed(tail.splitlines()):
+        line = line.strip()
+        if not (line.startswith("{") and '"value"' in line):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and "value" in obj:
+            return {"value": float(obj["value"]),
+                    "metric_key": _metric_key(obj.get("metric", "")),
+                    "source": "tail_json"}
+    m = _NORTH_STAR_RE.search(tail)
+    if m:
+        return {"value": float(m.group(1)),
+                "metric_key": "1024x1024",
+                "source": "tail_regex"}
+    return None
+
+
+def load_trajectory(bench_dir: str = ".") -> dict:
+    """Parse every BENCH_r*.json in ``bench_dir`` into an ordered list of
+    trajectory points; unparseable files land in ``problems`` rather
+    than raising (the sentry must degrade loudly, not crash)."""
+    rounds = []
+    for fname in os.listdir(bench_dir):
+        m = _BENCH_FILE_RE.match(fname)
+        if m:
+            rounds.append((int(m.group(1)), fname))
+    points, problems = [], []
+    for rnd, fname in sorted(rounds):
+        path = os.path.join(bench_dir, fname)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as exc:
+            problems.append(f"{fname}: unreadable ({exc})")
+            continue
+        head = extract_headline(doc)
+        if head is None:
+            problems.append(f"{fname}: no headline value found")
+            continue
+        head.update({"round": rnd, "file": fname})
+        points.append(head)
+    return {"points": points, "problems": problems}
+
+
+def check_regression(trajectory: dict, fresh_value=None,
+                     threshold_pct: float = 20.0) -> dict:
+    """Gate a wall-clock number against the trajectory floor.
+
+    With ``fresh_value`` (a just-measured number), it is compared against
+    the best (minimum) same-metric point of the whole archive.  Without
+    one (dry-run / archive self-check), the LATEST archived point is
+    checked against the best of the points before it.  ``ok`` is False
+    when the candidate exceeds the floor by more than ``threshold_pct``
+    percent.
+    """
+    points = trajectory.get("points") or []
+    if not points:
+        return {"ok": False, "reason": "no_trajectory_points",
+                "problems": trajectory.get("problems", [])}
+    latest = points[-1]
+    key = latest["metric_key"]
+    same = [p for p in points if p["metric_key"] == key]
+    if fresh_value is None:
+        candidate, cand_src = latest["value"], latest["file"]
+        prior = same[:-1]
+        if not prior:
+            return {"ok": True, "reason": "single_point",
+                    "metric_key": key, "candidate": candidate,
+                    "candidate_source": cand_src,
+                    "points": len(points),
+                    "problems": trajectory.get("problems", [])}
+        floor = min(p["value"] for p in prior)
+    else:
+        candidate, cand_src = float(fresh_value), "fresh"
+        floor = min(p["value"] for p in same)
+    regression_pct = (candidate - floor) / floor * 100.0
+    return {
+        "ok": regression_pct <= threshold_pct,
+        "metric_key": key,
+        "candidate": candidate,
+        "candidate_source": cand_src,
+        "floor": floor,
+        "regression_pct": round(regression_pct, 2),
+        "threshold_pct": threshold_pct,
+        "points": len(points),
+        "problems": trajectory.get("problems", []),
+    }
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, _HERE)

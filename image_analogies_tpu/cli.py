@@ -16,6 +16,7 @@ an `eval` command computing SSIM between two images.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import sys
@@ -108,6 +109,12 @@ def _add_engine_flags(p: argparse.ArgumentParser) -> None:
                         "registry + span tracing; with --log-path the "
                         "run_id-stamped records feed `report`.  Off by "
                         "default and near-zero-cost when off")
+    p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                   help="bind a loopback /metrics + /healthz exposition "
+                        "server (obs/live.py) for the duration of the "
+                        "command, scraping the LIVE registry mid-run "
+                        "(implies --metrics; 0 = ephemeral port, printed "
+                        "to stderr)")
     p.add_argument("--profile-dir", default=None)
     p.add_argument("--save-levels", dest="save_levels_dir", default=None,
                    metavar="DIR",
@@ -152,7 +159,7 @@ def _params_from_args(args, base: AnalogyParams) -> AnalogyParams:
         kw["coarse_patch_size"] = args.coarse_patch_size
     if args.no_ann:
         kw["use_ann"] = False
-    if args.metrics:
+    if args.metrics or getattr(args, "metrics_port", None) is not None:
         kw["metrics"] = True
     if args.no_level_sync:
         kw["level_sync"] = False
@@ -168,6 +175,27 @@ def _emit_stats(res) -> None:
         print(json.dumps(st, sort_keys=True), file=sys.stderr)
 
 
+@contextlib.contextmanager
+def _maybe_metrics_server(args):
+    """Bind the obs/live exposition server for the command's duration
+    when --metrics-port was given; no-op (and no obs.live import)
+    otherwise."""
+    port = getattr(args, "metrics_port", None)
+    if port is None:
+        yield None
+        return
+    from image_analogies_tpu.obs import live as obs_live
+
+    httpd = obs_live.start_http_server(port)
+    bound = httpd.server_address[1]
+    print(f"metrics: http://127.0.0.1:{bound}/metrics "
+          f"(and /healthz)", file=sys.stderr)
+    try:
+        yield httpd
+    finally:
+        obs_live.stop_http_server(httpd)
+
+
 def cmd_run(args) -> int:
     mode = args.mode
     base = {
@@ -179,21 +207,22 @@ def cmd_run(args) -> int:
     params = _params_from_args(args, base)
 
     ap = load_image(args.ap)
-    if mode == "texture_synthesis":
-        shape = tuple(int(x) for x in args.out_shape.split("x"))
-        res = modes.texture_synthesis(ap, shape, params, seed=args.seed)
-    elif mode == "super_resolution":
-        # A is derived by degrading A'; only A' and B are needed.
-        b = load_image(args.b)
-        res = modes.super_resolution(ap, b, params,
-                                     blur_passes=args.blur_passes)
-    else:
-        a = load_image(args.a)
-        b = load_image(args.b)
-        if mode == "filter":
-            res = modes.artistic_filter(a, ap, b, params)
+    with _maybe_metrics_server(args):
+        if mode == "texture_synthesis":
+            shape = tuple(int(x) for x in args.out_shape.split("x"))
+            res = modes.texture_synthesis(ap, shape, params, seed=args.seed)
+        elif mode == "super_resolution":
+            # A is derived by degrading A'; only A' and B are needed.
+            b = load_image(args.b)
+            res = modes.super_resolution(ap, b, params,
+                                         blur_passes=args.blur_passes)
         else:
-            res = modes.texture_by_numbers(a, ap, b, params)
+            a = load_image(args.a)
+            b = load_image(args.b)
+            if mode == "filter":
+                res = modes.artistic_filter(a, ap, b, params)
+            else:
+                res = modes.texture_by_numbers(a, ap, b, params)
     save_image(args.out, res.bp)
     _emit_stats(res)
     print(args.out)
@@ -208,7 +237,8 @@ def cmd_video(args) -> int:
     params = _params_from_args(args, base)
     if args.temporal_weight is not None:
         params = params.replace(temporal_weight=args.temporal_weight)
-    res = video_analogy(a, ap, frames, params, scheme=args.scheme)
+    with _maybe_metrics_server(args):
+        res = video_analogy(a, ap, frames, params, scheme=args.scheme)
     os.makedirs(args.out_dir, exist_ok=True)
     outs = []
     for t, frame in enumerate(res.frames):
@@ -234,19 +264,21 @@ def cmd_sweep(args) -> int:
         "super_resolution": PRESETS["super_resolution"],
     }[args.mode]
     os.makedirs(args.out_dir, exist_ok=True)
-    for k in (float(x) for x in args.kappas.split(",")):
-        params = _params_from_args(args, base).replace(kappa=k)
-        if args.mode == "super_resolution":
-            res = modes.super_resolution(ap_img, b, params,
-                                         blur_passes=args.blur_passes)
-        else:
-            res = modes.artistic_filter(a, ap_img, b, params)
-        out = os.path.join(args.out_dir, f"kappa_{k:g}.png")
-        save_image(out, res.bp)
-        rec = {"kappa": k, "out": out}
-        if ref is not None:
-            rec["ssim_vs_ref"] = round(ssim(np.clip(res.bp, 0, 1), ref), 4)
-        print(json.dumps(rec))
+    with _maybe_metrics_server(args):
+        for k in (float(x) for x in args.kappas.split(",")):
+            params = _params_from_args(args, base).replace(kappa=k)
+            if args.mode == "super_resolution":
+                res = modes.super_resolution(ap_img, b, params,
+                                             blur_passes=args.blur_passes)
+            else:
+                res = modes.artistic_filter(a, ap_img, b, params)
+            out = os.path.join(args.out_dir, f"kappa_{k:g}.png")
+            save_image(out, res.bp)
+            rec = {"kappa": k, "out": out}
+            if ref is not None:
+                rec["ssim_vs_ref"] = round(ssim(np.clip(res.bp, 0, 1), ref),
+                                           4)
+            print(json.dumps(rec))
     return 0
 
 
@@ -356,13 +388,17 @@ def cmd_serve(args) -> int:
         deadline_ordering=not args.no_deadline_ordering,
         breaker_threshold=args.breaker_threshold,
         cost_persist=not args.no_cost_persist,
+        slo_target=args.slo_target,
+        slo_fast_window_s=args.slo_fast_window_s,
+        slo_slow_window_s=args.slo_slow_window_s,
     )
 
     if args.selftest is not None:
         from image_analogies_tpu.serve import loadgen
 
-        summary = loadgen.selftest(cfg, args.selftest, seed=args.seed,
-                                   deadline_ms=deadline_ms)
+        with _maybe_metrics_server(args):
+            summary = loadgen.selftest(cfg, args.selftest, seed=args.seed,
+                                       deadline_ms=deadline_ms)
         print(loadgen.render(summary))
         print(json.dumps(summary, sort_keys=True), file=sys.stderr)
         return 0 if (summary["errors"] == 0
@@ -377,7 +413,8 @@ def cmd_serve(args) -> int:
     with Server(cfg) as srv:
         httpd = serve_http(srv, args.http)
         print(f"serving on http://127.0.0.1:{args.http} "
-              f"(POST /v1/analogy, GET /healthz); Ctrl-C to drain+exit")
+              f"(POST /v1/analogy, GET /healthz, GET /metrics); "
+              f"Ctrl-C to drain+exit")
         try:
             httpd.serve_forever()
         except KeyboardInterrupt:
@@ -418,6 +455,107 @@ def cmd_chaos(args) -> int:
         print(json.dumps(result, sort_keys=True, default=str),
               file=sys.stderr)
     return 0 if result["ok"] else 1
+
+
+def cmd_metrics(args) -> int:
+    """Prometheus exposition of a run log's latest metrics snapshot
+    (obs/live.py).  Without --port, render once to stdout.  With --port,
+    bind a loopback sidecar exposition server that re-reads the log per
+    scrape — live telemetry for runs that did not pass --metrics-port
+    themselves (the log is the transport)."""
+    from image_analogies_tpu.obs import live as obs_live
+
+    if not os.path.exists(args.log):
+        print(f"metrics: no such log: {args.log}", file=sys.stderr)
+        return 2
+    if args.port is None:
+        snap = obs_live.snapshot_from_log(args.log)
+        if snap is None:
+            print(f"metrics: no run_end snapshot in {args.log}",
+                  file=sys.stderr)
+            return 1
+        sys.stdout.write(obs_live.render_prometheus(snap))
+        return 0
+
+    log = args.log
+    httpd = obs_live.start_http_server(
+        args.port,
+        snapshot_fn=lambda: obs_live.snapshot_from_log(log),
+        health_fn=lambda: obs_live.health_from_log(log))
+    bound = httpd.server_address[1]
+    print(f"metrics sidecar on http://127.0.0.1:{bound}/metrics "
+          f"(and /healthz), re-reading {log} per scrape; Ctrl-C to exit",
+          file=sys.stderr)
+    try:
+        httpd._ia_thread.join()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        obs_live.stop_http_server(httpd)
+    return 0
+
+
+def _load_bench_module():
+    """Import the repo-root bench.py (it is a script, not a package
+    member).  Module scope there is jax-free, so `--check` stays fast."""
+    import importlib.util
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "bench.py")
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    spec = importlib.util.spec_from_file_location("ia_bench", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def cmd_bench(args) -> int:
+    """Bench entry + regression sentry.  Plain `ia bench` runs the full
+    benchmark harness (bench.py).  `--check` never measures: it parses
+    the BENCH_r*.json trajectory and gates a candidate number — the
+    latest archived point by default, or --value/--result when given —
+    against the best same-metric point, failing (exit 1) past
+    --threshold percent regression."""
+    try:
+        bench = _load_bench_module()
+    except FileNotFoundError as exc:
+        print(f"bench: bench.py not found at {exc}", file=sys.stderr)
+        return 2
+
+    if not args.check and not args.dry_run:
+        return int(bench.main() or 0)
+
+    bench_dir = args.dir or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    trajectory = bench.load_trajectory(bench_dir)
+    fresh = None
+    if args.value is not None:
+        fresh = args.value
+    elif args.result is not None:
+        try:
+            with open(args.result) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as exc:
+            print(f"bench: bad --result {args.result}: {exc}",
+                  file=sys.stderr)
+            return 2
+        if isinstance(doc, dict) and "value" in doc:
+            fresh = float(doc["value"])
+        else:
+            head = bench.extract_headline(doc if isinstance(doc, dict)
+                                          else {})
+            if head is None:
+                print(f"bench: no headline value in {args.result}",
+                      file=sys.stderr)
+                return 2
+            fresh = head["value"]
+    verdict = bench.check_regression(trajectory, fresh_value=fresh,
+                                     threshold_pct=args.threshold)
+    print(json.dumps(verdict, sort_keys=True))
+    for problem in verdict.get("problems", []):
+        print(f"bench: warning: {problem}", file=sys.stderr)
+    return 0 if verdict["ok"] else 1
 
 
 def cmd_trace(args) -> int:
@@ -506,6 +644,40 @@ def build_parser() -> argparse.ArgumentParser:
                     help="output trace path (default: trace.json)")
     tr.set_defaults(fn=cmd_trace)
 
+    mx = sub.add_parser("metrics",
+                        help="Prometheus text exposition of a run log's "
+                             "metrics: once to stdout, or as a loopback "
+                             "sidecar server with --port")
+    mx.add_argument("log", help="run-log JSONL (--log-path output)")
+    mx.add_argument("--port", type=int, default=None, metavar="PORT",
+                    help="bind a sidecar /metrics + /healthz server that "
+                         "re-reads the log per scrape (0 = ephemeral)")
+    mx.set_defaults(fn=cmd_metrics)
+
+    bn = sub.add_parser("bench",
+                        help="run the benchmark harness, or with --check "
+                             "gate a wall-clock number against the "
+                             "BENCH_r*.json trajectory (regression sentry)")
+    bn.add_argument("--check", action="store_true",
+                    help="no measurement: parse the trajectory and fail "
+                         "(exit 1) when the candidate regresses past "
+                         "--threshold over the best same-metric point")
+    bn.add_argument("--dry-run", action="store_true",
+                    help="alias for the no-measurement check path (tier-1 "
+                         "smoke: proves the archive still parses)")
+    bn.add_argument("--value", type=float, default=None,
+                    help="fresh wall-clock seconds to gate (e.g. a number "
+                         "just measured out-of-band)")
+    bn.add_argument("--result", default=None, metavar="FILE",
+                    help="JSON file carrying the fresh number: a bench "
+                         "headline line or a BENCH_r0N.json driver doc")
+    bn.add_argument("--threshold", type=float, default=20.0,
+                    help="max tolerated regression percent (default 20)")
+    bn.add_argument("--dir", default=None,
+                    help="directory holding BENCH_r*.json (default: repo "
+                         "root)")
+    bn.set_defaults(fn=cmd_bench)
+
     # tune takes NO engine flags (and so skips the distributed-init gate):
     # --dry-run must never touch the device.
     tn = sub.add_parser("tune",
@@ -587,6 +759,14 @@ def build_parser() -> argparse.ArgumentParser:
                     help="do not persist the measured degrade cost rate "
                          "to the tune store at shutdown (persistence "
                          "seeds the next server's admission estimates)")
+    sv.add_argument("--slo-target", type=float, default=0.99,
+                    help="SLO: target fraction of deadlined requests that "
+                         "meet their deadline (obs/slo.py burn-rate "
+                         "gauges, /healthz slo section)")
+    sv.add_argument("--slo-fast-window-s", type=float, default=60.0,
+                    help="fast (paging) burn-rate window seconds")
+    sv.add_argument("--slo-slow-window-s", type=float, default=600.0,
+                    help="slow (ticket) burn-rate window seconds")
     sv.add_argument("--seed", type=int, default=0)
     _add_engine_flags(sv)
     sv.set_defaults(fn=cmd_serve)

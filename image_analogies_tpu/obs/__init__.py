@@ -27,6 +27,20 @@ to jax:
   and per-level HBM watermarks (``hbm.peak_bytes.d<N>`` peak gauges).
 - ``obs.export`` — the ``ia trace`` converter: run-log JSONL to
   Chrome/Perfetto trace.json (host / device / compile tracks).
+
+Live telemetry plane (ISSUE 6 tentpole), jax-free like the core:
+
+- ``obs.live`` — Prometheus text exposition over the live registry
+  snapshot (``/metrics``) plus a loopback-only HTTP exposition server
+  (``/metrics`` + ``/healthz``); used by serve/http.py, the
+  ``--metrics-port`` engine flag, and ``ia metrics``.  Imported lazily
+  by consumers (it pulls stdlib ``http.server`` on demand).
+- ``obs.slo`` — rolling-window SLO attainment + fast/slow burn-rate
+  tracking over deadline outcomes, exported as ``slo.*`` gauges and an
+  ``slo`` section in ``ia report``.
+- ``obs.trace.request_context`` — thread-ambient attrs (the serve
+  request id) inherited by every span/record emitted inside the scope,
+  so one request's records chain end to end in ``ia trace``.
 """
 
 from image_analogies_tpu.obs import metrics, trace  # noqa: F401

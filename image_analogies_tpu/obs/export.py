@@ -62,6 +62,14 @@ def _classify(rec: Dict[str, Any]) -> Tuple[str, int, str, Optional[float]]:
                 f"req {rec.get('request', '?')} "
                 f"{rec.get('status', '?')}",
                 float(rec.get("total_ms", 0.0)))
+    if ev in ("serve_admit", "serve_degrade_decision"):
+        # request-chain instants on the serve track: together with the
+        # queue_ms/dispatch_ms-bearing serve_request interval these make
+        # one request's critical path readable end to end (admit ->
+        # queue wait -> batch -> dispatch -> degrade decision), all
+        # joined by the shared `request` id in args.
+        verb = "admit" if ev == "serve_admit" else "degrade"
+        return "i", SERVE_TID, f"{verb} r{rec.get('request', '?')}", None
     if ev in ("chaos_inject", "ckpt_quarantined", "watchdog_timeout",
               "retry_exhausted", "serve_worker_crash", "breaker_open",
               "breaker_half_open", "breaker_closed"):

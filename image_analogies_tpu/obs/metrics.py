@@ -63,6 +63,19 @@ class Histogram:
                 return min(edge, self.max)
         return self.max
 
+    def cumulative_buckets(self) -> List[tuple]:
+        """Prometheus-style cumulative buckets: ``[(le_edge, cum), ...]``
+        ascending by edge, where ``le_edge`` is the bucket's upper bound
+        (``2**k``; the k=0 bucket also absorbs values <= 0 so its edge is
+        1.0).  Well-defined on every histogram state: empty -> ``[]``,
+        single-sample -> one pair — never an exception, never NaN."""
+        out: List[tuple] = []
+        cum = 0
+        for k in sorted(self.buckets):
+            cum += self.buckets[k]
+            out.append((float(2 ** k), cum))
+        return out
+
     def summary(self) -> Dict[str, float]:
         if not self.count:
             return {"count": 0, "sum": 0.0, "min": 0.0, "max": 0.0,

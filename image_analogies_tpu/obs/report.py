@@ -267,6 +267,23 @@ def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         for dev, v in (hr.get("peaks") or {}).items():
             hbm[dev] = max(hbm.get(dev, 0.0), float(v))
 
+    # --- SLO section (obs/slo.py counters + run_end gauges) ---------------
+    slo_info: Optional[Dict[str, Any]] = None
+    if "slo.deadlined" in counters or "slo.target" in gauges:
+        deadlined = int(counters.get("slo.deadlined", 0))
+        violations = int(counters.get("slo.violations", 0))
+        slo_info = {
+            "target": gauges.get("slo.target"),
+            "deadlined": deadlined,
+            "violations": violations,
+            # lifetime attainment from counters; the rolling-window view
+            # lives in the gauges below (frozen at run_end)
+            "attainment": ((deadlined - violations) / deadlined
+                           if deadlined else None),
+            "burn_rate_fast": gauges.get("slo.burn_rate.fast"),
+            "burn_rate_slow": gauges.get("slo.burn_rate.slow"),
+        }
+
     return {
         "manifest": manifest,
         "run_end": run_end,
@@ -281,6 +298,7 @@ def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "compile": compile_info,
         "tune": tune_info,
         "serve": serve_info,
+        "slo": slo_info,
         "chaos": chaos_info,
         "hbm": hbm or None,
         "spans": spans,
@@ -407,6 +425,23 @@ def render(an: Dict[str, Any], run_id: Optional[str] = None) -> str:
             hist = ", ".join(f"{k}x{v}" for k, v in
                              srv["batch_size_hist"].items())
             w(f"    batch sizes   {hist}  (size x count)")
+
+    slo = an.get("slo")
+    if slo:
+        w("  slo:")
+        target = slo.get("target")
+        attain = slo.get("attainment")
+        if target is not None:
+            w(f"    target        {100 * target:.2f}%")
+        w(f"    deadlined     {slo['deadlined']} requests, "
+          f"{slo['violations']} violations"
+          + (f" (attainment {100 * attain:.2f}%)"
+             if attain is not None else ""))
+        bf, bs = slo.get("burn_rate_fast"), slo.get("burn_rate_slow")
+        if bf is not None or bs is not None:
+            w(f"    burn rate     fast {bf if bf is not None else '-'} / "
+              f"slow {bs if bs is not None else '-'}  "
+              "(1.0 = exactly on budget)")
 
     cha = an.get("chaos")
     if cha:

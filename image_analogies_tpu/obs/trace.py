@@ -63,6 +63,7 @@ class RunContext:
 
 _CURRENT: Optional[RunContext] = None
 _SPANS = threading.local()  # per-thread span stack
+_REQ_CTX = threading.local()  # per-thread ambient request attrs
 
 
 def current_run_id() -> Optional[str]:
@@ -79,6 +80,36 @@ def _stamp(record: Dict[str, Any]) -> None:
 # Registered once at import: utils.logging calls it on every emit; it is
 # a no-op dict check while no run is active.
 _logging.set_record_stamper(_stamp)
+
+
+@contextlib.contextmanager
+def request_context(**attrs: Any):
+    """Ambient trace attributes for the current thread.
+
+    Every span exit and :func:`emit_record` inside the scope inherits
+    ``attrs`` (explicit span attrs win).  This is how a serve request's
+    ``request`` id flows from admission through queue → batcher → worker
+    → engine dispatch without threading a parameter through every layer:
+    the worker wraps the per-request path once and all nested records —
+    including the engine's own ``level``/``fetch`` spans — carry the id,
+    so ``ia trace`` can render one request's critical path end to end.
+
+    Nests: an inner scope overlays the outer and restores it on exit.
+    Zero-cost when unused: span/emit paths read one thread-local slot.
+    """
+    prev = getattr(_REQ_CTX, "attrs", None)
+    merged = dict(prev) if prev else {}
+    merged.update(attrs)
+    _REQ_CTX.attrs = merged
+    try:
+        yield
+    finally:
+        _REQ_CTX.attrs = prev
+
+
+def context_attrs() -> Optional[Dict[str, Any]]:
+    """The current thread's ambient request attrs (or None)."""
+    return getattr(_REQ_CTX, "attrs", None)
 
 
 _UNSET = object()
@@ -260,6 +291,10 @@ class _Span:
         if exc and exc[0] is not None:
             rec["error"] = getattr(exc[0], "__name__", str(exc[0]))
         rec.update(self.attrs)
+        ambient = getattr(_REQ_CTX, "attrs", None)
+        if ambient:
+            for k, v in ambient.items():
+                rec.setdefault(k, v)
         _logging.emit(rec, self.ctx.log_path)
         return False
 
@@ -277,6 +312,10 @@ def emit_record(record: Dict[str, Any]) -> None:
     active it still mirrors to stdlib logging (utils.logging.emit), just
     without a JSONL destination — callers never need to branch."""
     ctx = _CURRENT
+    ambient = getattr(_REQ_CTX, "attrs", None)
+    if ambient:
+        for k, v in ambient.items():
+            record.setdefault(k, v)
     _logging.emit(record, ctx.log_path if ctx is not None else None)
 
 

@@ -42,6 +42,12 @@ def exemplar_digest(a: np.ndarray, ap: np.ndarray) -> str:
     return h.hexdigest()[:12]
 
 
+def key_str(key: Tuple[Any, ...]) -> str:
+    """Canonical display form of a batch key (span attrs, trace labels):
+    ``digest/a_bucket/b_bucket/exemplar``."""
+    return "/".join(str(k) for k in key)
+
+
 def batch_key(a: np.ndarray, ap: np.ndarray, b: np.ndarray,
               params: AnalogyParams) -> Tuple[Any, ...]:
     a_rows = int(a.shape[0]) * int(a.shape[1])

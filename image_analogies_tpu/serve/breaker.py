@@ -13,9 +13,16 @@ up behind it.  The breaker converts that into fast, honest rejection:
   through.  Success closes the breaker; failure re-opens it for another
   cooldown.
 
+The admission layer consults :meth:`admission_open` — a non-claiming
+read that is True only while the breaker is open with the cooldown
+unelapsed — so ``submit()`` can shed with ``Rejected("breaker_open")``
+one hop before the queue without stealing the half-open probe slot.
+State is exported live as the gauge ``serve.breaker.state.<backend>``
+(closed=0, half_open=1, open=2) for the /metrics exposition.
+
 ``threshold=0`` disables the breaker entirely (every ``allow()`` is
-True, nothing is counted).  The clock is injectable so tests drive the
-state machine without sleeping.
+True, ``admission_open()`` is False, nothing is counted).  The clock is
+injectable so tests drive the state machine without sleeping.
 """
 
 from __future__ import annotations
@@ -28,12 +35,17 @@ from image_analogies_tpu.obs import metrics as obs_metrics
 from image_analogies_tpu.obs import trace as obs_trace
 
 
+_STATE_CODE = {"closed": 0, "half_open": 1, "open": 2}
+
+
 class CircuitBreaker:
     def __init__(self, threshold: int, cooldown_s: float,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 backend: str = "default"):
         self._threshold = int(threshold)
         self._cooldown_s = float(cooldown_s)
         self._clock = clock
+        self.backend = str(backend)
         self._lock = threading.Lock()
         self._state = "closed"
         self._consecutive = 0
@@ -44,6 +56,28 @@ class CircuitBreaker:
     def state(self) -> str:
         with self._lock:
             return self._state
+
+    def export_state(self) -> None:
+        """Publish the per-backend state gauge (closed=0, half_open=1,
+        open=2).  Called on every transition and once at pool start so
+        the gauge exists from the first scrape."""
+        with self._lock:
+            self._export_locked()
+
+    def _export_locked(self) -> None:
+        obs_metrics.set_gauge(f"serve.breaker.state.{self.backend}",
+                              _STATE_CODE[self._state])
+
+    def admission_open(self) -> bool:
+        """Non-claiming read for the admission layer: True only while the
+        breaker is open AND the cooldown has not elapsed.  Once the
+        cooldown expires this returns False even before a probe runs, so
+        the half-open probe request can flow through ``submit()``."""
+        if self._threshold <= 0:
+            return False
+        with self._lock:
+            return (self._state == "open"
+                    and self._clock() - self._opened_at < self._cooldown_s)
 
     def allow(self) -> bool:
         """May a dispatch proceed right now?  In half_open this CLAIMS the
@@ -60,6 +94,7 @@ class CircuitBreaker:
                     return False
                 self._state = "half_open"
                 self._probing = False
+                self._export_locked()
                 obs_trace.emit_record({"event": "breaker_half_open"})
             # half_open: hand out the one probe slot
             if self._probing:
@@ -78,6 +113,7 @@ class CircuitBreaker:
             self._state = "closed"
             self._consecutive = 0
             self._probing = False
+            self._export_locked()
 
     def record_failure(self) -> None:
         if self._threshold <= 0:
@@ -97,6 +133,7 @@ class CircuitBreaker:
         self._opened_at = self._clock()
         self._consecutive = 0
         self._probing = False
+        self._export_locked()
         obs_metrics.inc("serve.breaker.trips")
         obs_trace.emit_record({"event": "breaker_open",
                                "cooldown_s": self._cooldown_s})

@@ -5,7 +5,12 @@ lives here, and nothing in the test suite requires it (the sandbox has
 no DNS; binding is loopback-only by construction).
 
 API:
-  GET  /healthz      -> {"ok": true, "queue_depth": N}
+  GET  /healthz      -> Server.health(): ok, accepting, uptime_s,
+                        queue_depth, inflight, breakers{backend: state},
+                        workers{total, alive, threads}, devcache_bytes,
+                        hbm_peak_bytes, slo{target, burn rates, ...}
+  GET  /metrics      -> Prometheus text exposition (obs/live.py) of the
+                        server's live metrics registry
   POST /v1/analogy   -> body {"a": [[...]], "ap": [[...]], "b": [[...]],
                         "deadline_ms": optional float}
                         reply {"request", "status", "bp", "timings", ...}
@@ -22,6 +27,7 @@ from typing import Any, Dict
 
 import numpy as np
 
+from image_analogies_tpu.obs import live as obs_live
 from image_analogies_tpu.serve.server import Server
 from image_analogies_tpu.serve.types import DeadlineExceeded, Rejected
 
@@ -40,10 +46,23 @@ def _make_handler(server: Server):
             self.end_headers()
             self.wfile.write(body)
 
+        def _reply_text(self, code: int, text: str, ctype: str) -> None:
+            body = text.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def do_GET(self):  # noqa: N802 - stdlib API
             if self.path == "/healthz":
-                self._reply(200, {"ok": True,
-                                  "queue_depth": server.queue_depth})
+                self._reply(200, server.health())
+            elif self.path == "/metrics":
+                server.refresh_gauges()
+                self._reply_text(
+                    200,
+                    obs_live.render_prometheus(obs_live.snapshot_or_none()),
+                    obs_live.CONTENT_TYPE)
             else:
                 self._reply(404, {"error": "not_found"})
 

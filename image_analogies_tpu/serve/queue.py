@@ -124,6 +124,8 @@ class AdmissionQueue:
             now = time.monotonic()
             for req in batch:
                 req.t_dequeue = now
+                obs_metrics.observe("serve.queue_wait_ms",
+                                    (now - req.t_submit) * 1e3)
             obs_metrics.set_gauge("serve.queue_depth", len(self._items))
             return batch
 

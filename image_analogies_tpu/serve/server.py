@@ -19,14 +19,16 @@ from __future__ import annotations
 
 import contextlib
 import threading
+import time
 from concurrent.futures import Future
-from typing import Optional
+from typing import Any, Dict, Optional
 
 import numpy as np
 
 from image_analogies_tpu.config import AnalogyParams
 from image_analogies_tpu.obs import metrics as obs_metrics
 from image_analogies_tpu.obs import trace as obs_trace
+from image_analogies_tpu.obs.slo import SloTracker
 from image_analogies_tpu.serve import batcher
 from image_analogies_tpu.serve import degrade as serve_degrade
 from image_analogies_tpu.serve.degrade import CostModel
@@ -53,12 +55,17 @@ class Server:
         rate, self.cost_prior_source = serve_degrade.load_prior(cfg.params)
         self.cost_model = CostModel(
             rate, seeded=self.cost_prior_source != "default")
-        self._pool = WorkerPool(cfg, self._queue, self.cost_model)
+        self.slo = SloTracker(cfg.slo_target,
+                              fast_window_s=cfg.slo_fast_window_s,
+                              slow_window_s=cfg.slo_slow_window_s)
+        self._pool = WorkerPool(cfg, self._queue, self.cost_model,
+                                slo=self.slo)
         self._exit = contextlib.ExitStack()
         self._accepting = False
         self._started = False
         self._next_id = 0
         self._id_lock = threading.Lock()
+        self._t_start: Optional[float] = None
         self.warmup_report: list = []
 
     # -- lifecycle ---------------------------------------------------------
@@ -82,14 +89,17 @@ class Server:
                 "deadline_ordering": self.cfg.deadline_ordering,
                 "breaker_threshold": self.cfg.breaker_threshold,
                 "cost_prior": self.cost_prior_source,
+                "slo_target": self.cfg.slo_target,
             }}))
         obs_metrics.inc(f"serve.cost_prior.{self.cost_prior_source}")
+        obs_metrics.set_gauge("serve.queue_depth", 0)
         if self.cfg.warmup_sizes:
             with obs_trace.span("serve_warmup",
                                 sizes=len(self.cfg.warmup_sizes)):
                 self.warmup_report = tune_warmup.warmup_buckets(
                     self.cfg.params, self.cfg.warmup_sizes)
         self._pool.start()
+        self._t_start = time.monotonic()
         self._accepting = True
         return self
 
@@ -126,6 +136,15 @@ class Server:
         :class:`Rejected` when the server is full or shutting down."""
         if not self._accepting:
             raise Rejected("shutting_down")
+        if self._pool.breaker.admission_open():
+            # Breaker-aware admission: the dispatch breaker is open, so
+            # an accepted request would only sit in the queue to be
+            # fast-failed at dispatch.  Shed one hop earlier instead —
+            # queue_depth stays honest during brownouts.  admission_open
+            # is non-claiming, so the half-open probe still flows.
+            obs_metrics.inc("serve.rejected")
+            obs_metrics.inc("serve.rejected.breaker_open")
+            raise Rejected("breaker_open")
         p = params or self.cfg.params
         if deadline_s is None:
             deadline_s = self.cfg.default_deadline_s
@@ -143,6 +162,13 @@ class Server:
         if deadline_s is not None:
             req.deadline = req.t_submit + deadline_s
         self._queue.submit(req)  # Rejected propagates to the caller
+        # Admission instant: the first hop of the request's trace chain
+        # (ia trace renders admit -> queue wait -> batch -> dispatch).
+        obs_trace.emit_record({"event": "serve_admit",
+                               "request": rid,
+                               "key": batcher.key_str(req.key),
+                               "deadline_s": deadline_s,
+                               "queue_depth": len(self._queue)})
         return fut
 
     def request(self, a, ap, b, params=None, deadline_s=None,
@@ -153,6 +179,46 @@ class Server:
     @property
     def queue_depth(self) -> int:
         return len(self._queue)
+
+    # -- live telemetry ------------------------------------------------------
+
+    def refresh_gauges(self) -> None:
+        """Bring point-in-time gauges current before a /metrics scrape
+        (event-driven gauges update themselves; these are sampled)."""
+        if self._t_start is not None:
+            obs_metrics.set_gauge("serve.uptime_s",
+                                  round(time.monotonic() - self._t_start, 3))
+        obs_metrics.set_gauge("serve.queue_depth", len(self._queue))
+        self._pool.breaker.export_state()
+
+    def health(self) -> Dict[str, Any]:
+        """JSON-ready /healthz payload: liveness + the state an operator
+        (or the future multi-host router) needs to route around trouble."""
+        live = self._pool.liveness()
+        snap = obs_metrics.snapshot()
+        gauges = snap.get("gauges", {})
+        breaker = self._pool.breaker
+        workers_ok = all(live.values()) if live else True
+        return {
+            "ok": bool(self._started and self._accepting and workers_ok),
+            "accepting": self._accepting,
+            "uptime_s": (round(time.monotonic() - self._t_start, 3)
+                         if self._t_start is not None else 0.0),
+            "queue_depth": len(self._queue),
+            "inflight": self._pool.inflight,
+            "breakers": {breaker.backend: breaker.state},
+            "workers": {
+                "total": len(live),
+                "alive": sum(1 for ok in live.values() if ok),
+                "threads": live,
+            },
+            "devcache_bytes": gauges.get("devcache.bytes", 0),
+            # per-device hbm.peak_bytes.d<N> watermarks -> worst device
+            "hbm_peak_bytes": max(
+                (v for k, v in gauges.items()
+                 if k.startswith("hbm.peak_bytes.")), default=0),
+            "slo": self.slo.snapshot(),
+        }
 
 
 class Client:

@@ -23,7 +23,10 @@ class Rejected(RuntimeError):
 
     ``reason`` is machine-readable: ``"queue_full"`` when the bounded queue
     is at depth, ``"shutting_down"`` once drain has begun,
-    ``"circuit_open"`` while the dispatch circuit breaker is tripped,
+    ``"breaker_open"`` when admission sheds because the dispatch circuit
+    breaker is open (one hop before the queue — see serve/breaker.py),
+    ``"circuit_open"`` when the breaker trips between an accepted
+    request's admission and its dispatch,
     ``"worker_crash"`` when a crashed worker exhausted the requeue budget.
     """
 
@@ -83,6 +86,13 @@ class ServeConfig:
     # before failing them with Rejected("worker_crash") — no request is
     # ever silently lost, and a poison request can't requeue forever.
     crash_requeues: int = 1
+    # SLO over deadline outcomes (obs/slo.py): target fraction of
+    # deadlined requests that must meet their deadline, with fast
+    # (paging) and slow (ticket) burn-rate windows.  Exported as gauges
+    # and in /healthz; undeadlined traffic is not counted.
+    slo_target: float = 0.99
+    slo_fast_window_s: float = 60.0
+    slo_slow_window_s: float = 600.0
 
     def __post_init__(self):
         if self.queue_depth < 1:
@@ -95,6 +105,12 @@ class ServeConfig:
             raise ValueError("breaker_threshold/crash_requeues must be >= 0")
         if self.ordering_age_bound_s < 0:
             raise ValueError("ordering_age_bound_s must be >= 0")
+        if not 0.0 < self.slo_target < 1.0:
+            raise ValueError("slo_target must be in (0, 1)")
+        if (self.slo_fast_window_s <= 0
+                or self.slo_slow_window_s < self.slo_fast_window_s):
+            raise ValueError(
+                "slo windows must satisfy 0 < fast <= slow")
 
 
 @dataclasses.dataclass

@@ -32,6 +32,8 @@ from typing import List, Optional
 from image_analogies_tpu import chaos
 from image_analogies_tpu.obs import metrics as obs_metrics
 from image_analogies_tpu.obs import trace as obs_trace
+from image_analogies_tpu.obs.slo import SloTracker
+from image_analogies_tpu.serve import batcher
 from image_analogies_tpu.serve import degrade as serve_degrade
 from image_analogies_tpu.serve.breaker import CircuitBreaker
 from image_analogies_tpu.serve.queue import AdmissionQueue
@@ -47,22 +49,37 @@ from image_analogies_tpu.utils import failure
 
 class WorkerPool:
     def __init__(self, cfg: ServeConfig, queue: AdmissionQueue,
-                 cost_model: Optional[serve_degrade.CostModel] = None):
+                 cost_model: Optional[serve_degrade.CostModel] = None,
+                 slo: Optional[SloTracker] = None):
         self._cfg = cfg
         self._queue = queue
         self._cost = cost_model or serve_degrade.CostModel()
         self.breaker = CircuitBreaker(cfg.breaker_threshold,
-                                      cfg.breaker_cooldown_s)
+                                      cfg.breaker_cooldown_s,
+                                      backend=cfg.params.backend)
+        self.slo = slo
         self._threads: List[threading.Thread] = []
         self._inflight = 0
         self._inflight_lock = threading.Lock()
 
     def start(self) -> None:
+        # Publish the breaker gauge inside the server's run scope (gauges
+        # set before the scope opens are dropped with the old registry).
+        self.breaker.export_state()
         for i in range(self._cfg.workers):
             t = threading.Thread(target=self._loop, name=f"ia-serve-{i}",
                                  daemon=True)
             t.start()
             self._threads.append(t)
+
+    @property
+    def inflight(self) -> int:
+        with self._inflight_lock:
+            return self._inflight
+
+    def liveness(self) -> dict:
+        """Per-thread liveness for /healthz: ``{thread_name: is_alive}``."""
+        return {t.name: t.is_alive() for t in self._threads}
 
     def join(self, timeout: Optional[float] = None) -> None:
         end = None if timeout is None else time.monotonic() + timeout
@@ -113,7 +130,7 @@ class WorkerPool:
         obs_metrics.observe("serve.batch_size", len(batch))
         try:
             with obs_trace.span("serve_batch", size=len(batch),
-                                key="/".join(str(k) for k in batch[0].key)):
+                                key=batcher.key_str(batch[0].key)):
                 backend = None
                 for req in batch:
                     backend = self._run_one(req, backend, len(batch))
@@ -136,7 +153,22 @@ class WorkerPool:
             "degraded": degraded,
         })
 
+    def _record_slo(self, req: Request, met: bool) -> None:
+        """Feed the SLO tracker: only *deadlined* requests count toward
+        the deadline-attainment SLO (undeadlined traffic has no promise
+        to break)."""
+        if self.slo is not None and req.deadline is not None:
+            self.slo.record(met)
+
     def _run_one(self, req: Request, backend, batch_size: int):
+        # Ambient request id for the whole per-request path: every span
+        # and record below — including the engine's own level/fetch spans
+        # inside create_image_analogy — inherits it, so `ia trace` renders
+        # one connected request-id chain from admit to dispatch.
+        with obs_trace.request_context(request=req.request_id):
+            return self._dispatch_one(req, backend, batch_size)
+
+    def _dispatch_one(self, req: Request, backend, batch_size: int):
         """Dispatch one request; returns the (possibly newly built) shared
         backend for subsequent same-batch members."""
         # Lazy import: keep serve/ importable without touching jax until
@@ -157,14 +189,23 @@ class WorkerPool:
             req, self._cost, allow_degrade=self._cfg.degrade)
         if action == "timeout":
             obs_metrics.inc("serve.timeouts")
+            self._record_slo(req, False)
             self._emit_request_record(req, "timeout", batch_size=batch_size)
             req.future.set_exception(
                 DeadlineExceeded(req.request_id, -(req.remaining() or 0.0)))
             return backend
 
+        if degraded is not None:
+            # Instant on the serve track: the degrade ladder substituted
+            # params for this request — part of its critical path.
+            obs_trace.emit_record({"event": "serve_degrade_decision",
+                                   "request": req.request_id,
+                                   "degraded": degraded})
+
         if not self.breaker.allow():
             # circuit open: fail fast, no dispatch, no retry burn
             obs_metrics.inc("serve.rejected")
+            self._record_slo(req, False)
             self._emit_request_record(req, "rejected", batch_size=batch_size)
             req.future.set_exception(Rejected("circuit_open"))
             return backend
@@ -194,6 +235,7 @@ class WorkerPool:
         except Exception as exc:  # noqa: BLE001 - forwarded to the client
             self.breaker.record_failure()
             obs_metrics.inc("serve.errors")
+            self._record_slo(req, False)
             self._emit_request_record(req, "error", batch_size=batch_size,
                                       dispatch_ms=(time.monotonic() - t0) * 1e3)
             req.future.set_exception(exc)
@@ -219,6 +261,7 @@ class WorkerPool:
             degraded=degraded,
         )
         obs_metrics.inc("serve.completed")
+        self._record_slo(req, req.deadline is None or now <= req.deadline)
         if degraded is not None:
             obs_metrics.inc("serve.degraded")
         obs_metrics.observe("serve.latency_ms", resp.total_ms)

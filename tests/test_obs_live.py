@@ -1,0 +1,517 @@
+"""obs/live + obs/slo (ISSUE 6): streaming telemetry plane.
+
+Locked here:
+
+- golden Prometheus text exposition — stable metric names, HELP/TYPE
+  pairs, cumulative base-2 histogram buckets; no NaN/Inf ever emitted,
+  including empty and single-sample histograms;
+- the disabled snapshot path allocates nothing (tracemalloc-asserted)
+  and never touches a registry (monkeypatch-proven, chaos pattern);
+- serve front end: GET /metrics is valid exposition carrying
+  serve.queue_depth + serve.breaker.state + a bucketed histogram, and
+  GET /healthz reports breaker state + worker liveness — including
+  while the breaker is OPEN under a chaos serve.dispatch drill;
+- request-id propagation: every span/record of one served request
+  carries the same id, and the trace export chains admit -> dispatch;
+- SLO burn-rate math (fake clock), slo.* gauges, `ia report` section;
+- `ia bench --check` sentry: real trajectory passes, injected
+  regression fails, `--dry-run` smoke rides tier-1;
+- grep locks: obs/live.py + obs/slo.py have no module-scope jax.
+"""
+
+import json
+import os
+import re
+import tracemalloc
+import urllib.request
+
+import pytest
+
+from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.obs import live as obs_live
+from image_analogies_tpu.obs import metrics as obs_metrics
+from image_analogies_tpu.obs import trace as obs_trace
+from image_analogies_tpu.obs.slo import SloTracker
+from tests.conftest import make_pair
+
+
+def _params(**kw):
+    kw.setdefault("levels", 2)
+    kw.setdefault("backend", "cpu")
+    return AnalogyParams(**kw)
+
+
+# ------------------------------------------------ exposition rendering
+
+
+def test_prometheus_golden_exposition():
+    """Byte-exact golden: names sanitized under the ia_ prefix, one
+    HELP/TYPE pair per metric (HELP carries the dotted registry name),
+    counters get _total, histogram buckets are cumulative with 2^k
+    edges + +Inf + _sum + _count, sections and names sorted."""
+    reg = obs_metrics.MetricsRegistry()
+    reg.inc("serve.accepted", 3)
+    reg.inc("compile.count", 1)
+    reg.set_gauge("serve.queue_depth", 2)
+    reg.set_gauge("serve.breaker.state.cpu", 0)
+    reg.observe("serve.latency_ms", 0.5)   # k=0 bucket (le=1)
+    reg.observe("serve.latency_ms", 3.0)   # k=2 bucket (le=4)
+    reg.observe("serve.latency_ms", 3.5)
+    golden = "\n".join([
+        "# HELP ia_compile_count_total counter compile.count",
+        "# TYPE ia_compile_count_total counter",
+        "ia_compile_count_total 1",
+        "# HELP ia_serve_accepted_total counter serve.accepted",
+        "# TYPE ia_serve_accepted_total counter",
+        "ia_serve_accepted_total 3",
+        "# HELP ia_serve_breaker_state_cpu gauge serve.breaker.state.cpu",
+        "# TYPE ia_serve_breaker_state_cpu gauge",
+        "ia_serve_breaker_state_cpu 0",
+        "# HELP ia_serve_queue_depth gauge serve.queue_depth",
+        "# TYPE ia_serve_queue_depth gauge",
+        "ia_serve_queue_depth 2",
+        "# HELP ia_serve_latency_ms histogram serve.latency_ms",
+        "# TYPE ia_serve_latency_ms histogram",
+        'ia_serve_latency_ms_bucket{le="1"} 1',
+        'ia_serve_latency_ms_bucket{le="4"} 3',
+        'ia_serve_latency_ms_bucket{le="+Inf"} 3',
+        "ia_serve_latency_ms_sum 7",
+        "ia_serve_latency_ms_count 3",
+    ]) + "\n"
+    assert obs_live.render_prometheus(reg.snapshot()) == golden
+
+
+def test_prometheus_empty_and_single_sample_histograms():
+    """Satellite: histogram export is well-defined on empty and
+    single-sample histograms — no exception, no NaN, cumulative buckets
+    still monotone."""
+    h = obs_metrics.Histogram()
+    assert h.percentile(50) == 0.0          # empty: defined, not NaN
+    assert h.percentile(99) == 0.0
+    assert h.cumulative_buckets() == []
+    empty_summary = h.summary()
+    assert empty_summary["count"] == 0
+
+    h.observe(7.0)                          # single sample
+    assert h.percentile(0) == 7.0           # clamped to observed max
+    assert h.percentile(50) == 7.0
+    assert h.percentile(100) == 7.0
+    assert h.cumulative_buckets() == [(8.0, 1)]
+
+    reg = obs_metrics.MetricsRegistry()
+    reg.observe("one.sample", 7.0)
+    snap = reg.snapshot()
+    snap["histograms"]["empty.hist"] = empty_summary
+    text = obs_live.render_prometheus(snap)
+    assert "nan" not in text.lower() and "inf " not in text.lower()
+    assert 'ia_empty_hist_bucket{le="+Inf"} 0' in text
+    assert "ia_empty_hist_count 0" in text
+    assert 'ia_one_sample_bucket{le="8"} 1' in text
+
+
+def test_prometheus_name_sanitization_and_none_snapshot():
+    assert obs_live.prom_name("serve.breaker.state.cpu") == \
+        "ia_serve_breaker_state_cpu"
+    assert obs_live.prom_name("hbm.peak_bytes.d0") == "ia_hbm_peak_bytes_d0"
+    # None snapshot (obs disabled) renders a comment, not an error
+    text = obs_live.render_prometheus(None)
+    assert text.startswith("#") and text.endswith("\n")
+    # every emitted metric name is exposition-legal
+    reg = obs_metrics.MetricsRegistry()
+    reg.inc("weird-name.with:chars!")
+    legal = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*(_total)?$")
+    for line in obs_live.render_prometheus(reg.snapshot()).splitlines():
+        if line.startswith("#"):
+            continue
+        assert legal.match(line.split("{")[0].split(" ")[0])
+
+
+# ------------------------------------------------ disabled path cost
+
+
+def test_disabled_snapshot_path_allocates_nothing(monkeypatch):
+    """Acceptance: with obs disabled, the snapshot path is one global
+    read returning None — zero allocations attributable to obs/, and
+    the registry is provably never touched (chaos disarm pattern:
+    poison the expensive call and prove it unreached)."""
+    assert obs_metrics.registry() is None
+
+    # monkeypatch-proven inert: if the disabled path ever reached a
+    # registry snapshot it would raise
+    monkeypatch.setattr(obs_metrics.MetricsRegistry, "snapshot",
+                        lambda self: (_ for _ in ()).throw(
+                            AssertionError("registry touched while off")))
+    assert obs_live.snapshot_or_none() is None
+
+    tracemalloc.start()
+    try:
+        for _ in range(1000):
+            obs_live.snapshot_or_none()
+        snap = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+    obs_allocs = [t for t in snap.traces
+                  if any("image_analogies_tpu/obs/" in fr.filename
+                         for fr in t.traceback)]
+    assert obs_allocs == []
+
+
+# ------------------------------------------------ exposition server
+
+
+def test_live_http_server_metrics_and_healthz():
+    httpd = obs_live.start_http_server(
+        0,
+        snapshot_fn=lambda: {"counters": {"x.y": 1}, "gauges": {},
+                             "histograms": {}},
+        health_fn=lambda: {"ok": True, "who": "test"})
+    try:
+        port = httpd.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics") as r:
+            assert r.headers["Content-Type"] == obs_live.CONTENT_TYPE
+            assert "ia_x_y_total 1" in r.read().decode()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz") as r:
+            assert json.load(r) == {"ok": True, "who": "test"}
+        bad = urllib.request.Request(f"http://127.0.0.1:{port}/nope")
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(bad)
+    finally:
+        obs_live.stop_http_server(httpd)
+
+
+# ------------------------------------------------ serve front end
+
+
+def _serve_cfg(**kw):
+    from image_analogies_tpu.serve import ServeConfig
+
+    kw.setdefault("params", _params())
+    kw.setdefault("workers", 1)
+    kw.setdefault("max_batch", 1)
+    kw.setdefault("batch_window_ms", 0.0)
+    return ServeConfig(**kw)
+
+
+def test_serve_http_metrics_and_healthz_schema():
+    """Acceptance: during a served run, GET /metrics is valid Prometheus
+    exposition carrying serve.queue_depth, serve.breaker.state, and a
+    bucketed histogram; GET /healthz reports breaker + worker liveness
+    + SLO."""
+    import threading
+
+    from image_analogies_tpu.serve import Server
+    from image_analogies_tpu.serve.http import serve_http
+
+    a, ap, b = make_pair(10, 10, seed=30)
+    with Server(_serve_cfg(default_deadline_s=60.0)) as srv:
+        assert srv.request(a, ap, b, timeout=120).status == "ok"
+        httpd = serve_http(srv, 0)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            port = httpd.server_address[1]
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics") as r:
+                assert r.headers["Content-Type"] == obs_live.CONTENT_TYPE
+                text = r.read().decode()
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz") as r:
+                hz = json.load(r)
+        finally:
+            httpd.shutdown()
+    # exposition content (dotted names ride in HELP lines)
+    assert "serve.queue_depth" in text
+    assert "serve.breaker.state" in text
+    assert re.search(r'_bucket\{le="[^"]+"\} \d+', text)
+    assert "nan" not in text.lower()
+    # healthz schema
+    assert hz["ok"] is True and hz["accepting"] is True
+    assert hz["queue_depth"] == 0 and hz["inflight"] == 0
+    assert hz["breakers"] == {"cpu": "closed"}
+    assert hz["workers"]["total"] == 1 and hz["workers"]["alive"] == 1
+    assert all(hz["workers"]["threads"].values())
+    assert hz["uptime_s"] >= 0
+    assert hz["slo"]["target"] == pytest.approx(0.99)
+    assert hz["slo"]["deadlined"] == 1 and hz["slo"]["violations"] == 0
+    assert {"devcache_bytes", "hbm_peak_bytes"} <= set(hz)
+
+
+def test_healthz_breaker_open_under_chaos_dispatch_drill():
+    """Satellite: /healthz + /metrics show the breaker OPEN while a
+    chaos serve.dispatch drill is mid-flight — the exact brownout view
+    an operator (or the future router) routes around."""
+    from image_analogies_tpu.chaos import inject
+    from image_analogies_tpu.chaos.plan import ChaosPlan, SiteRule
+    from image_analogies_tpu.serve import Rejected, Server
+
+    a, ap, b = make_pair(10, 10, seed=31)
+    cfg = _serve_cfg(request_retries=0, breaker_threshold=1,
+                     breaker_cooldown_s=300.0, crash_requeues=0)
+    plan = ChaosPlan(seed=0, sites=(
+        ("serve.dispatch", SiteRule(kind="crash", schedule=(0,))),))
+    with Server(cfg) as srv:
+        with inject.plan_scope(plan):
+            # drill batch 0 crashes at the dispatch site; containment
+            # resolves it as worker_crash
+            with pytest.raises(Rejected) as ei:
+                srv.request(a, ap, b, timeout=60)
+            assert ei.value.reason == "worker_crash"
+            # now trip the breaker (threshold=1) and read health while
+            # the drill plan is still armed
+            srv._pool.breaker.record_failure()
+            hz = srv.health()
+            assert hz["breakers"] == {"cpu": "open"}
+            assert hz["workers"]["alive"] == 1  # crash was contained
+            srv.refresh_gauges()
+            text = obs_live.render_prometheus(obs_live.snapshot_or_none())
+            assert "ia_serve_breaker_state_cpu 2" in text  # open=2
+            # admission sheds one hop early while open
+            with pytest.raises(Rejected) as ei2:
+                srv.submit(a, ap, b)
+            assert ei2.value.reason == "breaker_open"
+
+
+# ------------------------------------------------ request-id chain
+
+
+def test_request_id_propagates_through_all_spans(tmp_path):
+    """Acceptance: every span/record of one served request — admit,
+    queue, dispatch, the engine's own level spans — carries the same
+    request id, and the trace export chains them on the serve track."""
+    from image_analogies_tpu.obs import export as obs_export
+    from image_analogies_tpu.serve import Server
+
+    log = str(tmp_path / "req.jsonl")
+    a, ap, b = make_pair(10, 10, seed=32)
+    cfg = _serve_cfg(params=_params(log_path=log))
+    with Server(cfg) as srv:
+        assert srv.request(a, ap, b, timeout=120).status == "ok"
+
+    recs = [json.loads(line) for line in open(log)]
+    chain = [r for r in recs if r.get("request") == 1]
+    events = {r.get("event") for r in chain}
+    assert "serve_admit" in events          # admission hop
+    assert "serve_request" in events        # completion record
+    span_names = {r.get("name") for r in chain if r.get("event") == "span"}
+    assert "serve_dispatch" in span_names   # dispatch hop
+    assert "level" in span_names            # ENGINE spans inherit the id
+    # no other request id appears in the chain
+    assert {r.get("request") for r in chain} == {1}
+
+    out = str(tmp_path / "trace.json")
+    obs_export.export_trace(log, out)
+    tr = json.load(open(out))
+    serve_track = [e for e in tr["traceEvents"]
+                   if e.get("tid") == obs_export.SERVE_TID
+                   and e.get("ph") != "M"]
+    names = [e["name"] for e in serve_track]
+    assert "admit r1" in names              # instant at admission
+    assert any(n.startswith("req 1 ") for n in names)  # lifetime interval
+    assert "serve_dispatch" in names
+
+
+def test_request_context_nests_and_restores():
+    with obs_trace.run_scope(_params(metrics=True)):
+        assert obs_trace.context_attrs() is None
+        with obs_trace.request_context(request=7):
+            assert obs_trace.context_attrs() == {"request": 7}
+            with obs_trace.request_context(hop="inner"):
+                assert obs_trace.context_attrs() == {"request": 7,
+                                                     "hop": "inner"}
+            assert obs_trace.context_attrs() == {"request": 7}
+        assert obs_trace.context_attrs() is None
+
+
+# ------------------------------------------------ SLO tracking
+
+
+def test_slo_burn_rate_math_fake_clock():
+    now = {"t": 1000.0}
+    slo = SloTracker(target=0.9, fast_window_s=10.0, slow_window_s=100.0,
+                     clock=lambda: now["t"])
+    # 10 outcomes in the fast window, 2 violations: violation rate 0.2,
+    # budget 0.1 -> fast burn 2.0
+    for i in range(10):
+        slo.record(i not in (3, 7))
+    s = slo.snapshot()
+    assert s["deadlined"] == 10 and s["violations"] == 2
+    assert s["burn_rate_fast"] == pytest.approx(2.0)
+    assert s["burn_rate_slow"] == pytest.approx(2.0)
+    assert s["attainment"] == pytest.approx(0.8)
+    # advance past the fast window: fast burn decays to the new traffic,
+    # slow window still remembers
+    now["t"] += 50.0
+    for _ in range(10):
+        slo.record(True)
+    s = slo.snapshot()
+    assert s["burn_rate_fast"] == 0.0
+    assert s["burn_rate_slow"] == pytest.approx((2 / 20) / 0.1)
+    # advance past the slow window: everything pruned
+    now["t"] += 200.0
+    assert slo.snapshot()["burn_rate_slow"] == 0.0
+    assert slo.snapshot()["attainment"] == 1.0  # no data -> not burning
+
+
+def test_slo_validation_and_gauges_and_report(tmp_path):
+    with pytest.raises(ValueError):
+        SloTracker(target=1.0)
+    with pytest.raises(ValueError):
+        SloTracker(target=0.99, fast_window_s=60.0, slow_window_s=1.0)
+
+    log = str(tmp_path / "slo.jsonl")
+    with obs_trace.run_scope(_params(metrics=True, log_path=log)):
+        slo = SloTracker(target=0.95)
+        slo.record(True)
+        slo.record(False)
+        snap = obs_metrics.snapshot()
+    assert snap["counters"]["slo.deadlined"] == 2
+    assert snap["counters"]["slo.violations"] == 1
+    assert snap["gauges"]["slo.target"] == pytest.approx(0.95)
+    assert snap["gauges"]["slo.burn_rate.fast"] == pytest.approx(10.0)
+    assert snap["gauges"]["slo.attainment"] == pytest.approx(0.5)
+
+    from image_analogies_tpu.obs import report as obs_report
+
+    an = json.loads(obs_report.report_json(log))["runs"][0]
+    assert an["slo"]["deadlined"] == 2 and an["slo"]["violations"] == 1
+    assert an["slo"]["target"] == pytest.approx(0.95)
+    assert an["slo"]["attainment"] == pytest.approx(0.5)
+    assert "slo:" in obs_report.report(log)
+
+
+def test_serve_records_slo_outcomes():
+    """Worker path feeds the tracker: met deadlines count, undeadlined
+    traffic does not."""
+    from image_analogies_tpu.serve import Server
+
+    a, ap, b = make_pair(10, 10, seed=33)
+    with Server(_serve_cfg()) as srv:
+        assert srv.request(a, ap, b, timeout=120).status == "ok"  # no dl
+        assert srv.request(a, ap, b, deadline_s=60.0,
+                           timeout=120).status == "ok"
+        s = srv.slo.snapshot()
+    assert s["deadlined"] == 1          # only the deadlined request
+    assert s["violations"] == 0
+
+
+# ------------------------------------------------ ia metrics CLI
+
+
+def test_cli_metrics_renders_log_snapshot(tmp_path, capsys):
+    from image_analogies_tpu import cli
+
+    log = str(tmp_path / "run.jsonl")
+    with obs_trace.run_scope(_params(metrics=True, log_path=log)):
+        obs_metrics.inc("serve.accepted", 4)
+        obs_metrics.observe("serve.latency_ms", 12.0)
+    assert cli.main(["metrics", log]) == 0
+    out = capsys.readouterr().out
+    assert "ia_serve_accepted_total 4" in out
+    assert 'ia_serve_latency_ms_bucket{le="+Inf"} 1' in out
+    # missing log -> usage error, no traceback
+    assert cli.main(["metrics", str(tmp_path / "absent.jsonl")]) == 2
+
+
+def test_metrics_sidecar_server_rereads_log(tmp_path):
+    log = str(tmp_path / "run.jsonl")
+    with obs_trace.run_scope(_params(metrics=True, log_path=log)):
+        obs_metrics.inc("runs.count", 1)
+    httpd = obs_live.start_http_server(
+        0, snapshot_fn=lambda: obs_live.snapshot_from_log(log),
+        health_fn=lambda: obs_live.health_from_log(log))
+    try:
+        port = httpd.server_address[1]
+        url = f"http://127.0.0.1:{port}"
+        text = urllib.request.urlopen(f"{url}/metrics").read().decode()
+        assert "ia_runs_count_total 1" in text
+        hz = json.load(urllib.request.urlopen(f"{url}/healthz"))
+        assert hz["runs"] == 1 and hz["last_run_complete"] is True
+        # a second run appends to the log; the next scrape sees it
+        with obs_trace.run_scope(_params(metrics=True, log_path=log)):
+            obs_metrics.inc("runs.count", 2)
+        text = urllib.request.urlopen(f"{url}/metrics").read().decode()
+        assert "ia_runs_count_total 2" in text
+        assert json.load(urllib.request.urlopen(
+            f"{url}/healthz"))["runs"] == 2
+    finally:
+        obs_live.stop_http_server(httpd)
+
+
+# ------------------------------------------------ bench sentry
+
+
+def test_bench_check_real_trajectory_passes_and_injected_fails(capsys):
+    """Acceptance + tier-1 smoke: the sentry parses every BENCH_r*.json
+    in the repo (no problems), passes the real trajectory, and fails an
+    injected synthetic regression."""
+    from image_analogies_tpu import cli
+
+    assert cli.main(["bench", "--check", "--dry-run"]) == 0
+    verdict = json.loads(capsys.readouterr().out)
+    assert verdict["ok"] is True
+    assert verdict["problems"] == []    # the archive formats still parse
+    assert verdict["points"] >= 5
+
+    # injected regression: way past the floor -> exit 1
+    bad = (verdict.get("floor") or verdict["candidate"]) * 10
+    assert cli.main(["bench", "--check", "--value", str(bad)]) == 1
+    assert json.loads(capsys.readouterr().out)["ok"] is False
+
+
+def test_bench_sentry_groups_by_metric_key(tmp_path):
+    """r01 measured 256^2, later rounds 1024^2 — points only gate
+    against same-metric history (a config switch is not a regression)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_probe", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    def doc(value, metric):
+        return {"parsed": {"value": value, "metric": metric}, "tail": ""}
+
+    (tmp_path / "BENCH_r01.json").write_text(
+        json.dumps(doc(1.0, "256x256 oil config")))
+    (tmp_path / "BENCH_r02.json").write_text(
+        json.dumps(doc(20.0, "1024x1024 north star")))
+    (tmp_path / "BENCH_r03.json").write_text(
+        json.dumps(doc(15.0, "1024x1024 north star")))
+    traj = bench.load_trajectory(str(tmp_path))
+    # latest (15.0, 1024^2) gates only vs 20.0, never vs r01's 1.0
+    verdict = bench.check_regression(traj)
+    assert verdict["ok"] is True and verdict["floor"] == 20.0
+    # truncated-tail regex fallback still yields a point
+    (tmp_path / "BENCH_r04.json").write_text(json.dumps({
+        "parsed": None,
+        "tail": 'garbage {"north_star_1024_seed7": {"tpu_s": 14.5, '}))
+    traj = bench.load_trajectory(str(tmp_path))
+    assert traj["points"][-1] == {"value": 14.5, "metric_key": "1024x1024",
+                                  "source": "tail_regex", "round": 4,
+                                  "file": "BENCH_r04.json"}
+    # fresh value gates against the min of same-metric points
+    assert bench.check_regression(traj, fresh_value=30.0)["ok"] is False
+    assert bench.check_regression(traj, fresh_value=14.0)["ok"] is True
+
+
+# ------------------------------------------------ grep locks
+
+
+def test_live_and_slo_modules_are_jax_free():
+    """Satellite lock: the telemetry plane must import (and serve
+    scrapes) on any host without pulling jax — no module-scope jax
+    import, no direct jit/pjit/pmap calls."""
+    import image_analogies_tpu.obs as obs_pkg
+
+    root = os.path.dirname(obs_pkg.__file__)
+    forbidden = re.compile(r"\bjax\.jit\s*\(|\bpjit\s*\(|\bjax\.pmap\s*\(")
+    toplevel_jax = re.compile(r"^(import jax|from jax)", re.MULTILINE)
+    for name in ("live.py", "slo.py", "metrics.py"):
+        with open(os.path.join(root, name)) as f:
+            src = f.read()
+        assert not forbidden.findall(src), f"obs/{name} calls jit/pjit"
+        assert not toplevel_jax.findall(src), (
+            f"obs/{name} imports jax at module scope")

@@ -427,8 +427,9 @@ def test_breaker_threshold_zero_disabled():
 
 def test_breaker_trips_server_and_recovers():
     """End-to-end: consecutive dispatch failures trip the breaker, later
-    requests fail fast with Rejected("circuit_open") — no retry burn —
-    and a successful probe after the cooldown closes it again."""
+    submits are shed at ADMISSION with Rejected("breaker_open") — one hop
+    before the queue, no retry burn — and a successful probe after the
+    cooldown closes it again."""
     from image_analogies_tpu.utils import failure
 
     a, ap, b = make_pair(10, 10, seed=20)
@@ -444,13 +445,44 @@ def test_breaker_trips_server_and_recovers():
         t0 = time.monotonic()
         with pytest.raises(Rejected) as ei:
             srv.request(a, ap, b, timeout=60)
-        assert ei.value.reason == "circuit_open"
-        assert time.monotonic() - t0 < 5.0  # fast fail, not a dispatch
+        assert ei.value.reason == "breaker_open"
+        assert time.monotonic() - t0 < 5.0  # shed at submit, no dispatch
+        assert srv.queue_depth == 0         # never entered the queue
         # elapse the cooldown without sleeping 30s (white-box nudge)
         srv._pool.breaker._opened_at -= 60.0
         resp = srv.request(a, ap, b, timeout=120)  # the half-open probe
         assert resp.status == "ok"
         assert srv._pool.breaker.state == "closed"
+
+
+def test_breaker_circuit_open_still_reachable_at_dispatch():
+    """An ACCEPTED request whose breaker trips between admission and
+    dispatch still gets the dispatch-layer Rejected("circuit_open") —
+    admission shedding did not remove the inner containment layer."""
+    a, ap, b = make_pair(10, 10, seed=22)
+    cfg = _cfg(workers=1, max_batch=1, batch_window_ms=0.0,
+               request_retries=0, breaker_threshold=1,
+               breaker_cooldown_s=300.0)
+    srv = Server(cfg)
+    # Gate the worker loop so the request sits in the queue while we
+    # trip the breaker underneath it.
+    gate = threading.Event()
+    orig_pop = srv._queue.pop_batch
+
+    def gated_pop(*a_, **kw):
+        batch = orig_pop(*a_, **kw)
+        gate.wait(timeout=30)
+        return batch
+
+    srv._queue.pop_batch = gated_pop
+    with srv:
+        fut = srv.submit(a, ap, b)       # admitted while closed
+        srv._pool.breaker.record_failure()  # threshold=1 -> open
+        assert srv._pool.breaker.state == "open"
+        gate.set()                        # worker proceeds to dispatch
+        with pytest.raises(Rejected) as ei:
+            fut.result(timeout=60)
+        assert ei.value.reason == "circuit_open"
 
 
 # ----------------------------------------------- crash containment
