@@ -25,6 +25,7 @@ No module here imports jax — the plane is pure host-side control flow;
 sites are data-driven (grep-locked in tests/test_chaos.py).
 """
 
+from image_analogies_tpu.chaos.faults import ProcessDeath  # noqa: F401
 from image_analogies_tpu.chaos.inject import (  # noqa: F401
     arm,
     armed,
@@ -37,4 +38,5 @@ from image_analogies_tpu.chaos.inject import (  # noqa: F401
 )
 from image_analogies_tpu.chaos.plan import ChaosPlan, SiteRule  # noqa: F401
 
-FAULT_KINDS = ("transient", "oom", "latency", "corrupt", "crash")
+FAULT_KINDS = ("transient", "oom", "latency", "corrupt", "crash",
+               "process_death")

@@ -61,14 +61,20 @@ def make_serve_load(n: int, size: Tuple[int, int] = (12, 12), seed: int = 7
 
 def serve_config(*, workers: int = 2, max_batch: int = 4,
                  crash_requeues: int = 1, breaker_threshold: int = 5,
-                 deadline_ordering: bool = True):
-    """Small CPU serve config for serve drills."""
+                 deadline_ordering: bool = True,
+                 batch_window_ms: float = 2.0,
+                 journal_dir: Optional[str] = None):
+    """Small CPU serve config for serve drills.
+
+    ``journal_dir`` arms the write-ahead journal (kill-restart drill);
+    drill journals skip fsync — the drill restarts in-process, so
+    OS-buffer durability is enough and the selftest stays fast."""
     from image_analogies_tpu.serve.types import ServeConfig
 
     return ServeConfig(
         params=image_params(levels=1, retries=0),
         queue_depth=64,
-        batch_window_ms=2.0,
+        batch_window_ms=batch_window_ms,
         max_batch=max_batch,
         workers=workers,
         request_retries=2,
@@ -76,4 +82,6 @@ def serve_config(*, workers: int = 2, max_batch: int = 4,
         breaker_threshold=breaker_threshold,
         deadline_ordering=deadline_ordering,
         drain_timeout_s=60.0,
+        journal_dir=journal_dir,
+        journal_fsync=False,
     )

@@ -53,6 +53,19 @@ class WorkerCrash(RuntimeError):
     """Injected worker-thread crash: non-transient on purpose."""
 
 
+class ProcessDeath(BaseException):
+    """Injected process death — the whole process is gone, mid-write.
+
+    Deliberately derives from ``BaseException`` AND is excluded from the
+    serve worker's crash containment: a dead process cannot requeue its
+    batch, resolve futures, or append a journal line.  In-process drills
+    model death by letting this escape the worker thread (it exits
+    silently, futures unresolved) and then tearing the server down
+    non-gracefully; the write-ahead journal replay on restart is the only
+    recovery path, which is exactly what the kill-restart drill verifies.
+    """
+
+
 def oom_error(site: str, visit: int) -> XlaRuntimeError:
     return XlaRuntimeError(
         f"RESOURCE_EXHAUSTED: chaos oom at {site} (visit {visit}): "

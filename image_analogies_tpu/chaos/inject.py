@@ -30,6 +30,7 @@ fault lands on.
 from __future__ import annotations
 
 import contextlib
+import math
 import random
 import threading
 import time
@@ -103,6 +104,30 @@ def plan_seed() -> Optional[int]:
     return plan.seed if plan is not None else None
 
 
+# z-score of the 99th percentile of the standard normal: with
+# sigma = ln(p99/p50) / Z99, lognormal(ln(p50), sigma) has exactly the
+# requested median and 99th percentile.
+_Z99 = 2.3263478740408408
+
+
+def _latency_s(name: str, rule: SiteRule) -> float:
+    """Sleep length for a firing latency rule, in seconds.
+
+    Fixed ``latency_ms`` by default; when the rule carries a lognormal
+    spec (p50/p99 both set) the delay is drawn from the site's seeded
+    stream — deterministic per (plan seed, site, visit sequence), so a
+    replayed drill sleeps the same tail."""
+    if not rule.latency_p50_ms:
+        return rule.latency_ms / 1e3
+    sigma = math.log(rule.latency_p99_ms / rule.latency_p50_ms) / _Z99
+    with _LOCK:
+        st = _STATE.get(name)
+        if st is None:
+            return rule.latency_ms / 1e3
+        return st["rng"].lognormvariate(
+            math.log(rule.latency_p50_ms), sigma) / 1e3
+
+
 def _decide(name: str, rule: SiteRule) -> Optional[int]:
     """Take one visit at ``name``; returns the visit index when the rule
     fires, else None.  Single lock section: counter bump + draw."""
@@ -158,7 +183,7 @@ def site(name: str, **ctx: Any) -> Optional[str]:
     if rule.kind == "oom":
         raise _faults.oom_error(name, visit)
     if rule.kind == "latency":
-        time.sleep(rule.latency_ms / 1e3)
+        time.sleep(_latency_s(name, rule))
         if rule.hang:
             # the wedged op never completes: by the time this raise
             # unwinds, a watchdogged caller has already timed out and
@@ -169,4 +194,7 @@ def site(name: str, **ctx: Any) -> Optional[str]:
     if rule.kind == "crash":
         raise _faults.WorkerCrash(
             f"chaos worker crash at {name} (visit {visit})")
+    if rule.kind == "process_death":
+        raise _faults.ProcessDeath(
+            f"chaos process death at {name} (visit {visit})")
     return rule.kind  # "corrupt": directive for the call site

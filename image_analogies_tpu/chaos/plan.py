@@ -27,19 +27,28 @@ import dataclasses
 import json
 from typing import Any, Dict, Optional, Tuple
 
-_KINDS = ("transient", "oom", "latency", "corrupt", "crash")
+_KINDS = ("transient", "oom", "latency", "corrupt", "crash",
+          "process_death")
 
 
 @dataclasses.dataclass(frozen=True)
 class SiteRule:
     """One site's fault behavior.
 
-    ``kind``       one of transient | oom | latency | corrupt | crash.
+    ``kind``       one of transient | oom | latency | corrupt | crash |
+                   process_death.
     ``p``          per-visit fault probability (ignored when ``schedule``
                    is given).
     ``schedule``   explicit 0-based call indices that fault.
     ``max_faults`` total injection cap for the site (0 = unlimited).
-    ``latency_ms`` sleep length for the latency kind.
+    ``latency_ms`` sleep length for the latency kind (fixed delay).
+    ``latency_p50_ms`` / ``latency_p99_ms``
+                   latency only: when both are set (> 0) the sleep is
+                   drawn from a lognormal with that median and 99th
+                   percentile instead of the fixed ``latency_ms`` —
+                   realistic tail-latency drills.  Draws come from the
+                   per-``(seed, site)`` stream, so the same plan always
+                   produces the same delays.
     ``hang``       latency only: after the sleep, raise instead of
                    resuming — models a wedged op that never completes
                    (the watchdog drill's fault; a plain sleep models a
@@ -51,6 +60,8 @@ class SiteRule:
     schedule: Tuple[int, ...] = ()
     max_faults: int = 0
     latency_ms: float = 50.0
+    latency_p50_ms: float = 0.0
+    latency_p99_ms: float = 0.0
     hang: bool = False
 
     def __post_init__(self):
@@ -61,6 +72,13 @@ class SiteRule:
             raise ValueError(f"p must be in [0, 1], got {self.p}")
         if self.max_faults < 0 or self.latency_ms < 0:
             raise ValueError("max_faults/latency_ms must be >= 0")
+        if self.latency_p50_ms < 0 or self.latency_p99_ms < 0:
+            raise ValueError("latency percentiles must be >= 0")
+        if bool(self.latency_p50_ms) != bool(self.latency_p99_ms):
+            raise ValueError(
+                "latency_p50_ms and latency_p99_ms must be set together")
+        if self.latency_p50_ms and self.latency_p99_ms < self.latency_p50_ms:
+            raise ValueError("latency_p99_ms must be >= latency_p50_ms")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -88,6 +106,8 @@ class ChaosPlan:
                        if not (k == "p" and not v)
                        and not (k == "schedule" and not v)
                        and not (k == "max_faults" and not v)
+                       and not (k == "latency_p50_ms" and not v)
+                       and not (k == "latency_p99_ms" and not v)
                        and not (k == "hang" and not v)}
                 for name, rule in self.sites
             },

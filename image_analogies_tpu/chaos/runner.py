@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import os
 import tempfile
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -39,6 +40,8 @@ _KIND_NOTES = {
     "latency": "watchdog converts a wedged dispatch into a retry",
     "corrupt": "checksum catches damaged checkpoint; quarantine+recompute",
     "crash": "worker crash containment requeues the batch",
+    "process_death": "journal replay answers every admitted request "
+                     "exactly once after kill+restart",
 }
 
 
@@ -49,12 +52,28 @@ def plan_for_kind(kind: str, seed: int = 0) -> ChaosPlan:
     elif kind == "oom":
         sites = (("level.dispatch", SiteRule(kind="oom", schedule=(1,))),)
     elif kind == "latency":
+        # 2s hang vs the drill's 0.5s watchdog: the margin must be wide
+        # in BOTH directions — the hang well above the watchdog so it
+        # always trips, and the watchdog well above a legitimate tiny
+        # dispatch so a loaded CI box can't trip it spuriously (a
+        # spurious timeout exhausts the retry budget and flakes the
+        # drill; seen at 200ms/50ms).
         sites = (("level.dispatch", SiteRule(kind="latency", schedule=(0,),
-                                             latency_ms=200.0, hang=True)),)
+                                             latency_ms=2000.0, hang=True)),)
     elif kind == "corrupt":
         sites = (("ckpt.save", SiteRule(kind="corrupt", schedule=(0,))),)
     elif kind == "crash":
         sites = (("serve.dispatch", SiteRule(kind="crash", schedule=(0,))),)
+    elif kind == "process_death":
+        # Kill-restart drill geometry (one worker, max_batch == n == 4,
+        # WAL-before-queue): journal visits 0..3 are the four admits,
+        # then the worker alternates dispatched/done appends — 4=disp r0,
+        # 5=done r0, 6=disp r1, 7=done r1.  Dying at visit 7 leaves one
+        # request fully done (dedupe path), one computed but UNRECORDED
+        # mid-done (the exactly-once edge: replay must re-run it to the
+        # same bytes), and two admitted-only (plain replay).
+        sites = (("serve.journal", SiteRule(kind="process_death",
+                                            schedule=(7,))),)
     else:
         raise ValueError(f"unknown fault kind {kind!r}")
     return ChaosPlan(seed=seed, sites=sites, name=f"selftest-{kind}")
@@ -93,14 +112,18 @@ def _reconcile(plan: ChaosPlan, counters: Dict[str, float]) -> List[str]:
     # load as a quarantine; a crash as a contained worker crash.  A
     # raising kind at a serve batch boundary is contained as a crash
     # regardless of its class — the containment layer can't tell.
-    retries = watchdogs = quarantines = crashes = 0.0
+    retries = watchdogs = quarantines = crashes = deaths = 0.0
     for name, rule in plan.sites:
         n = counters.get(f"chaos.site.{name}", 0)
         if not n:
             continue
         if name == "serve.admit":
             continue  # surfaces synchronously to the client; no recovery
-        if name in ("serve.dispatch",) and rule.kind in (
+        if rule.kind == "process_death":
+            # not contained: the worker thread dies; the only matching
+            # evidence is the death counter (recovery is the journal's)
+            deaths += n
+        elif name in ("serve.dispatch",) and rule.kind in (
                 "transient", "oom", "crash"):
             crashes += n
         elif rule.kind in ("transient", "oom"):
@@ -120,6 +143,8 @@ def _reconcile(plan: ChaosPlan, counters: Dict[str, float]) -> List[str]:
         want("ckpt.quarantined", quarantines)
     if crashes:
         want("serve.worker_crashes", crashes)
+    if deaths:
+        want("serve.process_deaths", deaths)
     return problems
 
 
@@ -143,7 +168,8 @@ def drill_image(plan: ChaosPlan, *, seed: int = 7,
             checkpoint_dir=os.path.join(tmp, "ckpt"),
             # a hang only recovers when something bounds the wait; give
             # the watchdog a deadline well under the injected latency
-            dispatch_timeout_s=0.05 if hanging else 0.0)
+            # but far above an honest dispatch (see plan_for_kind)
+            dispatch_timeout_s=0.5 if hanging else 0.0)
         with obs_trace.run_scope(params) as ctx:
             with inject.plan_scope(plan):
                 chaos_bp = drills.run_image(a, ap, b, params)
@@ -259,8 +285,126 @@ def drill_serve(plan: ChaosPlan, *, n: int = 6, seed: int = 7
     }
 
 
+def drill_kill_restart(plan: ChaosPlan, *, n: int = 4, seed: int = 7
+                       ) -> Dict[str, Any]:
+    """Process-death drill: a journaled single-worker server takes a full
+    batch; the injected :class:`~chaos.faults.ProcessDeath` kills the
+    worker mid-journal-append; the server is torn down NON-gracefully
+    (queued and in-flight clients dropped, exactly as a real death drops
+    them); a second server on the same journal replays.  Invariants:
+    every admitted request is answered exactly once — pre-death responses
+    and post-restart resubmissions alike bit-identical to direct engine
+    runs — and the journal/replay counters reconcile."""
+    from image_analogies_tpu.obs import trace as obs_trace
+    from image_analogies_tpu.serve.server import Server
+
+    with tempfile.TemporaryDirectory() as tmp:
+        jdir = os.path.join(tmp, "journal")
+        # Wide batch window in incarnation 1: the worker must coalesce
+        # ALL n submits into one batch for the plan's visit schedule to
+        # mean what the geometry comment in plan_for_kind says it means.
+        cfg = drills.serve_config(workers=1, max_batch=n,
+                                  batch_window_ms=2000.0, journal_dir=jdir)
+        # Restart pops a < max_batch replay batch; a small window keeps
+        # the drill from idling out the full coalescing wait.
+        cfg2 = drills.serve_config(workers=1, max_batch=n,
+                                   batch_window_ms=50.0, journal_dir=jdir)
+        load = drills.make_serve_load(n, seed=seed)
+        baseline = {item["index"]: drills.run_image(
+            item["a"], item["ap"], item["b"], cfg.params)
+            for item in load}
+        ikey = "kill-restart-{}".format
+
+        problems: List[str] = []
+        with obs_trace.run_scope(cfg.params) as ctx:
+            # -- incarnation 1: full batch, death mid-append ------------
+            inject.arm(plan)
+            try:
+                srv = Server(cfg).start()
+                futures = {}
+                for item in load:
+                    futures[item["index"]] = srv.submit(
+                        item["a"], item["ap"], item["b"],
+                        idempotency_key=ikey(item["index"]))
+                end = time.monotonic() + 60.0
+                while (inject.injected_total() < 1
+                       and time.monotonic() < end):
+                    time.sleep(0.01)
+                srv.kill()
+                snap = inject.snapshot()
+            finally:
+                inject.disarm()
+            pre_done = {i: f.result(timeout=0) for i, f in futures.items()
+                        if f.done() and f.exception() is None}
+            unresolved = [i for i, f in futures.items() if not f.done()]
+            if not pre_done:
+                problems.append("no request finished before the death")
+            if not unresolved:
+                problems.append("death left nothing unresolved (dead drill)")
+
+            # -- incarnation 2: same journal, disarmed replay -----------
+            srv2 = Server(cfg2).start()
+            stats = dict(srv2.recovery_stats or {})
+            recovered = srv2.wait_recovered(timeout=120)
+            # resubmit EVERY original request under its original key:
+            # each must dedupe against the journal's recorded response
+            replies = {}
+            for item in load:
+                replies[item["index"]] = srv2.submit(
+                    item["a"], item["ap"], item["b"],
+                    idempotency_key=ikey(item["index"])).result(timeout=120)
+            srv2.shutdown()
+            counters = _counters(ctx)
+
+        bad = {k: v for k, v in recovered.items() if v != "ok"}
+        if bad:
+            problems.append(f"replayed work did not finish ok: {bad}")
+        if stats.get("replayed", 0) != len(unresolved):
+            problems.append(
+                f"replayed {stats.get('replayed', 0)} entries "
+                f"!= {len(unresolved)} unresolved at death")
+        identical = all(
+            np.array_equal(replies[i].bp, baseline[i]) for i in replies)
+        identical = identical and all(
+            np.array_equal(resp.bp, baseline[i])
+            for i, resp in pre_done.items())
+        if not identical:
+            problems.append("recovered output differs from clean run")
+        # exactly-once ledger: one done record per request, every
+        # resubmission answered from it, no request re-admitted
+        for name, expect in (("serve.journal.done", n),
+                             ("serve.journal.deduped", n),
+                             ("serve.journal.admitted", n)):
+            got = counters.get(name, 0)
+            if got != expect:
+                problems.append(f"{name}={got} != expected {expect}")
+        problems += _reconcile(plan, counters)
+        injected = sum(st["injected"] for st in snap.values())
+        if injected == 0:
+            problems.append("plan injected nothing (dead drill)")
+        return {
+            "workload": "kill_restart",
+            "plan": plan.to_dict(),
+            "injected": injected,
+            "sites": snap,
+            "recovery": stats,
+            "outcomes": {
+                "pre_death_ok": len(pre_done),
+                "replayed": stats.get("replayed", 0),
+                "deduped": int(counters.get("serve.journal.deduped", 0)),
+            },
+            "counters": {k: v for k, v in counters.items()
+                         if k.startswith(("chaos.", "serve."))},
+            "identical": identical,
+            "ok": not problems,
+            "problems": problems,
+        }
+
+
 def run_drill(plan: ChaosPlan, **kw) -> Dict[str, Any]:
     """Dispatch a plan to the workload its sites target."""
+    if any(name == "serve.journal" for name, _ in plan.sites):
+        return drill_kill_restart(plan, **kw)
     if _wants_serve(plan):
         return drill_serve(plan, **kw)
     return drill_image(plan, **kw)

@@ -391,6 +391,7 @@ def cmd_serve(args) -> int:
         slo_target=args.slo_target,
         slo_fast_window_s=args.slo_fast_window_s,
         slo_slow_window_s=args.slo_slow_window_s,
+        journal_dir=args.journal,
     )
 
     if args.selftest is not None:
@@ -455,6 +456,48 @@ def cmd_chaos(args) -> int:
         print(json.dumps(result, sort_keys=True, default=str),
               file=sys.stderr)
     return 0 if result["ok"] else 1
+
+
+def cmd_journal(args) -> int:
+    """Write-ahead journal tooling (serve/journal.py).  ``inspect`` is a
+    read-only summary of a journal directory — segments, per-state
+    request counts, incomplete and poisoned keys; ``compact`` rewrites
+    it to its minimal equivalent (final state per key, finished input
+    spills dropped, response spills kept for dedupe)."""
+    from image_analogies_tpu.serve.journal import RequestJournal
+
+    if not os.path.isdir(args.dir):
+        print(f"journal: no such directory {args.dir}", file=sys.stderr)
+        return 2
+    jr = RequestJournal(args.dir)
+    if args.action == "inspect":
+        info = jr.inspect()
+        if args.json:
+            print(json.dumps(info, indent=2, sort_keys=True))
+        else:
+            print(f"journal {info['path']}: {info['requests']} requests "
+                  f"in {info['segments']} segment(s), {info['lines']} lines"
+                  + (f", {info['corrupt_segments']} quarantined file(s)"
+                     if info["corrupt_segments"] else ""))
+            for st, n in sorted(info["states"].items()):
+                print(f"  {st:<12} {n}")
+            if info["incomplete"]:
+                print(f"  incomplete   {', '.join(info['incomplete'])}")
+            if info["poisoned"]:
+                print(f"  poisoned     {', '.join(info['poisoned'])}")
+        return 0
+    if args.action == "compact":
+        out = jr.compact()
+        if args.json:
+            print(json.dumps(out, indent=2, sort_keys=True))
+        else:
+            print(f"compacted {args.dir}: {out['segments']} segment(s) / "
+                  f"{out['lines']} lines -> 1 segment / "
+                  f"{out['after']['lines']} lines "
+                  f"({out['dropped_lines']} dropped)")
+        return 0
+    print(f"journal: unknown action {args.action}", file=sys.stderr)
+    return 2
 
 
 def cmd_metrics(args) -> int:
@@ -767,6 +810,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="fast (paging) burn-rate window seconds")
     sv.add_argument("--slo-slow-window-s", type=float, default=600.0,
                     help="slow (ticket) burn-rate window seconds")
+    sv.add_argument("--journal", default=None, metavar="DIR",
+                    help="write-ahead request journal directory: every "
+                         "request is recorded at admit and on each state "
+                         "transition; on startup the server replays it — "
+                         "finished requests dedupe exactly-once, "
+                         "interrupted ones re-enqueue, poison ones shed "
+                         "(omit to disable; disabled costs nothing)")
     sv.add_argument("--seed", type=int, default=0)
     _add_engine_flags(sv)
     sv.set_defaults(fn=cmd_serve)
@@ -782,8 +832,9 @@ def build_parser() -> argparse.ArgumentParser:
                          "to replay against the matching drill workload")
     ch.add_argument("--selftest", action="store_true",
                     help="one canonical drill per fault kind "
-                         "(transient, oom, latency, corrupt, crash) plus "
-                         "the same-seed schedule-determinism check")
+                         "(transient, oom, latency, corrupt, crash, "
+                         "process_death) plus the same-seed "
+                         "schedule-determinism check")
     ch.add_argument("--kinds", default=None,
                     help="comma-separated fault-kind subset for "
                          "--selftest (default: all)")
@@ -793,6 +844,20 @@ def build_parser() -> argparse.ArgumentParser:
                     help="also print the full machine-readable report "
                          "to stderr")
     ch.set_defaults(fn=cmd_chaos)
+
+    jr = sub.add_parser("journal",
+                        help="write-ahead request journal tooling: "
+                             "inspect a journal directory or compact it "
+                             "to its minimal equivalent")
+    jr.add_argument("action", choices=("inspect", "compact"),
+                    help="inspect: read-only per-state summary; compact: "
+                         "rewrite to one segment of final states "
+                         "(finished input spills dropped, response "
+                         "spills kept for dedupe)")
+    jr.add_argument("dir", help="journal directory (ia serve --journal)")
+    jr.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    jr.set_defaults(fn=cmd_journal)
 
     wu = sub.add_parser("warmup",
                         help="AOT-compile jit signatures for a target "

@@ -70,8 +70,22 @@ def _classify(rec: Dict[str, Any]) -> Tuple[str, int, str, Optional[float]]:
         # joined by the shared `request` id in args.
         verb = "admit" if ev == "serve_admit" else "degrade"
         return "i", SERVE_TID, f"{verb} r{rec.get('request', '?')}", None
-    if ev in ("chaos_inject", "ckpt_quarantined", "watchdog_timeout",
-              "retry_exhausted", "serve_worker_crash", "breaker_open",
+    if ev in ("serve_replay", "serve_recovery", "serve_dedupe"):
+        # durability-plane instants on the serve track: journal replay
+        # actions, the recovery summary, and dedupe short-circuits sit
+        # next to the request intervals they stand in for
+        if ev == "serve_replay":
+            name = f"replay {rec.get('action', '?')} {rec.get('idem', '?')}"
+        elif ev == "serve_dedupe":
+            name = f"dedupe {rec.get('idem', '?')}"
+        else:
+            name = (f"recovery replayed={rec.get('replayed', 0)} "
+                    f"done={rec.get('done', 0)}")
+        return "i", SERVE_TID, name, None
+    if ev in ("chaos_inject", "ckpt_quarantined", "journal_quarantined",
+              "watchdog_timeout",
+              "retry_exhausted", "serve_worker_crash", "serve_process_death",
+              "breaker_open",
               "breaker_half_open", "breaker_closed"):
         # fault-plane instants on their own track: injections line up
         # visually against the retries/quarantines/crashes they caused

@@ -256,6 +256,28 @@ def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             },
         }
 
+    # --- durability section (serve.journal.* counters + recovery records) -
+    recoveries = [r for r in records if r.get("event") == "serve_recovery"]
+    journal_info: Optional[Dict[str, Any]] = None
+    if recoveries or any(k.startswith("serve.journal.") for k in counters):
+        journal_info = {
+            "admitted": int(counters.get("serve.journal.admitted", 0)),
+            "dispatched": int(counters.get("serve.journal.dispatched", 0)),
+            "done": int(counters.get("serve.journal.done", 0)),
+            "rejected": int(counters.get("serve.journal.rejected", 0)),
+            "poisoned": int(counters.get("serve.journal.poisoned", 0)),
+            "replayed": int(counters.get("serve.journal.replayed", 0)),
+            "deduped": int(counters.get("serve.journal.deduped", 0)),
+            "quarantined": int(counters.get("serve.journal.quarantined", 0)),
+            "poison_sheds": int(counters.get("serve.poisoned", 0)),
+            "process_deaths": int(counters.get("serve.process_deaths", 0)),
+            # each restart's replay summary, in order
+            "recoveries": [{k: r[k] for k in
+                            ("entries", "replayed", "poisoned", "done",
+                             "unrecoverable", "quarantined") if k in r}
+                           for r in recoveries],
+        }
+
     # --- per-device HBM peaks (run_end gauges + streamed hbm records) -----
     gauges: Dict[str, float] = {}
     if run_end:
@@ -299,6 +321,7 @@ def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "tune": tune_info,
         "serve": serve_info,
         "slo": slo_info,
+        "journal": journal_info,
         "chaos": chaos_info,
         "hbm": hbm or None,
         "spans": spans,
@@ -442,6 +465,28 @@ def render(an: Dict[str, Any], run_id: Optional[str] = None) -> str:
             w(f"    burn rate     fast {bf if bf is not None else '-'} / "
               f"slow {bs if bs is not None else '-'}  "
               "(1.0 = exactly on budget)")
+
+    jn = an.get("journal")
+    if jn:
+        w("  durability:")
+        w(f"    journal       {jn['admitted']} admitted -> "
+          f"{jn['done']} done, {jn['rejected']} rejected, "
+          f"{jn['poisoned']} poisoned "
+          f"({jn['dispatched']} dispatch attempts)")
+        w(f"    exactly-once  {jn['deduped']} duplicate submissions "
+          f"answered from the journal, {jn['poison_sheds']} poison sheds")
+        if (jn["replayed"] or jn["process_deaths"] or jn["quarantined"]
+                or jn["recoveries"]):
+            w(f"    recovery      {jn['replayed']} replayed across "
+              f"{len(jn['recoveries'])} restart(s), "
+              f"{jn['process_deaths']} process deaths, "
+              f"{jn['quarantined']} journal files quarantined")
+        for i, rcv in enumerate(jn["recoveries"]):
+            w(f"    restart {i:<5} entries={rcv.get('entries', 0)} "
+              f"replayed={rcv.get('replayed', 0)} "
+              f"done={rcv.get('done', 0)} "
+              f"poisoned={rcv.get('poisoned', 0)} "
+              f"unrecoverable={rcv.get('unrecoverable', 0)}")
 
     cha = an.get("chaos")
     if cha:

@@ -12,7 +12,8 @@ API:
   GET  /metrics      -> Prometheus text exposition (obs/live.py) of the
                         server's live metrics registry
   POST /v1/analogy   -> body {"a": [[...]], "ap": [[...]], "b": [[...]],
-                        "deadline_ms": optional float}
+                        "deadline_ms": optional float,
+                        "idempotency_key": optional str (journal dedupe)}
                         reply {"request", "status", "bp", "timings", ...}
 
 Planes are nested JSON lists of floats — fine for a loopback demo
@@ -80,11 +81,14 @@ def _make_handler(server: Server):
                 self._reply(400, {"error": "bad_request", "detail": str(exc)})
                 return
             deadline_ms = req.get("deadline_ms")
+            idem = req.get("idempotency_key")
             try:
-                resp = server.request(
+                resp = server.submit(
                     a, ap, b,
                     deadline_s=None if deadline_ms is None
-                    else float(deadline_ms) / 1e3)
+                    else float(deadline_ms) / 1e3,
+                    idempotency_key=None if idem is None
+                    else str(idem)).result()
             except Rejected as exc:
                 self._reply(429, {"error": "rejected", "reason": exc.reason})
                 return

@@ -1,0 +1,525 @@
+"""Write-ahead request journal — the serving plane's durability log.
+
+Every admitted request is recorded BEFORE it enters the queue, then each
+state transition is appended as it happens:
+
+    admitted -> dispatched -> done(response digest)
+                           -> rejected(reason)
+                           -> poisoned
+
+so a process death at any instant leaves a journal from which
+:meth:`Server.recover` can reconstruct exactly what was owed to whom:
+
+- ``done`` entries short-circuit duplicate submissions with the recorded
+  response (exactly-once from the client's view — the response planes
+  are spilled alongside the log);
+- incomplete entries are re-enqueued in original admit order;
+- entries whose ``dispatched`` count exhausted ``crash_requeues`` are
+  marked ``poisoned`` and permanently shed with ``Rejected("poison")``
+  so a poison request cannot crash the fleet twice.
+
+Format: JSONL *segments* (``segment-%06d.jsonl``) where every line
+carries a ``seal`` — sha256 over the canonical JSON of the rest of the
+record — reusing ``utils/checkpoint.py``'s seal/quarantine pattern: a
+torn tail or flipped bit fails the seal, the valid prefix is kept, and
+the damaged segment is quarantined as ``.corrupt`` (evidence, never
+deleted) instead of poisoning replay.  Appends are fsync'd by default
+(``journal_fsync=False`` trades the sync for speed in tests).
+
+Payload planes are spilled next to the log as checksummed ``.npz``
+(``payloads/<idem>.npz`` inputs, ``payloads/<idem>.resp.npz`` the
+recorded response), so the journal lines stay small and replay can both
+re-run an incomplete request and answer a duplicate of a finished one.
+
+Idempotency key: client-supplied, or ``sha1(batch key x payload
+digest)`` — deterministic across processes, so a client retry after a
+restart dedupes with no client-side cooperation.
+
+Zero-cost when disabled: the server holds ``journal=None`` unless
+``ServeConfig.journal_dir`` is set; no call site touches this module on
+the disabled path (locked by tests/test_journal.py's poisoned-import
+test).  Like the rest of serve/, this module is jax-free (grep-locked).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import threading
+import zipfile
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from image_analogies_tpu import chaos
+from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.obs import metrics as obs_metrics
+from image_analogies_tpu.obs import trace as obs_trace
+from image_analogies_tpu.utils import checkpoint as ckpt
+
+_SEGMENT_FMT = "segment-%06d.jsonl"
+_OPS = ("admitted", "dispatched", "done", "rejected", "poisoned")
+
+
+def idem_key(key_str: str, b: np.ndarray) -> str:
+    """Idempotency key for a request: sha1 over the batch key (params
+    digest x shape buckets x exemplar content) and the target plane's
+    content.  Deterministic across processes — the property that makes a
+    client retry after a server restart dedupe by construction."""
+    b = np.ascontiguousarray(b)
+    h = hashlib.sha1()
+    h.update(key_str.encode())
+    h.update(repr((b.shape, str(b.dtype))).encode())
+    h.update(b.tobytes())
+    return h.hexdigest()[:16]
+
+
+def _seal(record: Dict[str, Any]) -> str:
+    canonical = json.dumps(record, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(canonical.encode()).hexdigest()[:32]
+
+
+def _plane_checksum(*arrays: np.ndarray) -> str:
+    """Same recipe as checkpoint._payload_checksum: shape + dtype + bytes
+    under one sha256, stored inside the npz, checked on load."""
+    h = hashlib.sha256()
+    for arr in arrays:
+        arr = np.ascontiguousarray(arr)
+        h.update(repr((arr.shape, str(arr.dtype))).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()[:32]
+
+
+def response_digest(bp: np.ndarray, bp_y: np.ndarray) -> str:
+    """Content digest of a response's output planes — what the ``done``
+    journal line records, so an operator can audit that a replayed run
+    reproduced the same bytes."""
+    return _plane_checksum(bp, bp_y)
+
+
+@dataclasses.dataclass
+class JournalEntry:
+    """Replay-time view of one idempotency key's transition history."""
+
+    idem: str
+    admit: Dict[str, Any]
+    dispatched: int = 0
+    done: Optional[Dict[str, Any]] = None
+    rejected: Optional[str] = None
+    poisoned: bool = False
+
+    @property
+    def complete(self) -> bool:
+        return self.done is not None or self.rejected is not None \
+            or self.poisoned
+
+
+@dataclasses.dataclass
+class Replay:
+    """Result of :meth:`RequestJournal.replay`."""
+
+    entries: Dict[str, JournalEntry]      # idem -> history
+    order: List[str]                      # idems in original admit order
+    quarantined: int = 0                  # segments moved to .corrupt
+    lines: int = 0                        # valid sealed lines read
+
+    @property
+    def incomplete(self) -> List[JournalEntry]:
+        return [self.entries[i] for i in self.order
+                if not self.entries[i].complete]
+
+
+class RequestJournal:
+    """One directory of sealed JSONL segments + spilled payloads.
+
+    Thread-safe: appends from the admission thread and every worker
+    serialize on one lock (a request journal is an ordering witness —
+    interleaved partial lines would defeat it)."""
+
+    def __init__(self, path: str, fsync: bool = True):
+        self.path = path
+        self.fsync = fsync
+        self._lock = threading.Lock()
+        self._fh = None
+        self._segment = 0
+        # In-memory dedupe state, rebuilt by replay() and kept current by
+        # record_done/record_poisoned during the process lifetime.
+        self._done: Dict[str, Any] = {}       # idem -> Response | None(lazy)
+        self._poisoned: set = set()
+        os.makedirs(self._payload_dir, exist_ok=True)
+
+    # -- paths -------------------------------------------------------------
+
+    @property
+    def _payload_dir(self) -> str:
+        return os.path.join(self.path, "payloads")
+
+    def _segment_path(self, index: int) -> str:
+        return os.path.join(self.path, _SEGMENT_FMT % index)
+
+    def _segments(self) -> List[str]:
+        try:
+            names = sorted(n for n in os.listdir(self.path)
+                           if n.startswith("segment-")
+                           and n.endswith(".jsonl"))
+        except OSError:
+            return []
+        return [os.path.join(self.path, n) for n in names]
+
+    def payload_path(self, idem: str) -> str:
+        return os.path.join(self._payload_dir, f"{idem}.npz")
+
+    def response_path(self, idem: str) -> str:
+        return os.path.join(self._payload_dir, f"{idem}.resp.npz")
+
+    # -- append side -------------------------------------------------------
+
+    def open(self) -> "RequestJournal":
+        """Open a fresh segment for appends (one per server incarnation —
+        a restart never appends into a segment a dead process may have
+        torn)."""
+        with self._lock:
+            if self._fh is not None:
+                return self
+            segs = self._segments()
+            last = int(os.path.basename(segs[-1])[8:-6]) if segs else 0
+            self._segment = last + 1
+            self._fh = open(self._segment_path(self._segment), "a")
+        return self
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                finally:
+                    self._fh = None
+
+    def _append(self, record: Dict[str, Any]) -> None:
+        # The chaos plane's process-death site: a ProcessDeath raised
+        # here models the process dying with this transition unrecorded —
+        # exactly the torn-history case replay must absorb.
+        chaos.site("serve.journal", op=record.get("op", "?"))
+        line = json.dumps({"seal": _seal(record), **record},
+                          sort_keys=True, separators=(",", ":"))
+        with self._lock:
+            if self._fh is None:  # journal closed (shutdown race): drop
+                return
+            self._fh.write(line + "\n")
+            self._fh.flush()
+            if self.fsync:
+                os.fsync(self._fh.fileno())
+        obs_metrics.inc(f"serve.journal.{record['op']}")
+
+    def record_admit(self, idem: str, request_id: int, a: np.ndarray,
+                     ap: np.ndarray, b: np.ndarray, params: AnalogyParams,
+                     deadline_s: Optional[float], key: str) -> None:
+        """WAL step: spill the payload, then the admit line.  Runs BEFORE
+        the queue sees the request — an admitted request with no journal
+        line cannot exist, only the harmless converse."""
+        ppath = self.payload_path(idem)
+        if not os.path.exists(ppath):  # client retries reuse the spill
+            tmp = ppath + ".tmp.npz"
+            np.savez(tmp, a=a, ap=ap, b=b,
+                     params=json.dumps(dataclasses.asdict(params),
+                                       sort_keys=True),
+                     checksum=_plane_checksum(a, ap, b))
+            os.replace(tmp, ppath)
+        self._append({"op": "admitted", "idem": idem, "rid": request_id,
+                      "key": key, "deadline_s": deadline_s})
+
+    def record_dispatched(self, idem: str) -> None:
+        self._append({"op": "dispatched", "idem": idem})
+
+    def record_done(self, idem: str, resp: Any) -> None:
+        """Spill the response, then the done line, then remember it for
+        in-process dedupe.  Callers sequence this BEFORE resolving the
+        client future: once a client can observe an answer, the journal
+        already guarantees every future duplicate gets the same one."""
+        rpath = self.response_path(idem)
+        if not os.path.exists(rpath):
+            tmp = rpath + ".tmp.npz"
+            np.savez(tmp, bp=resp.bp, bp_y=resp.bp_y,
+                     stats=json.dumps(resp.stats, default=str),
+                     degraded=json.dumps(resp.degraded),
+                     request_id=resp.request_id,
+                     checksum=_plane_checksum(resp.bp, resp.bp_y))
+            os.replace(tmp, rpath)
+        self._append({"op": "done", "idem": idem,
+                      "rid": resp.request_id,
+                      "response_digest": response_digest(resp.bp,
+                                                         resp.bp_y)})
+        with self._lock:
+            self._done[idem] = resp
+
+    def record_rejected(self, idem: str, reason: str) -> None:
+        self._append({"op": "rejected", "idem": idem, "reason": reason})
+
+    def record_poisoned(self, idem: str) -> None:
+        self._append({"op": "poisoned", "idem": idem})
+        with self._lock:
+            self._poisoned.add(idem)
+
+    # -- dedupe / poison lookups (request path) ----------------------------
+
+    def is_poisoned(self, idem: str) -> bool:
+        with self._lock:
+            return idem in self._poisoned
+
+    def lookup_done(self, idem: str) -> Optional[Any]:
+        """Recorded Response for a finished key, or None.  A replayed
+        ``done`` is loaded lazily from its spill on first hit; a spill
+        that fails its checksum is quarantined and the key degrades to
+        not-done (the engine is deterministic, so a re-run still answers
+        with the same bytes — exactly-once is preserved)."""
+        with self._lock:
+            if idem not in self._done:
+                return None
+            resp = self._done[idem]
+        if resp is not None:
+            return resp
+        resp = self._load_response(idem)
+        with self._lock:
+            if resp is None:
+                self._done.pop(idem, None)
+            else:
+                self._done[idem] = resp
+        return resp
+
+    def _load_response(self, idem: str) -> Optional[Any]:
+        from image_analogies_tpu.serve.types import Response
+
+        rpath = self.response_path(idem)
+        if not os.path.exists(rpath):
+            return None
+        try:
+            with np.load(rpath) as z:
+                bp = z["bp"].astype(np.float32)
+                bp_y = z["bp_y"].astype(np.float32)
+                want = str(z["checksum"])
+                if want != _plane_checksum(z["bp"], z["bp_y"]):
+                    raise ValueError(
+                        f"response payload checksum mismatch at {rpath}")
+                stats = json.loads(str(z["stats"]))
+                degraded = json.loads(str(z["degraded"]))
+                rid = int(z["request_id"])
+        except (zipfile.BadZipFile, OSError, ValueError, KeyError,
+                EOFError):
+            ckpt.quarantine(rpath, counter="serve.journal.quarantined",
+                            event="journal_quarantined")
+            return None
+        return Response(request_id=rid, bp=bp, bp_y=bp_y, stats=stats,
+                        batch_size=1, queue_ms=0.0, dispatch_ms=0.0,
+                        total_ms=0.0, degraded=degraded)
+
+    def load_payload(self, idem: str):
+        """(a, ap, b, params, deadline_s-less admit payload) for replay,
+        or None when the spill is missing/damaged (quarantined — the
+        request cannot be re-run, only reported)."""
+        ppath = self.payload_path(idem)
+        if not os.path.exists(ppath):
+            return None
+        try:
+            with np.load(ppath) as z:
+                a = z["a"].astype(np.float32)
+                ap = z["ap"].astype(np.float32)
+                b = z["b"].astype(np.float32)
+                want = str(z["checksum"])
+                if want != _plane_checksum(z["a"], z["ap"], z["b"]):
+                    raise ValueError(
+                        f"journal payload checksum mismatch at {ppath}")
+                params = AnalogyParams(**json.loads(str(z["params"])))
+        except (zipfile.BadZipFile, OSError, ValueError, KeyError,
+                EOFError, TypeError):
+            ckpt.quarantine(ppath, counter="serve.journal.quarantined",
+                            event="journal_quarantined")
+            return None
+        return a, ap, b, params
+
+    # -- replay side -------------------------------------------------------
+
+    def _read_segment(self, path: str) -> List[Dict[str, Any]]:
+        """Sealed lines of one segment.  On the first unparseable or
+        seal-failing line the valid prefix is kept, the damaged file is
+        quarantined as ``.corrupt``, and the prefix is rewritten in its
+        place so the next restart replays cleanly (the quarantined bytes
+        stay as evidence, same contract as checkpoint quarantine)."""
+        records: List[Dict[str, Any]] = []
+        good_lines: List[str] = []
+        damaged = False
+        with open(path) as f:
+            for line in f:
+                stripped = line.strip()
+                if not stripped:
+                    continue
+                try:
+                    rec = json.loads(stripped)
+                    seal = rec.pop("seal")
+                    if seal != _seal(rec) or rec.get("op") not in _OPS:
+                        raise ValueError("bad seal")
+                except (json.JSONDecodeError, KeyError, ValueError,
+                        AttributeError, TypeError):
+                    damaged = True
+                    break
+                records.append(rec)
+                good_lines.append(stripped)
+        if damaged:
+            ckpt.quarantine(path, counter="serve.journal.quarantined",
+                            event="journal_quarantined")
+            with open(path + ".tmp", "w") as f:
+                for rec_line in good_lines:
+                    f.write(rec_line + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(path + ".tmp", path)
+        return records
+
+    def replay(self) -> Replay:
+        """Fold every segment's transitions into per-key histories.
+
+        Duplicate transitions are idempotent folds (two ``done`` lines
+        for one key — e.g. a retry that raced a death — count once); the
+        admit ORDER is the original EDF submission order and is what
+        recovery re-enqueues by."""
+        entries: Dict[str, JournalEntry] = {}
+        order: List[str] = []
+        quarantined_before = _corrupt_count(self.path)
+        lines = 0
+        for seg in self._segments():
+            for rec in self._read_segment(seg):
+                lines += 1
+                idem = str(rec.get("idem"))
+                op = rec["op"]
+                if op == "admitted":
+                    if idem not in entries:
+                        entries[idem] = JournalEntry(idem=idem, admit=rec)
+                        order.append(idem)
+                    continue
+                ent = entries.get(idem)
+                if ent is None:
+                    # transition without an admit (its admit line was in
+                    # a torn prefix): synthesize so done/poisoned dedupe
+                    # still works; it can never be re-enqueued (no
+                    # payload reference is trusted without an admit).
+                    ent = JournalEntry(idem=idem, admit={},
+                                       rejected="orphaned")
+                    entries[idem] = ent
+                if op == "dispatched":
+                    ent.dispatched += 1
+                elif op == "done":
+                    ent.done = rec
+                elif op == "rejected":
+                    ent.rejected = str(rec.get("reason", "rejected"))
+                elif op == "poisoned":
+                    ent.poisoned = True
+        with self._lock:
+            for ent in entries.values():
+                if ent.done is not None:
+                    self._done.setdefault(ent.idem, None)  # lazy load
+                if ent.poisoned:
+                    self._poisoned.add(ent.idem)
+        return Replay(entries=entries, order=order,
+                      quarantined=_corrupt_count(self.path)
+                      - quarantined_before,
+                      lines=lines)
+
+    # -- tooling (`ia journal`) --------------------------------------------
+
+    def inspect(self) -> Dict[str, Any]:
+        """Read-only summary for ``ia journal inspect``."""
+        rep = self.replay()
+        states: Dict[str, int] = {}
+        for ent in rep.entries.values():
+            if ent.poisoned:
+                st = "poisoned"
+            elif ent.done is not None:
+                st = "done"
+            elif ent.rejected is not None:
+                st = "rejected"
+            elif ent.dispatched:
+                st = "dispatched"
+            else:
+                st = "admitted"
+            states[st] = states.get(st, 0) + 1
+        return {
+            "path": self.path,
+            "segments": len(self._segments()),
+            "corrupt_segments": _corrupt_count(self.path),
+            "lines": rep.lines,
+            "requests": len(rep.entries),
+            "states": states,
+            "incomplete": [e.idem for e in rep.incomplete],
+            "poisoned": sorted(e.idem for e in rep.entries.values()
+                               if e.poisoned),
+        }
+
+    def compact(self) -> Dict[str, Any]:
+        """Rewrite the journal to its minimal equivalent: one fresh
+        segment holding each key's FINAL state (admit lines only for
+        still-incomplete work), dropping intermediate transitions and the
+        input spills of finished requests.  Response spills are kept —
+        they are what dedupe answers with.  ``.corrupt`` files are never
+        touched."""
+        rep = self.replay()
+        before = {"segments": len(self._segments()), "lines": rep.lines}
+        tmp = os.path.join(self.path, "compact.tmp")
+        kept = 0
+        with open(tmp, "w") as f:
+            def put(rec: Dict[str, Any]) -> None:
+                nonlocal kept
+                f.write(json.dumps({"seal": _seal(rec), **rec},
+                                   sort_keys=True,
+                                   separators=(",", ":")) + "\n")
+                kept += 1
+
+            for idem in rep.order:
+                ent = rep.entries[idem]
+                if not ent.complete:
+                    put(ent.admit)
+                    for _ in range(ent.dispatched):
+                        put({"op": "dispatched", "idem": idem})
+            for idem, ent in sorted(rep.entries.items()):
+                if ent.poisoned:
+                    put({"op": "poisoned", "idem": idem})
+                elif ent.done is not None:
+                    put(ent.done)
+            f.flush()
+            os.fsync(f.fileno())
+        segs = self._segments()
+        last = int(os.path.basename(segs[-1])[8:-6]) if segs else 0
+        os.replace(tmp, self._segment_path(last + 1))
+        for seg in segs:
+            os.remove(seg)
+        for ent in rep.entries.values():
+            if ent.complete:
+                try:
+                    os.remove(self.payload_path(ent.idem))
+                except OSError:
+                    pass
+        return {**before, "after": {"segments": 1, "lines": kept},
+                "dropped_lines": rep.lines - kept}
+
+    def stats(self) -> Dict[str, int]:
+        """Live journal counters (from the active obs registry) — what
+        /healthz and the selftest summary surface."""
+        snap = obs_metrics.snapshot() or {}
+        counters = snap.get("counters", {})
+        return {k.split("serve.journal.", 1)[1]: int(v)
+                for k, v in counters.items()
+                if k.startswith("serve.journal.")}
+
+
+def _corrupt_count(path: str) -> int:
+    try:
+        names = os.listdir(path) + os.listdir(os.path.join(path,
+                                                           "payloads"))
+    except OSError:
+        return 0
+    return sum(1 for n in names if n.endswith(".corrupt"))
+
+
+def emit_replay_record(event: str, **fields: Any) -> None:
+    """Recovery instants for the serve trace track (`ia trace`)."""
+    obs_trace.emit_record({"event": event, **fields})

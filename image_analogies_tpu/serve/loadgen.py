@@ -87,6 +87,7 @@ def selftest(cfg: ServeConfig, n: int, *, seed: int = 0,
     responses: Dict[int, Any] = {}
     errors: Dict[int, BaseException] = {}
     rejected = 0
+    journal_stats: Optional[Dict[str, int]] = None
     with Server(cfg) as srv:
         t0 = time.perf_counter()
         futures = {}
@@ -103,6 +104,23 @@ def selftest(cfg: ServeConfig, n: int, *, seed: int = 0,
             except BaseException as exc:  # noqa: BLE001 - summarized
                 errors[idx] = exc
         srv_s = time.perf_counter() - t0
+        if cfg.journal_dir:
+            # journaled smoke: every completed request resubmitted under
+            # its derived content key must dedupe, not recompute
+            deduped = 0
+            for idx in sorted(responses):
+                item = load[idx]
+                try:
+                    again = srv.submit(item["a"], item["ap"],
+                                       item["b"]).result(timeout=600)
+                    if (again.request_id == responses[idx].request_id
+                            and np.array_equal(again.bp,
+                                               responses[idx].bp)):
+                        deduped += 1
+                except BaseException:  # noqa: BLE001 - counted below
+                    pass
+            journal_stats = dict(srv.health()["journal"] or {})
+            journal_stats["resubmit_deduped"] = deduped
 
     ok = [r for r in responses.values() if r.degraded is None]
     degraded = [r for r in responses.values() if r.degraded is not None]
@@ -135,6 +153,7 @@ def selftest(cfg: ServeConfig, n: int, *, seed: int = 0,
         "rejected": rejected,
         "batch_size_hist": {str(k): v for k, v in sorted(batch_hist.items())},
         "bit_identical": bool(identical),
+        "journal": journal_stats,
     }
 
 
@@ -154,4 +173,12 @@ def render(summary: Dict[str, Any]) -> str:
         f"  bit-identical to singleton dispatch: "
         f"{summary['bit_identical']}",
     ]
+    jn = summary.get("journal")
+    if jn:
+        lines.append(
+            f"  journal:    {jn.get('admitted', 0)} admitted, "
+            f"{jn.get('done', 0)} done, "
+            f"{jn.get('deduped', 0)} deduped "
+            f"({jn.get('resubmit_deduped', 0)} resubmissions answered "
+            "from the journal)")
     return "\n".join(lines)

@@ -141,6 +141,21 @@ class AdmissionQueue:
             obs_metrics.set_gauge("serve.queue_depth", len(self._items))
             self._cond.notify_all()
 
+    def restore(self, reqs: List[Request]) -> None:
+        """Re-enqueue journal-replayed requests in their original admit
+        order (recovery).  Like :meth:`requeue`, bypasses the depth bound
+        and the chaos admission site: these requests were ALREADY
+        admitted — by the previous incarnation of this process — and the
+        journal is the witness; bouncing them here would lose accepted
+        work, the exact failure the journal exists to prevent."""
+        with self._lock:
+            if not reqs:
+                return
+            self._items.extend(reqs)
+            obs_metrics.max_gauge("serve.queue_depth_peak", len(self._items))
+            obs_metrics.set_gauge("serve.queue_depth", len(self._items))
+            self._cond.notify_all()
+
     def close(self) -> None:
         """Stop accepting; wake all workers so they can drain and exit."""
         with self._lock:

@@ -13,6 +13,17 @@ Lifecycle contract:
    in-flight and queued work (unless ``drain=False``, which fails queued
    requests with Rejected("shutting_down")), joins the workers, then
    closes the run scope so ``run_end`` carries the final counters.
+
+Durability (``ServeConfig.journal_dir``): a write-ahead request journal
+(serve/journal.py) records every admit before the queue sees it and
+every transition after.  ``start()`` then runs :meth:`Server.recover`
+BEFORE accepting traffic: finished entries arm done-dedupe (duplicate
+submissions answer instantly with the recorded response — exactly-once
+from the client's view), incomplete entries re-enqueue in original admit
+order, and entries whose dispatch history already exhausted
+``crash_requeues`` are marked poisoned and shed forever with
+``Rejected("poison")``.  ``kill()`` is the non-graceful teardown drills
+use to model process death.
 """
 
 from __future__ import annotations
@@ -31,6 +42,7 @@ from image_analogies_tpu.obs import trace as obs_trace
 from image_analogies_tpu.obs.slo import SloTracker
 from image_analogies_tpu.serve import batcher
 from image_analogies_tpu.serve import degrade as serve_degrade
+from image_analogies_tpu.serve import journal as serve_journal
 from image_analogies_tpu.serve.degrade import CostModel
 from image_analogies_tpu.serve.queue import AdmissionQueue
 from image_analogies_tpu.serve.types import (
@@ -58,8 +70,18 @@ class Server:
         self.slo = SloTracker(cfg.slo_target,
                               fast_window_s=cfg.slo_fast_window_s,
                               slow_window_s=cfg.slo_slow_window_s)
+        # Write-ahead journal: None unless configured — the disabled
+        # request path must never touch the journal module (zero-cost
+        # contract, locked by tests).
+        self._journal = (serve_journal.RequestJournal(
+            cfg.journal_dir, fsync=cfg.journal_fsync)
+            if cfg.journal_dir else None)
+        # idem -> Future for requests reconstructed by recover(); lets an
+        # embedder (or drill) wait for replayed work to finish.
+        self.recovery: Dict[str, "Future[Response]"] = {}
+        self.recovery_stats: Optional[Dict[str, int]] = None
         self._pool = WorkerPool(cfg, self._queue, self.cost_model,
-                                slo=self.slo)
+                                slo=self.slo, journal=self._journal)
         self._exit = contextlib.ExitStack()
         self._accepting = False
         self._started = False
@@ -90,6 +112,7 @@ class Server:
                 "breaker_threshold": self.cfg.breaker_threshold,
                 "cost_prior": self.cost_prior_source,
                 "slo_target": self.cfg.slo_target,
+                "journal": self.cfg.journal_dir,
             }}))
         obs_metrics.inc(f"serve.cost_prior.{self.cost_prior_source}")
         obs_metrics.set_gauge("serve.queue_depth", 0)
@@ -98,6 +121,12 @@ class Server:
                                 sizes=len(self.cfg.warmup_sizes)):
                 self.warmup_report = tune_warmup.warmup_buckets(
                     self.cfg.params, self.cfg.warmup_sizes)
+        if self._journal is not None:
+            # Replay BEFORE traffic: recovered work re-enqueues first,
+            # and done-dedupe / poison state is armed before the first
+            # duplicate submission can arrive.
+            self._journal.open()
+            self.recover()
         self._pool.start()
         self._t_start = time.monotonic()
         self._accepting = True
@@ -117,8 +146,102 @@ class Server:
                 serve_degrade.persist_rate(self.cost_model, self.cfg.params)
             except Exception:  # pragma: no cover - persistence best-effort
                 pass
+        if self._journal is not None:
+            self._journal.close()
         self._started = False
         self._exit.close()
+
+    def kill(self) -> None:
+        """Non-graceful teardown — the drill-facing stand-in for process
+        death.  Nothing is drained and no future is resolved: queued and
+        in-flight clients are left hanging, exactly as a real death
+        leaves them.  The write-ahead journal on disk is the only thing
+        that survives; a new Server on the same ``journal_dir`` picks the
+        work back up via :meth:`recover`."""
+        if not self._started:
+            return
+        self._accepting = False
+        self._queue.close()
+        self._queue.drain_rejected()  # dropped unresolved, like a death
+        self._pool.join(2.0)
+        if self._journal is not None:
+            self._journal.close()
+        self._started = False
+        self._exit.close()
+
+    # -- recovery ----------------------------------------------------------
+
+    def recover(self) -> Dict[str, int]:
+        """Replay the journal: arm done-dedupe and the poison set, then
+        re-enqueue every incomplete entry in original admit order.
+        Replayed requests carry no deadline (the original client's
+        absolute deadline died with the old process; the recovered
+        response is what a duplicate submission dedupes against) and
+        continue their pre-restart dispatch history: an entry whose
+        ``dispatched`` count already exceeds ``crash_requeues`` is marked
+        poisoned and shed instead of being given another chance to crash
+        the fleet."""
+        assert self._journal is not None
+        rep = self._journal.replay()
+        stats = {"entries": len(rep.entries), "replayed": 0, "poisoned": 0,
+                 "done": 0, "unrecoverable": 0,
+                 "quarantined": rep.quarantined}
+        restored = []
+        for ent in rep.incomplete:
+            if ent.dispatched > self.cfg.crash_requeues:
+                self._journal.record_poisoned(ent.idem)
+                stats["poisoned"] += 1
+                obs_trace.emit_record({"event": "serve_replay",
+                                       "idem": ent.idem,
+                                       "action": "poisoned",
+                                       "dispatched": ent.dispatched})
+                continue
+            payload = self._journal.load_payload(ent.idem)
+            if payload is None:  # spill damaged: quarantined, not re-run
+                self._journal.record_rejected(ent.idem, "payload_corrupt")
+                stats["unrecoverable"] += 1
+                obs_trace.emit_record({"event": "serve_replay",
+                                       "idem": ent.idem,
+                                       "action": "unrecoverable"})
+                continue
+            a, ap, b, params = payload
+            with self._id_lock:
+                self._next_id += 1
+                rid = self._next_id
+            fut: "Future[Response]" = Future()
+            req = Request(
+                request_id=rid, a=a, ap=ap, b=b, params=params,
+                key=batcher.batch_key(a, ap, b, params), future=fut,
+                idem=ent.idem, replayed=True, requeues=ent.dispatched)
+            restored.append(req)
+            self.recovery[ent.idem] = fut
+            stats["replayed"] += 1
+            obs_metrics.inc("serve.journal.replayed")
+            obs_trace.emit_record({"event": "serve_replay",
+                                   "idem": ent.idem, "request": rid,
+                                   "action": "requeued",
+                                   "dispatched": ent.dispatched})
+        stats["done"] = sum(1 for e in rep.entries.values()
+                            if e.done is not None)
+        self._queue.restore(restored)
+        obs_trace.emit_record({"event": "serve_recovery", **stats})
+        self.recovery_stats = stats
+        return stats
+
+    def wait_recovered(self, timeout: Optional[float] = None) -> Dict[str, str]:
+        """Block until every journal-replayed request resolves; returns
+        ``{idem: outcome}`` where outcome is the response status or the
+        exception type name."""
+        end = None if timeout is None else time.monotonic() + timeout
+        out: Dict[str, str] = {}
+        for idem, fut in self.recovery.items():
+            left = None if end is None else max(0.0,
+                                                end - time.monotonic())
+            try:
+                out[idem] = fut.result(left).status
+            except Exception as exc:  # noqa: BLE001 - summarized
+                out[idem] = type(exc).__name__
+        return out
 
     def __enter__(self) -> "Server":
         return self.start()
@@ -130,12 +253,39 @@ class Server:
 
     def submit(self, a: np.ndarray, ap: np.ndarray, b: np.ndarray,
                params: Optional[AnalogyParams] = None,
-               deadline_s: Optional[float] = None) -> "Future[Response]":
+               deadline_s: Optional[float] = None,
+               idempotency_key: Optional[str] = None) -> "Future[Response]":
         """Enqueue one request; returns a Future resolving to a Response
         (or raising DeadlineExceeded / the dispatch error).  Raises
-        :class:`Rejected` when the server is full or shutting down."""
+        :class:`Rejected` when the server is full or shutting down.
+
+        With the journal enabled, ``idempotency_key`` (or the derived
+        content key) makes submission exactly-once across restarts: a
+        key the journal already finished answers instantly with the
+        recorded response, and a key marked poisoned sheds with
+        ``Rejected("poison")`` before it can touch a worker — checked
+        ahead of the breaker, so known-poison retries never trip it."""
         if not self._accepting:
             raise Rejected("shutting_down")
+        p = params or self.cfg.params
+        key = idem = None
+        if self._journal is not None:
+            key = batcher.batch_key(a, ap, b, p)
+            idem = idempotency_key or serve_journal.idem_key(
+                batcher.key_str(key), np.asarray(b))
+            if self._journal.is_poisoned(idem):
+                obs_metrics.inc("serve.rejected")
+                obs_metrics.inc("serve.poisoned")
+                raise Rejected("poison")
+            cached = self._journal.lookup_done(idem)
+            if cached is not None:
+                obs_metrics.inc("serve.journal.deduped")
+                obs_trace.emit_record({"event": "serve_dedupe",
+                                       "request": cached.request_id,
+                                       "idem": idem})
+                fut: "Future[Response]" = Future()
+                fut.set_result(cached)
+                return fut
         if self._pool.breaker.admission_open():
             # Breaker-aware admission: the dispatch breaker is open, so
             # an accepted request would only sit in the queue to be
@@ -145,23 +295,36 @@ class Server:
             obs_metrics.inc("serve.rejected")
             obs_metrics.inc("serve.rejected.breaker_open")
             raise Rejected("breaker_open")
-        p = params or self.cfg.params
         if deadline_s is None:
             deadline_s = self.cfg.default_deadline_s
         with self._id_lock:
             self._next_id += 1
             rid = self._next_id
-        fut: "Future[Response]" = Future()
+        fut = Future()
         req = Request(
             request_id=rid,
             a=np.asarray(a), ap=np.asarray(ap), b=np.asarray(b),
             params=p,
-            key=batcher.batch_key(a, ap, b, p),
+            key=key if key is not None else batcher.batch_key(a, ap, b, p),
             future=fut,
+            idem=idem,
         )
         if deadline_s is not None:
             req.deadline = req.t_submit + deadline_s
-        self._queue.submit(req)  # Rejected propagates to the caller
+        if self._journal is not None:
+            # WAL ordering: the admit record (payload spill + sealed
+            # line) lands BEFORE the queue sees the request, so an
+            # accepted request with no journal trace cannot exist.
+            self._journal.record_admit(
+                idem, rid, req.a, req.ap, req.b, p, deadline_s,
+                batcher.key_str(req.key))
+            try:
+                self._queue.submit(req)
+            except Rejected as exc:
+                self._journal.record_rejected(idem, exc.reason)
+                raise
+        else:
+            self._queue.submit(req)  # Rejected propagates to the caller
         # Admission instant: the first hop of the request's trace chain
         # (ia trace renders admit -> queue wait -> batch -> dispatch).
         obs_trace.emit_record({"event": "serve_admit",
@@ -218,6 +381,10 @@ class Server:
                 (v for k, v in gauges.items()
                  if k.startswith("hbm.peak_bytes.")), default=0),
             "slo": self.slo.snapshot(),
+            # durability plane: live serve.journal.* counter tallies
+            # (None when the journal is disabled)
+            "journal": (self._journal.stats()
+                        if self._journal is not None else None),
         }
 
 
@@ -229,8 +396,10 @@ class Client:
     def __init__(self, server: Server):
         self._server = server
 
-    def submit(self, a, ap, b, params=None, deadline_s=None):
-        return self._server.submit(a, ap, b, params, deadline_s)
+    def submit(self, a, ap, b, params=None, deadline_s=None,
+               idempotency_key=None):
+        return self._server.submit(a, ap, b, params, deadline_s,
+                                   idempotency_key=idempotency_key)
 
     def request(self, a, ap, b, params=None, deadline_s=None, timeout=None):
         return self._server.request(a, ap, b, params, deadline_s, timeout)

@@ -27,7 +27,11 @@ class Rejected(RuntimeError):
     breaker is open (one hop before the queue — see serve/breaker.py),
     ``"circuit_open"`` when the breaker trips between an accepted
     request's admission and its dispatch,
-    ``"worker_crash"`` when a crashed worker exhausted the requeue budget.
+    ``"worker_crash"`` when a crashed worker exhausted the requeue budget,
+    ``"poison"`` when the request's idempotency key was previously marked
+    poisoned in the write-ahead journal (it exhausted ``crash_requeues``
+    once already — resubmission sheds instantly, before the breaker, so a
+    known-poison key can neither re-crash the fleet nor trip the breaker).
     """
 
     def __init__(self, reason: str):
@@ -93,6 +97,15 @@ class ServeConfig:
     slo_target: float = 0.99
     slo_fast_window_s: float = 60.0
     slo_slow_window_s: float = 600.0
+    # Durability (serve/journal.py): when set, every request is recorded
+    # in a write-ahead journal under this directory at admit time and on
+    # each state transition; Server.recover() replays it on startup
+    # (done-dedupe, re-enqueue, poison shed).  None (default) disables
+    # the journal entirely — the request path never touches the module.
+    journal_dir: Optional[str] = None
+    # fsync each journal append (the durability guarantee).  Tests and
+    # throughput-over-durability embedders may turn it off.
+    journal_fsync: bool = True
 
     def __post_init__(self):
         if self.queue_depth < 1:
@@ -129,6 +142,11 @@ class Request:
     t_submit: float = dataclasses.field(default_factory=time.monotonic)
     t_dequeue: Optional[float] = None
     requeues: int = 0  # crash-containment requeue count (bounded)
+    # Write-ahead-journal identity (None when the journal is disabled).
+    # ``replayed`` marks a request reconstructed by Server.recover() —
+    # its dispatch transitions continue the pre-restart history.
+    idem: Optional[str] = None
+    replayed: bool = False
 
     def remaining(self, now: Optional[float] = None) -> Optional[float]:
         if self.deadline is None:

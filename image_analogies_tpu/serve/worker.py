@@ -50,9 +50,10 @@ from image_analogies_tpu.utils import failure
 class WorkerPool:
     def __init__(self, cfg: ServeConfig, queue: AdmissionQueue,
                  cost_model: Optional[serve_degrade.CostModel] = None,
-                 slo: Optional[SloTracker] = None):
+                 slo: Optional[SloTracker] = None, journal=None):
         self._cfg = cfg
         self._queue = queue
+        self._journal = journal  # write-ahead journal (None = disabled)
         self._cost = cost_model or serve_degrade.CostModel()
         self.breaker = CircuitBreaker(cfg.breaker_threshold,
                                       cfg.breaker_cooldown_s,
@@ -94,6 +95,16 @@ class WorkerPool:
                 return
             try:
                 self._run_batch(batch)
+            except chaos.ProcessDeath:
+                # The chaos plane's process-death fault: deliberately NOT
+                # contained — a dead process cannot requeue anything.
+                # The thread exits, futures stay unresolved, and the only
+                # recovery path is the write-ahead journal on restart
+                # (the kill-restart drill's whole premise).
+                obs_metrics.inc("serve.process_deaths")
+                obs_trace.emit_record({"event": "serve_process_death",
+                                       "batch_size": len(batch)})
+                return
             except BaseException as exc:  # noqa: BLE001 - crash containment
                 self._contain_crash(batch, exc)
 
@@ -113,6 +124,13 @@ class WorkerPool:
                 req.requeues += 1
                 self._queue.requeue(req)
             else:
+                # Requeue budget exhausted: this request deterministically
+                # takes workers down.  Persist the poison verdict so any
+                # RESUBMISSION of the same idempotency key sheds at
+                # admission with Rejected("poison") instead of crashing
+                # the fleet again.
+                if self._journal is not None and req.idem:
+                    self._journal.record_poisoned(req.idem)
                 obs_metrics.inc("serve.rejected")
                 req.future.set_exception(Rejected("worker_crash"))
 
@@ -191,6 +209,7 @@ class WorkerPool:
             obs_metrics.inc("serve.timeouts")
             self._record_slo(req, False)
             self._emit_request_record(req, "timeout", batch_size=batch_size)
+            self._journal_rejected(req, "deadline")
             req.future.set_exception(
                 DeadlineExceeded(req.request_id, -(req.remaining() or 0.0)))
             return backend
@@ -207,6 +226,7 @@ class WorkerPool:
             obs_metrics.inc("serve.rejected")
             self._record_slo(req, False)
             self._emit_request_record(req, "rejected", batch_size=batch_size)
+            self._journal_rejected(req, "circuit_open")
             req.future.set_exception(Rejected("circuit_open"))
             return backend
 
@@ -217,6 +237,13 @@ class WorkerPool:
         else:
             backend = backend or get_backend(params)
             dispatch_backend = backend
+
+        # WAL transition: dispatched BEFORE the engine call.  If the
+        # process dies anywhere past this line without a done append,
+        # replay sees `dispatched` and re-enqueues (counting the attempt
+        # against the cross-restart poison budget).
+        if self._journal is not None and req.idem:
+            self._journal.record_dispatched(req.idem)
 
         t0 = time.monotonic()
         try:
@@ -238,6 +265,7 @@ class WorkerPool:
             self._record_slo(req, False)
             self._emit_request_record(req, "error", batch_size=batch_size,
                                       dispatch_ms=(time.monotonic() - t0) * 1e3)
+            self._journal_rejected(req, "error")
             req.future.set_exception(exc)
             return backend
 
@@ -269,5 +297,17 @@ class WorkerPool:
         self._emit_request_record(req, resp.status, batch_size=batch_size,
                                   dispatch_ms=resp.dispatch_ms,
                                   degraded=degraded)
+        # WAL transition: done is appended (response spilled + digest
+        # sealed) BEFORE the future resolves.  If the process dies between
+        # the two, the client never saw the answer and replay serves the
+        # recorded one — the exactly-once edge, not a duplicate.
+        if self._journal is not None and req.idem:
+            self._journal.record_done(req.idem, resp)
         req.future.set_result(resp)
         return backend
+
+    def _journal_rejected(self, req: Request, reason: str) -> None:
+        """Terminal non-success transition: replay must not re-enqueue a
+        request whose client already saw a definitive refusal."""
+        if self._journal is not None and req.idem:
+            self._journal.record_rejected(req.idem, reason)

@@ -72,14 +72,19 @@ def _payload_checksum(bp: np.ndarray, s: np.ndarray,
     return h.hexdigest()[:32]
 
 
-def quarantine(path: str) -> str:
-    """Move a damaged checkpoint aside as ``<path>.corrupt`` (never
-    deleted: the bytes are evidence) and record the event.  Returns the
-    quarantine path."""
+def quarantine(path: str, *, counter: str = "ckpt.quarantined",
+               event: str = "ckpt_quarantined") -> str:
+    """Move a damaged file aside as ``<path>.corrupt`` (never deleted:
+    the bytes are evidence) and record the event.  Returns the
+    quarantine path.
+
+    Defaults keep the original checkpoint contract; other planes reuse
+    the pattern with their own telemetry names (serve/journal.py passes
+    ``serve.journal.quarantined`` / ``journal_quarantined``)."""
     qpath = path + ".corrupt"
     os.replace(path, qpath)
-    obs_metrics.inc("ckpt.quarantined")
-    obs_trace.emit_record({"event": "ckpt_quarantined", "path": path})
+    obs_metrics.inc(counter)
+    obs_trace.emit_record({"event": event, "path": path})
     return qpath
 
 

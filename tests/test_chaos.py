@@ -119,6 +119,69 @@ def test_plan_validation():
         ChaosPlan.from_dict({"sites": {"x": {"p": 0.5}}})  # no kind
     with pytest.raises(ValueError):
         ChaosPlan.from_dict([])  # not an object
+    # lognormal latency spec: both percentiles or neither, and ordered
+    with pytest.raises(ValueError):
+        SiteRule(kind="latency", latency_p50_ms=10.0)
+    with pytest.raises(ValueError):
+        SiteRule(kind="latency", latency_p99_ms=10.0)
+    with pytest.raises(ValueError):
+        SiteRule(kind="latency", latency_p50_ms=10.0, latency_p99_ms=5.0)
+    with pytest.raises(ValueError):
+        SiteRule(kind="latency", latency_p50_ms=-1.0, latency_p99_ms=5.0)
+
+
+def test_plan_json_roundtrip_lognormal_latency():
+    plan = ChaosPlan(seed=3, sites=(
+        ("level.dispatch", SiteRule(kind="latency", p=1.0,
+                                    latency_p50_ms=2.0,
+                                    latency_p99_ms=20.0)),))
+    again = ChaosPlan.from_json(json.dumps(plan.to_dict()))
+    assert again == plan
+    # inert zero defaults stay out of the serialized form
+    flat = json.dumps(ChaosPlan(seed=3, sites=(
+        ("x", SiteRule(kind="latency")),)).to_dict())
+    assert "latency_p50_ms" not in flat
+
+
+def test_lognormal_latency_draws_are_plan_deterministic():
+    """Same (seed, site) -> same tail-latency draws; the p50/p99 spec
+    shapes them (median near p50, spread reaching toward p99)."""
+    rule = SiteRule(kind="latency", p=1.0, latency_p50_ms=5.0,
+                    latency_p99_ms=50.0)
+    plan = ChaosPlan(seed=11, sites=(("level.dispatch", rule),))
+
+    def draws(n=64):
+        inject.arm(plan)
+        try:
+            return [inject._latency_s("level.dispatch", rule)
+                    for _ in range(n)]
+        finally:
+            inject.disarm()
+
+    first, second = draws(), draws()
+    assert first == second                      # replayable tail
+    assert all(d > 0 for d in first)
+    med = sorted(first)[len(first) // 2]
+    assert 0.001 < med < 0.025                  # median ~5ms, not 50ms
+    assert max(first) > med * 2                 # a tail actually exists
+    # a different seed reshuffles the draws
+    inject.arm(ChaosPlan(seed=12, sites=(("level.dispatch", rule),)))
+    try:
+        other = [inject._latency_s("level.dispatch", rule)
+                 for _ in range(64)]
+    finally:
+        inject.disarm()
+    assert other != first
+
+
+def test_fixed_latency_rule_ignores_lognormal_path():
+    rule = SiteRule(kind="latency", p=1.0, latency_ms=7.0)
+    plan = ChaosPlan(seed=11, sites=(("level.dispatch", rule),))
+    inject.arm(plan)
+    try:
+        assert inject._latency_s("level.dispatch", rule) == 0.007
+    finally:
+        inject.disarm()
 
 
 # ------------------------------------------------------- telemetry

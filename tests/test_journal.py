@@ -1,0 +1,457 @@
+"""serve/journal.py — write-ahead request journal + durability plane.
+
+Tier-1 invariants locked here:
+
+- the journal replays: admit/transition histories fold back into
+  per-key states, in original admit order;
+- damage never poisons replay: a torn tail or a flipped byte costs the
+  damaged suffix only — the valid prefix survives, the damaged file is
+  quarantined as ``.corrupt`` (same contract as checkpoint quarantine,
+  same assertions as tests/test_aux.py's);
+- exactly-once: a finished key dedupes with the recorded response; a
+  corrupt response spill degrades the key to not-done (deterministic
+  re-run, same bytes) instead of serving garbage;
+- poison containment: a key that exhausted its crash budget is
+  persisted poisoned and future submissions shed with
+  ``Rejected("poison")`` before the breaker can see them;
+- disabled (the default) costs nothing: the request path never touches
+  the journal module;
+- serve/journal.py never imports jax (grep lock — durability is pure
+  host-side control flow).
+"""
+
+import json
+import os
+import re
+
+import numpy as np
+import pytest
+
+from image_analogies_tpu.chaos import drills, inject
+from image_analogies_tpu.chaos.plan import ChaosPlan, SiteRule
+from image_analogies_tpu.serve import journal as sj
+from image_analogies_tpu.serve.types import Rejected, Response
+
+
+@pytest.fixture(autouse=True)
+def _disarm_fault_injector():
+    yield
+    inject.disarm()
+
+
+def _planes(seed=0, size=(6, 6)):
+    rng = np.random.RandomState(seed)
+    h, w = size
+    return (rng.rand(h, w).astype(np.float32),
+            rng.rand(h, w).astype(np.float32),
+            rng.rand(h, w).astype(np.float32))
+
+
+def _resp(rid, bp, bp_y=None):
+    return Response(request_id=rid, bp=bp,
+                    bp_y=bp_y if bp_y is not None else bp,
+                    stats={"levels": 1}, batch_size=1, queue_ms=0.0,
+                    dispatch_ms=0.0, total_ms=0.0)
+
+
+def _journal(tmp_path, name="j"):
+    return sj.RequestJournal(str(tmp_path / name), fsync=False)
+
+
+def _admit(jr, idem, rid=1, seed=0):
+    a, ap, b = _planes(seed)
+    jr.record_admit(idem, rid, a, ap, b, drills.image_params(levels=1),
+                    None, "key")
+    return a, ap, b
+
+
+# ------------------------------------------------------- core replay
+
+
+def test_idem_key_is_deterministic_and_content_sensitive():
+    _, _, b = _planes(0)
+    assert sj.idem_key("k", b) == sj.idem_key("k", b.copy())
+    assert sj.idem_key("k", b) != sj.idem_key("other", b)
+    b2 = b.copy()
+    b2[0, 0] += 1.0
+    assert sj.idem_key("k", b) != sj.idem_key("k", b2)
+
+
+def test_roundtrip_replay_folds_states_in_admit_order(tmp_path):
+    jr = _journal(tmp_path)
+    jr.open()
+    a, ap, b = _admit(jr, "aa", rid=1, seed=1)
+    jr.record_dispatched("aa")
+    jr.record_done("aa", _resp(1, b))
+    _admit(jr, "bb", rid=2, seed=2)
+    jr.record_dispatched("bb")
+    _admit(jr, "cc", rid=3, seed=3)
+    jr.record_poisoned("cc")
+    jr.close()
+
+    # a FRESH journal object (a restarted process) replays the history
+    jr2 = _journal(tmp_path)
+    rep = jr2.replay()
+    assert rep.order == ["aa", "bb", "cc"]
+    assert rep.quarantined == 0
+    assert rep.entries["aa"].done is not None
+    assert rep.entries["bb"].dispatched == 1
+    assert not rep.entries["bb"].complete
+    assert rep.entries["cc"].poisoned
+    assert [e.idem for e in rep.incomplete] == ["bb"]
+    # done-dedupe: lazily loads the recorded response, bit-identical
+    got = jr2.lookup_done("aa")
+    assert got is not None and got.request_id == 1
+    assert np.array_equal(got.bp, b)
+    assert jr2.is_poisoned("cc")
+    # the incomplete entry's payload replays bit-identically too
+    payload = jr2.load_payload("bb")
+    assert payload is not None
+    assert np.array_equal(payload[2], _planes(2)[2])
+
+
+def test_replay_is_deterministic(tmp_path):
+    jr = _journal(tmp_path)
+    jr.open()
+    for i, idem in enumerate(("x1", "x2", "x3")):
+        _admit(jr, idem, rid=i + 1, seed=i)
+    jr.record_dispatched("x2")
+    jr.close()
+    r1 = _journal(tmp_path).replay()
+    r2 = _journal(tmp_path).replay()
+    assert r1.order == r2.order
+    assert {k: (e.dispatched, e.complete) for k, e in r1.entries.items()} \
+        == {k: (e.dispatched, e.complete) for k, e in r2.entries.items()}
+
+
+def test_duplicate_done_lines_fold_once(tmp_path):
+    """A done retry that raced a death leaves two done lines; replay must
+    count the request once, not answer twice."""
+    jr = _journal(tmp_path)
+    jr.open()
+    _, _, b = _admit(jr, "dd", rid=1, seed=4)
+    jr.record_done("dd", _resp(1, b))
+    jr.record_done("dd", _resp(1, b))  # duplicate append
+    jr.close()
+    jr2 = _journal(tmp_path)
+    rep = jr2.replay()
+    assert len(rep.entries) == 1
+    assert rep.entries["dd"].done is not None
+    assert rep.incomplete == []
+    assert jr2.inspect()["states"] == {"done": 1}
+
+
+# ---------------------------------------------- damage + quarantine
+# (same .corrupt contract — and the same assertion shapes — as the
+# checkpoint quarantine tests in tests/test_aux.py)
+
+
+def _segments(jr):
+    return jr._segments()
+
+
+def test_torn_tail_keeps_valid_prefix_and_quarantines(tmp_path):
+    jr = _journal(tmp_path)
+    jr.open()
+    _admit(jr, "p1", rid=1, seed=1)
+    _admit(jr, "p2", rid=2, seed=2)
+    jr.close()
+    (seg,) = _segments(jr)
+    with open(seg) as f:
+        whole = f.read()
+    # tear mid-way through the LAST line (a death mid-append)
+    torn_at = len(whole) - 10
+    with open(seg, "w") as f:
+        f.write(whole[:torn_at])
+
+    jr2 = _journal(tmp_path)
+    rep = jr2.replay()
+    assert rep.quarantined == 1
+    assert os.path.exists(seg + ".corrupt")       # evidence kept
+    assert rep.order == ["p1"]                     # valid prefix survived
+    # the rewritten segment replays cleanly on the NEXT restart too
+    rep2 = _journal(tmp_path).replay()
+    assert rep2.quarantined == 0
+    assert rep2.order == ["p1"]
+
+
+def test_flipped_byte_fails_seal_and_quarantines(tmp_path):
+    jr = _journal(tmp_path)
+    jr.open()
+    _admit(jr, "q1", rid=1, seed=1)
+    _admit(jr, "q2", rid=2, seed=2)
+    jr.close()
+    (seg,) = _segments(jr)
+    with open(seg) as f:
+        lines = f.readlines()
+    # flip one byte INSIDE the second line's record payload (keep it
+    # valid JSON: damage the idem value, so only the seal can catch it)
+    lines[1] = lines[1].replace('"idem":"q2"', '"idem":"qX"')
+    with open(seg, "w") as f:
+        f.writelines(lines)
+
+    rep = _journal(tmp_path).replay()
+    assert rep.quarantined == 1
+    assert os.path.exists(seg + ".corrupt")
+    assert rep.order == ["q1"]
+
+
+def test_corrupt_response_spill_degrades_to_not_done(tmp_path):
+    """Exactly-once under spill rot: the key stops answering from the
+    journal (quarantine), so a resubmission re-runs deterministically
+    instead of serving damaged bytes."""
+    jr = _journal(tmp_path)
+    jr.open()
+    _, _, b = _admit(jr, "rr", rid=1, seed=5)
+    jr.record_done("rr", _resp(1, b))
+    jr.close()
+    rpath = jr.response_path("rr")
+    with open(rpath, "r+b") as f:
+        f.seek(os.path.getsize(rpath) // 2)
+        f.write(b"\xff" * 32)
+
+    jr2 = _journal(tmp_path)
+    jr2.replay()
+    assert jr2.lookup_done("rr") is None
+    assert os.path.exists(rpath + ".corrupt")
+    assert not os.path.exists(rpath)
+
+
+def test_corrupt_payload_spill_is_unrecoverable_not_fatal(tmp_path):
+    jr = _journal(tmp_path)
+    jr.open()
+    _admit(jr, "uu", rid=1, seed=6)
+    jr.close()
+    ppath = jr.payload_path("uu")
+    with open(ppath, "r+b") as f:
+        f.seek(os.path.getsize(ppath) // 2)
+        f.write(b"\x00" * 32)
+    jr2 = _journal(tmp_path)
+    jr2.replay()
+    assert jr2.load_payload("uu") is None
+    assert os.path.exists(ppath + ".corrupt")
+
+
+def test_compact_rewrites_final_states_only(tmp_path):
+    jr = _journal(tmp_path)
+    jr.open()
+    _, _, b = _admit(jr, "c1", rid=1, seed=1)
+    jr.record_dispatched("c1")
+    jr.record_done("c1", _resp(1, b))
+    _admit(jr, "c2", rid=2, seed=2)
+    jr.record_dispatched("c2")
+    jr.close()
+
+    out = _journal(tmp_path).compact()
+    assert out["after"]["segments"] == 1
+    assert out["dropped_lines"] > 0
+    jr3 = _journal(tmp_path)
+    rep = jr3.replay()
+    assert rep.entries["c1"].done is not None
+    assert rep.entries["c2"].dispatched == 1      # attempt count survives
+    assert [e.idem for e in rep.incomplete] == ["c2"]
+    assert jr3.lookup_done("c1") is not None      # resp spill kept
+    assert not os.path.exists(jr3.payload_path("c1"))  # finished input gone
+    assert os.path.exists(jr3.payload_path("c2"))      # pending input kept
+
+
+# ------------------------------------------------- server integration
+
+
+def test_poisoned_key_sheds_before_breaker(tmp_path):
+    """A persisted poison verdict sheds resubmission instantly with
+    Rejected("poison") — counted, and never able to trip the breaker."""
+    from image_analogies_tpu.obs import metrics as obs_metrics
+    from image_analogies_tpu.obs import trace as obs_trace
+    from image_analogies_tpu.serve.server import Server
+
+    jdir = str(tmp_path / "j")
+    pre = sj.RequestJournal(jdir, fsync=False)
+    pre.open()
+    _admit(pre, "bad-key", rid=1, seed=7)
+    pre.record_poisoned("bad-key")
+    pre.close()
+
+    cfg = drills.serve_config(workers=1, journal_dir=jdir)
+    a, ap, b = _planes(7)
+    with obs_trace.run_scope(cfg.params.replace(metrics=True)):
+        with Server(cfg) as srv:
+            for _ in range(3):
+                with pytest.raises(Rejected) as exc:
+                    srv.submit(a, ap, b, idempotency_key="bad-key")
+                assert exc.value.reason == "poison"
+            assert srv._pool.breaker.state == "closed"
+            counters = obs_metrics.snapshot()["counters"]
+    assert counters.get("serve.poisoned") == 3
+
+
+def test_crash_exhaustion_persists_poison_across_restart(tmp_path):
+    """The in-process crash-containment verdict survives the process:
+    the key that took workers down is shed by the NEXT server too."""
+    from image_analogies_tpu.obs import trace as obs_trace
+    from image_analogies_tpu.serve.server import Server
+
+    jdir = str(tmp_path / "j")
+    cfg = drills.serve_config(workers=1, crash_requeues=0,
+                              journal_dir=jdir)
+    plan = ChaosPlan(seed=0, sites=(
+        ("serve.dispatch", SiteRule(kind="crash", p=1.0)),))
+    a, ap, b = _planes(8)
+    with obs_trace.run_scope(cfg.params):
+        with inject.plan_scope(plan):
+            with Server(cfg) as srv:
+                fut = srv.submit(a, ap, b, idempotency_key="crasher")
+                with pytest.raises(Rejected) as exc:
+                    fut.result(timeout=30)
+                assert exc.value.reason == "worker_crash"
+        # restart on the same journal, chaos disarmed: the key is
+        # remembered as poison, not retried
+        with Server(cfg) as srv2:
+            assert srv2.recovery_stats["replayed"] == 0
+            with pytest.raises(Rejected) as exc:
+                srv2.submit(a, ap, b, idempotency_key="crasher")
+            assert exc.value.reason == "poison"
+
+
+def test_duplicate_submission_dedupes_with_recorded_response(tmp_path):
+    from image_analogies_tpu.obs import trace as obs_trace
+    from image_analogies_tpu.serve.server import Server
+
+    cfg = drills.serve_config(workers=1, journal_dir=str(tmp_path / "j"))
+    a, ap, b = _planes(9, size=(12, 12))
+    with obs_trace.run_scope(cfg.params):
+        with Server(cfg) as srv:
+            first = srv.submit(a, ap, b).result(timeout=60)
+            again = srv.submit(a, ap, b).result(timeout=60)
+    assert again.request_id == first.request_id
+    assert np.array_equal(again.bp, first.bp)
+
+
+def test_disabled_journal_path_never_touches_module(tmp_path, monkeypatch):
+    """Zero-cost disabled: with journal_dir unset, the request path must
+    not instantiate a journal or derive an idem key."""
+    from image_analogies_tpu.obs import trace as obs_trace
+    from image_analogies_tpu.serve.server import Server
+
+    def poisoned(*a, **k):
+        raise AssertionError("journal touched on the disabled path")
+
+    monkeypatch.setattr(sj.RequestJournal, "__init__", poisoned)
+    monkeypatch.setattr(sj, "idem_key", poisoned)
+
+    cfg = drills.serve_config(workers=1)  # no journal_dir
+    a, ap, b = _planes(10, size=(12, 12))
+    with obs_trace.run_scope(cfg.params):
+        with Server(cfg) as srv:
+            resp = srv.submit(a, ap, b).result(timeout=60)
+    assert resp.status == "ok"
+
+
+def test_loadgen_selftest_journal_smoke(tmp_path):
+    """`ia serve --selftest --journal DIR`'s engine: the journaled smoke
+    must complete, stay bit-identical, and answer every resubmission
+    from the journal."""
+    from image_analogies_tpu.serve import loadgen
+
+    cfg = drills.serve_config(workers=1, journal_dir=str(tmp_path / "j"))
+    summary = loadgen.selftest(cfg, 3, seed=0, shapes=((12, 12),))
+    assert summary["errors"] == 0
+    assert summary["bit_identical"] is True
+    jn = summary["journal"]
+    assert jn is not None
+    assert jn["resubmit_deduped"] == summary["completed"] == 3
+    assert jn["admitted"] == 3 and jn["done"] == 3
+
+
+# ------------------------------------------------------- telemetry
+
+
+def test_journal_surfaces_in_report_and_trace(tmp_path):
+    """A journaled run's log carries the durability section in
+    `ia report` and replay/dedupe instants on the serve trace track."""
+    from image_analogies_tpu.obs import export as obs_export
+    from image_analogies_tpu.obs import report as obs_report
+    from image_analogies_tpu.obs import trace as obs_trace
+    from image_analogies_tpu.serve.server import Server
+
+    jdir = str(tmp_path / "j")
+    log = str(tmp_path / "run.jsonl")
+    cfg = drills.serve_config(workers=1, journal_dir=jdir)
+
+    # incarnation 1: admit one request but kill before the worker can
+    # finish it — guaranteed replay work for incarnation 2
+    slow = drills.serve_config(workers=1, batch_window_ms=5000.0,
+                               max_batch=2, journal_dir=jdir)
+    a, ap, b = _planes(11, size=(12, 12))
+    params = cfg.params.replace(metrics=True, log_path=log)
+    with obs_trace.run_scope(params):
+        srv = Server(slow)
+        srv.start()
+        jr = srv._journal
+        jr.record_admit("ghost", 99, a, ap, b, slow.params, None, "key")
+        srv.kill()
+        with Server(cfg) as srv2:
+            assert srv2.recovery_stats["replayed"] == 1
+            assert srv2.wait_recovered(timeout=60) == {"ghost": "ok"}
+            dup = srv2.submit(a, ap, b,
+                              idempotency_key="ghost").result(timeout=60)
+            assert np.array_equal(dup.bp, srv2.recovery["ghost"]
+                                  .result().bp)
+
+    an = obs_report.analyze(obs_report.load_records(log))
+    assert an["journal"] is not None
+    assert an["journal"]["replayed"] == 1
+    assert an["journal"]["deduped"] == 1
+    assert an["journal"]["recoveries"][-1]["replayed"] == 1
+    assert "durability:" in obs_report.report(log)
+
+    out = str(tmp_path / "trace.json")
+    obs_export.export_trace(log, out)
+    with open(out) as f:
+        trace = json.load(f)
+    serve_instants = [e["name"] for e in trace["traceEvents"]
+                      if e.get("tid") == obs_export.SERVE_TID
+                      and e["ph"] == "i"]
+    assert any(n.startswith("replay requeued") for n in serve_instants)
+    assert any(n.startswith("recovery replayed=1") for n in serve_instants)
+    assert any(n.startswith("dedupe") for n in serve_instants)
+
+
+# ------------------------------------------------------------- CLI
+
+
+def test_cli_journal_inspect_and_compact(tmp_path, capsys):
+    from image_analogies_tpu.cli import main
+
+    jdir = str(tmp_path / "j")
+    jr = sj.RequestJournal(jdir, fsync=False)
+    jr.open()
+    _, _, b = _admit(jr, "k1", rid=1, seed=1)
+    jr.record_dispatched("k1")
+    jr.record_done("k1", _resp(1, b))
+    _admit(jr, "k2", rid=2, seed=2)
+    jr.close()
+
+    assert main(["journal", "inspect", jdir]) == 0
+    out = capsys.readouterr().out
+    assert "2 requests" in out and "done" in out and "k2" in out
+
+    assert main(["journal", "compact", jdir, "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["after"]["lines"] == 2  # admit k2 + done k1 = final states
+
+    assert main(["journal", "inspect", "/nonexistent/journal"]) == 2
+
+
+# ------------------------------------------------------- grep locks
+
+
+def test_journal_module_is_jax_free():
+    """Durability is host-side control flow: serve/journal.py must import
+    cleanly (and run) with no jax anywhere — same lock as chaos/."""
+    src_path = sj.__file__
+    with open(src_path) as f:
+        src = f.read()
+    assert not re.findall(r"^(import jax|from jax)", src, re.MULTILINE)
+    assert not re.findall(r"\bjax\.jit\s*\(|\bpjit\s*\(|\bjax\.pmap\s*\(",
+                          src)
