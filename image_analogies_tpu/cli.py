@@ -487,7 +487,11 @@ def cmd_journal(args) -> int:
                 print(f"  poisoned     {', '.join(info['poisoned'])}")
         return 0
     if args.action == "compact":
-        out = jr.compact()
+        try:
+            out = jr.compact()
+        except RuntimeError as exc:  # journal active (live appender)
+            print(f"journal: {exc}", file=sys.stderr)
+            return 2
         if args.json:
             print(json.dumps(out, indent=2, sort_keys=True))
         else:
@@ -853,7 +857,8 @@ def build_parser() -> argparse.ArgumentParser:
                     help="inspect: read-only per-state summary; compact: "
                          "rewrite to one segment of final states "
                          "(finished input spills dropped, response "
-                         "spills kept for dedupe)")
+                         "spills kept for dedupe); compact refuses "
+                         "while a live server holds the journal")
     jr.add_argument("dir", help="journal directory (ia serve --journal)")
     jr.add_argument("--json", action="store_true",
                     help="machine-readable output")

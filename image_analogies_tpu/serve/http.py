@@ -13,7 +13,9 @@ API:
                         server's live metrics registry
   POST /v1/analogy   -> body {"a": [[...]], "ap": [[...]], "b": [[...]],
                         "deadline_ms": optional float,
-                        "idempotency_key": optional str (journal dedupe)}
+                        "idempotency_key": optional str (journal dedupe;
+                        must match [A-Za-z0-9_-]{1,64} — keys name spill
+                        files, so anything else answers 400)}
                         reply {"request", "status", "bp", "timings", ...}
 
 Planes are nested JSON lists of floats — fine for a loopback demo
@@ -29,6 +31,7 @@ from typing import Any, Dict
 import numpy as np
 
 from image_analogies_tpu.obs import live as obs_live
+from image_analogies_tpu.serve import journal as serve_journal
 from image_analogies_tpu.serve.server import Server
 from image_analogies_tpu.serve.types import DeadlineExceeded, Rejected
 
@@ -82,13 +85,20 @@ def _make_handler(server: Server):
                 return
             deadline_ms = req.get("deadline_ms")
             idem = req.get("idempotency_key")
+            if idem is not None:
+                idem = str(idem)
+                if not serve_journal.valid_idem(idem):
+                    self._reply(400, {
+                        "error": "bad_request",
+                        "detail": "idempotency_key must match "
+                                  "[A-Za-z0-9_-]{1,64}"})
+                    return
             try:
                 resp = server.submit(
                     a, ap, b,
                     deadline_s=None if deadline_ms is None
                     else float(deadline_ms) / 1e3,
-                    idempotency_key=None if idem is None
-                    else str(idem)).result()
+                    idempotency_key=idem).result()
             except Rejected as exc:
                 self._reply(429, {"error": "rejected", "reason": exc.reason})
                 return

@@ -33,7 +33,11 @@ re-run an incomplete request and answer a duplicate of a finished one.
 
 Idempotency key: client-supplied, or ``sha1(batch key x payload
 digest)`` — deterministic across processes, so a client retry after a
-restart dedupes with no client-side cooperation.
+restart dedupes with no client-side cooperation.  Keys name files under
+the journal directory, so client-supplied keys are confined to
+``[A-Za-z0-9_-]{1,64}`` (:func:`valid_idem`), enforced at the HTTP and
+``Server.submit`` boundaries and again by every path builder here —
+a traversal-shaped key can never become a filesystem path.
 
 Zero-cost when disabled: the server holds ``journal=None`` unless
 ``ServeConfig.journal_dir`` is set; no call site touches this module on
@@ -47,6 +51,7 @@ import dataclasses
 import hashlib
 import json
 import os
+import re
 import threading
 import zipfile
 from typing import Any, Dict, List, Optional
@@ -60,7 +65,18 @@ from image_analogies_tpu.obs import trace as obs_trace
 from image_analogies_tpu.utils import checkpoint as ckpt
 
 _SEGMENT_FMT = "segment-%06d.jsonl"
+_LOCK_NAME = "journal.lock"
 _OPS = ("admitted", "dispatched", "done", "rejected", "poisoned")
+_IDEM_RE = re.compile(r"[A-Za-z0-9_-]{1,64}\Z")
+
+
+def valid_idem(idem: str) -> bool:
+    """True when *idem* is safe to embed in journal lines and spill
+    filenames.  Keys name files under the journal directory, so
+    anything outside ``[A-Za-z0-9_-]{1,64}`` (path separators, dots,
+    NULs, over-long strings) is refused at the submit/HTTP boundary —
+    derived keys (sha1 hex) match by construction."""
+    return isinstance(idem, str) and bool(_IDEM_RE.fullmatch(idem))
 
 
 def idem_key(key_str: str, b: np.ndarray) -> str:
@@ -168,11 +184,29 @@ class RequestJournal:
             return []
         return [os.path.join(self.path, n) for n in names]
 
+    @property
+    def _lock_path(self) -> str:
+        return os.path.join(self.path, _LOCK_NAME)
+
     def payload_path(self, idem: str) -> str:
+        # Backstop behind the boundary validation in Server.submit /
+        # http.py: an unvalidated key must fail loudly here, never
+        # become a path outside the payload dir.
+        if not valid_idem(idem):
+            raise ValueError(f"unsafe idempotency key: {idem!r}")
         return os.path.join(self._payload_dir, f"{idem}.npz")
 
     def response_path(self, idem: str) -> str:
+        if not valid_idem(idem):
+            raise ValueError(f"unsafe idempotency key: {idem!r}")
         return os.path.join(self._payload_dir, f"{idem}.resp.npz")
+
+    @staticmethod
+    def _spill_tmp(final_path: str) -> str:
+        """Per-writer temp name for a spill headed to *final_path* (the
+        .npz suffix keeps np.savez from appending its own)."""
+        return (f"{final_path}.{os.getpid()}"
+                f".{threading.get_ident()}.tmp.npz")
 
     # -- append side -------------------------------------------------------
 
@@ -187,6 +221,25 @@ class RequestJournal:
             last = int(os.path.basename(segs[-1])[8:-6]) if segs else 0
             self._segment = last + 1
             self._fh = open(self._segment_path(self._segment), "a")
+            # Advisory single-writer lock: marks the journal active so
+            # compact() refuses to delete segments out from under a
+            # live appender.  Released by close(); a crash leaves it
+            # behind, so readers liveness-check the recorded pid.
+            with open(self._lock_path, "w") as lf:
+                lf.write(str(os.getpid()))
+            # Sweep spill temp files orphaned by a crashed incarnation
+            # (each writer uses a unique temp name, so these can only
+            # be dead — the atomic os.replace either happened or not).
+            try:
+                for name in os.listdir(self._payload_dir):
+                    if name.endswith(".tmp.npz"):
+                        try:
+                            os.remove(os.path.join(self._payload_dir,
+                                                   name))
+                        except OSError:
+                            pass
+            except OSError:
+                pass
         return self
 
     def close(self) -> None:
@@ -196,6 +249,35 @@ class RequestJournal:
                     self._fh.close()
                 finally:
                     self._fh = None
+                try:
+                    os.remove(self._lock_path)
+                except OSError:
+                    pass
+
+    def active_pid(self) -> Optional[int]:
+        """PID of a process currently appending to this journal, or
+        None.  A lock file whose owner is dead is stale — removed here
+        so a crashed incarnation doesn't block compaction forever."""
+        if self._fh is not None:
+            return os.getpid()
+        try:
+            with open(self._lock_path) as f:
+                pid = int(f.read().strip() or "0")
+        except (OSError, ValueError):
+            return None
+        if pid <= 0:
+            return None
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            try:
+                os.remove(self._lock_path)
+            except OSError:
+                pass
+            return None
+        except PermissionError:
+            pass  # exists, owned by another user: still alive
+        return pid
 
     def _append(self, record: Dict[str, Any]) -> None:
         # The chaos plane's process-death site: a ProcessDeath raised
@@ -221,7 +303,11 @@ class RequestJournal:
         line cannot exist, only the harmless converse."""
         ppath = self.payload_path(idem)
         if not os.path.exists(ppath):  # client retries reuse the spill
-            tmp = ppath + ".tmp.npz"
+            # Unique temp per writer: a retry racing the original (both
+            # past the exists check) must not interleave np.savez into
+            # one file — each writes its own, os.replace is atomic,
+            # last-one-wins lands a self-consistent spill either way.
+            tmp = self._spill_tmp(ppath)
             np.savez(tmp, a=a, ap=ap, b=b,
                      params=json.dumps(dataclasses.asdict(params),
                                        sort_keys=True),
@@ -240,7 +326,7 @@ class RequestJournal:
         already guarantees every future duplicate gets the same one."""
         rpath = self.response_path(idem)
         if not os.path.exists(rpath):
-            tmp = rpath + ".tmp.npz"
+            tmp = self._spill_tmp(rpath)
             np.savez(tmp, bp=resp.bp, bp_y=resp.bp_y,
                      stats=json.dumps(resp.stats, default=str),
                      degraded=json.dumps(resp.degraded),
@@ -391,6 +477,11 @@ class RequestJournal:
             for rec in self._read_segment(seg):
                 lines += 1
                 idem = str(rec.get("idem"))
+                if not valid_idem(idem):
+                    # Journal lines only ever carry boundary-validated
+                    # keys; an unsafe idem means a handcrafted file —
+                    # skip it so replay never turns it into a path.
+                    continue
                 op = rec["op"]
                 if op == "admitted":
                     if idem not in entries:
@@ -461,7 +552,17 @@ class RequestJournal:
         still-incomplete work), dropping intermediate transitions and the
         input spills of finished requests.  Response spills are kept —
         they are what dedupe answers with.  ``.corrupt`` files are never
-        touched."""
+        touched.
+
+        Refuses while the journal is active (``journal.lock`` held by a
+        live pid): a live appender holds the newest segment open, so
+        deleting it would send its fsync'd appends to an unlinked file
+        and silently lose every transition after the compaction."""
+        owner = self.active_pid()
+        if owner is not None:
+            raise RuntimeError(
+                f"journal at {self.path} is active (pid {owner}); "
+                "stop the server before compacting")
         rep = self.replay()
         before = {"segments": len(self._segments()), "lines": rep.lines}
         tmp = os.path.join(self.path, "compact.tmp")

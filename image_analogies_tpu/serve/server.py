@@ -270,6 +270,14 @@ class Server:
         p = params or self.cfg.params
         key = idem = None
         if self._journal is not None:
+            if (idempotency_key is not None
+                    and not serve_journal.valid_idem(idempotency_key)):
+                # The key names files under the journal dir — anything
+                # outside [A-Za-z0-9_-]{1,64} (path separators, dots)
+                # is refused before it can touch a path or a journal
+                # line.  HTTP pre-checks this and answers 400.
+                obs_metrics.inc("serve.rejected")
+                raise Rejected("bad_idempotency_key")
             key = batcher.batch_key(a, ap, b, p)
             idem = idempotency_key or serve_journal.idem_key(
                 batcher.key_str(key), np.asarray(b))

@@ -255,6 +255,105 @@ def test_compact_rewrites_final_states_only(tmp_path):
     assert os.path.exists(jr3.payload_path("c2"))      # pending input kept
 
 
+# -------------------------------------------- boundary hardening
+
+
+def test_valid_idem_charset():
+    """Keys name spill files: only [A-Za-z0-9_-]{1,64} passes, and the
+    derived sha1 keys pass by construction."""
+    assert sj.valid_idem("kill-restart-0")
+    assert sj.valid_idem("A_b-9")
+    assert sj.valid_idem(sj.idem_key("k", np.zeros((2, 2), np.float32)))
+    for bad in ("", "../../../x", "a/b", "a\\b", ".", "..", "a.b",
+                "a b", "a\x00b", "a" * 65, 7, None):
+        assert not sj.valid_idem(bad)
+
+
+def test_unsafe_idem_never_becomes_a_path(tmp_path):
+    """Path builders are the backstop behind boundary validation: a
+    traversal-shaped key must fail loudly, never join into a path."""
+    jr = _journal(tmp_path)
+    for bad in ("../../../x", "a/b", "..", "a" * 65):
+        with pytest.raises(ValueError):
+            jr.payload_path(bad)
+        with pytest.raises(ValueError):
+            jr.response_path(bad)
+
+
+def test_replay_skips_handcrafted_unsafe_idem_lines(tmp_path):
+    """A sealed-but-unsafe idem in a (handcrafted) journal line is
+    skipped by replay — recovery must never turn it into a file path
+    (load_payload on it would read/quarantine an arbitrary target)."""
+    jr = _journal(tmp_path)
+    rec = {"op": "admitted", "idem": "../../../etc/target", "rid": 1,
+           "key": "k", "deadline_s": None}
+    line = json.dumps({"seal": sj._seal(rec), **rec},
+                      sort_keys=True, separators=(",", ":"))
+    with open(os.path.join(jr.path, "segment-000001.jsonl"), "w") as f:
+        f.write(line + "\n")
+    rep = jr.replay()
+    assert rep.entries == {} and rep.order == []
+    assert rep.incomplete == []  # nothing for recover() to re-enqueue
+
+
+def test_concurrent_admit_spills_stay_valid(tmp_path):
+    """A client retry racing the original submission (both past the
+    exists check) must not corrupt the payload spill: each writer uses
+    its own temp file, so the surviving spill always load/checksums."""
+    import threading
+
+    jr = _journal(tmp_path)
+    jr.open()
+    a, ap, b = _planes(3)
+    params = drills.image_params(levels=1)
+    for round_ in range(8):
+        idem = f"race-{round_}"
+        barrier = threading.Barrier(2)
+
+        def spill(rid, idem=idem):
+            barrier.wait()
+            jr.record_admit(idem, rid, a, ap, b, params, None, "key")
+
+        threads = [threading.Thread(target=spill, args=(rid,))
+                   for rid in (1, 2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert jr.load_payload(idem) is not None  # checksum holds
+    jr.close()
+    names = os.listdir(os.path.join(jr.path, "payloads"))
+    assert not any(n.endswith(".corrupt") for n in names)
+
+
+def test_compact_refuses_while_journal_active(tmp_path, monkeypatch):
+    """compact() deleting segments under a live appender would send its
+    fsync'd appends to an unlinked file — refused via journal.lock."""
+    jr = _journal(tmp_path)
+    jr.open()
+    _admit(jr, "live-1", rid=1, seed=1)
+    with pytest.raises(RuntimeError, match="active"):
+        jr.compact()  # same object: in-process appender
+    other = _journal(tmp_path)
+    with pytest.raises(RuntimeError, match="active"):
+        other.compact()  # lock file names a live pid (ours)
+    jr.close()
+    out = _journal(tmp_path).compact()  # lock released: allowed
+    assert out["after"]["segments"] == 1
+
+    # a crashed incarnation's stale lock (dead owner) must not block
+    jr2 = _journal(tmp_path)
+    with open(os.path.join(jr2.path, "journal.lock"), "w") as f:
+        f.write("123456789")
+    def dead(pid, sig):
+        raise ProcessLookupError
+
+    monkeypatch.setattr(sj.os, "kill", dead)
+    assert jr2.active_pid() is None
+    jr2.compact()  # proceeds, stale lock swept
+    assert not os.path.exists(os.path.join(jr2.path, "journal.lock"))
+
+
 # ------------------------------------------------- server integration
 
 
@@ -283,6 +382,27 @@ def test_poisoned_key_sheds_before_breaker(tmp_path):
             assert srv._pool.breaker.state == "closed"
             counters = obs_metrics.snapshot()["counters"]
     assert counters.get("serve.poisoned") == 3
+
+
+def test_unsafe_idempotency_key_rejected_at_submit(tmp_path):
+    """A traversal-shaped client key is refused at the submit boundary
+    before it can reach a journal line or a spill path."""
+    from image_analogies_tpu.obs import trace as obs_trace
+    from image_analogies_tpu.serve.server import Server
+
+    cfg = drills.serve_config(workers=1, journal_dir=str(tmp_path / "j"))
+    a, ap, b = _planes(5, size=(12, 12))
+    with obs_trace.run_scope(cfg.params):
+        with Server(cfg) as srv:
+            for bad in ("../../../x", "a/b", "a" * 65, ""):
+                with pytest.raises(Rejected) as exc:
+                    srv.submit(a, ap, b, idempotency_key=bad)
+                assert exc.value.reason == "bad_idempotency_key"
+            # a well-formed key still flows
+            ok = srv.submit(a, ap, b,
+                            idempotency_key="good-key_1").result(timeout=60)
+    assert ok.status == "ok"
+    assert not os.path.exists(tmp_path / "x")  # nothing escaped the dir
 
 
 def test_crash_exhaustion_persists_poison_across_restart(tmp_path):
@@ -430,6 +550,8 @@ def test_cli_journal_inspect_and_compact(tmp_path, capsys):
     jr.record_dispatched("k1")
     jr.record_done("k1", _resp(1, b))
     _admit(jr, "k2", rid=2, seed=2)
+    assert main(["journal", "compact", jdir]) == 2  # refused: active
+    assert "active" in capsys.readouterr().err
     jr.close()
 
     assert main(["journal", "inspect", jdir]) == 0
