@@ -94,11 +94,20 @@ def extract_headline(doc: dict):
     tail for the north-star per-config block (survives the driver's
     head-truncation of long tails).
     """
+    def _head(obj, source):
+        out = {"value": float(obj["value"]),
+               "metric_key": _metric_key(obj.get("metric", "")),
+               "source": source}
+        # host-gap trajectory (PR 8): rounds that measured the pipelined
+        # engine carry the inter-level host time; older archives don't —
+        # the sentry gates it only where both sides have one
+        if obj.get("host_gap_ms") is not None:
+            out["host_gap_ms"] = float(obj["host_gap_ms"])
+        return out
+
     parsed = doc.get("parsed")
     if isinstance(parsed, dict) and "value" in parsed:
-        return {"value": float(parsed["value"]),
-                "metric_key": _metric_key(parsed.get("metric", "")),
-                "source": "parsed"}
+        return _head(parsed, "parsed")
     tail = doc.get("tail") or ""
     for line in reversed(tail.splitlines()):
         line = line.strip()
@@ -109,9 +118,7 @@ def extract_headline(doc: dict):
         except ValueError:
             continue
         if isinstance(obj, dict) and "value" in obj:
-            return {"value": float(obj["value"]),
-                    "metric_key": _metric_key(obj.get("metric", "")),
-                    "source": "tail_json"}
+            return _head(obj, "tail_json")
     m = _NORTH_STAR_RE.search(tail)
     if m:
         return {"value": float(m.group(1)),
@@ -148,7 +155,8 @@ def load_trajectory(bench_dir: str = ".") -> dict:
 
 
 def check_regression(trajectory: dict, fresh_value=None,
-                     threshold_pct: float = 20.0) -> dict:
+                     threshold_pct: float = 20.0,
+                     fresh_gap=None) -> dict:
     """Gate a wall-clock number against the trajectory floor.
 
     With ``fresh_value`` (a just-measured number), it is compared against
@@ -157,29 +165,41 @@ def check_regression(trajectory: dict, fresh_value=None,
     checked against the best of the points before it.  ``ok`` is False
     when the candidate exceeds the floor by more than ``threshold_pct``
     percent.
+
+    ``host_gap_ms`` (the pipelined engine's inter-level host time)
+    rides the same gate wherever BOTH the candidate and at least one
+    comparison point carry it — the pipeline's whole point is keeping
+    that number near zero, so a silent regression there must fail the
+    sentry even when total wall-clock absorbs it.  ``fresh_gap`` pairs
+    with ``fresh_value``; archive points carry theirs from
+    ``extract_headline``.
     """
     points = trajectory.get("points") or []
+    problems = list(trajectory.get("problems", []))
     if not points:
         return {"ok": False, "reason": "no_trajectory_points",
-                "problems": trajectory.get("problems", [])}
+                "problems": problems}
     latest = points[-1]
     key = latest["metric_key"]
     same = [p for p in points if p["metric_key"] == key]
     if fresh_value is None:
         candidate, cand_src = latest["value"], latest["file"]
+        cand_gap = latest.get("host_gap_ms")
         prior = same[:-1]
         if not prior:
             return {"ok": True, "reason": "single_point",
                     "metric_key": key, "candidate": candidate,
                     "candidate_source": cand_src,
                     "points": len(points),
-                    "problems": trajectory.get("problems", [])}
+                    "problems": problems}
         floor = min(p["value"] for p in prior)
     else:
         candidate, cand_src = float(fresh_value), "fresh"
+        cand_gap = fresh_gap
+        prior = same
         floor = min(p["value"] for p in same)
     regression_pct = (candidate - floor) / floor * 100.0
-    return {
+    out = {
         "ok": regression_pct <= threshold_pct,
         "metric_key": key,
         "candidate": candidate,
@@ -188,8 +208,25 @@ def check_regression(trajectory: dict, fresh_value=None,
         "regression_pct": round(regression_pct, 2),
         "threshold_pct": threshold_pct,
         "points": len(points),
-        "problems": trajectory.get("problems", []),
+        "problems": problems,
     }
+    prior_gaps = [p["host_gap_ms"] for p in prior
+                  if p.get("host_gap_ms") is not None]
+    if cand_gap is not None and prior_gaps:
+        gap_floor = min(prior_gaps)
+        # floor can legitimately be ~0 on a fully-hidden run: gate on an
+        # absolute 1 ms slack there instead of exploding the percentage
+        gap_reg = ((float(cand_gap) - gap_floor)
+                   / max(gap_floor, 1.0) * 100.0)
+        out["host_gap_ms"] = float(cand_gap)
+        out["host_gap_floor"] = gap_floor
+        out["host_gap_regression_pct"] = round(gap_reg, 2)
+        if gap_reg > threshold_pct:
+            out["ok"] = False
+            problems.append(
+                f"host_gap_ms regressed {gap_reg:.1f}% past the "
+                f"{gap_floor:.1f} ms floor (candidate {cand_gap:.1f} ms)")
+    return out
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, _HERE)
@@ -319,8 +356,12 @@ def _run_tpu(a, ap, b, params, keep_levels=False, reps=3):
 
     res, t_min, t_med = _timed(
         lambda: create_image_analogy(a, ap, b, params), reps)
+    timing = dict(getattr(res, "timing", None) or {})
     if keep_levels:
         res = create_image_analogy(a, ap, b, params, keep_levels=True)
+        # report the TIMED reps' pipeline accounting, not the untimed
+        # instrumentation run's (keep_levels disables donation)
+        res.timing = timing
     return res, t_min, t_med
 
 
@@ -577,11 +618,16 @@ def main() -> int:
         res_ns, ns_s, ns_s_med = _run_tpu(a, ap, b, p, keep_levels=True,
                                           reps=5)
         oracle_s = float(ocfg["wall_s"])
+        timing = getattr(res_ns, "timing", None) or {}
         rec = {
             "tpu_s": round(ns_s, 3),
             "tpu_s_median": round(ns_s_med, 3),
             "cpu_oracle_s": oracle_s,
             "speedup": round(oracle_s / ns_s, 1),
+            # inter-level host time of the last timed rep — the number
+            # the async pipeline exists to hide (gated by `ia bench
+            # --check` against the archive floor)
+            "host_gap_ms": round(float(timing.get("host_gap_ms", 0.0)), 1),
             **_parity_fields(res_ns, oz["bp_y"], oz["source_map"]),
             "oracle": f"cached seed {seed} (experiments/oracle_1024.py)",
             **_obs_fields(),
@@ -612,6 +658,7 @@ def main() -> int:
         "value": round(ns_s, 3),
         "value_median": round(ns_s_med, 3),
         "unit": "s",
+        "host_gap_ms": ns_rec["host_gap_ms"],
         "vs_baseline": round(oracle_s / ns_s, 1),
         "ssim_vs_oracle": round(ns_ssim, 4),
         "value_match": round(ns_match, 4),

@@ -43,6 +43,12 @@ class LevelJob:
     # Video mode: previous frame's planes at this level (temporal term).
     a_temporal: Optional[np.ndarray] = None
     b_temporal: Optional[np.ndarray] = None
+    # Buffer-donation consent, set by the DRIVER (it alone knows whether
+    # anything else still reads this level's chained planes — retries,
+    # keep_levels, checkpoints).  True lets the backend route this level
+    # through its donate_argnums twins; the driver must treat the donated
+    # b_filt_coarse buffer as dead afterwards.
+    donate: bool = False
 
     @property
     def a_shape(self) -> Tuple[int, int]:
@@ -98,3 +104,13 @@ class Matcher(abc.ABC):
         read-only array-likes and call np.asarray() where a host copy is
         required.  Stats may defer device scalars under "_n_coh"/"_n_ref";
         models.analogy._finalize_stats resolves them."""
+
+    def prefetch_level(self, job: LevelJob) -> None:
+        """Warm host-side caches for a FUTURE level (pipelined driver).
+
+        Called from a helper thread while the previous level's program is
+        in flight.  Implementations may only populate content/shape-keyed
+        caches (device-upload cache, schedule caches) — never produce the
+        level's results — so a prefetch that is skipped, fails, or races
+        the dispatch changes nothing but timing.  Default: no-op (the CPU
+        backend has no device uploads to hide)."""

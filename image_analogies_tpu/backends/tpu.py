@@ -43,6 +43,7 @@ Strategies (see config.AnalogyParams.strategy):
 from __future__ import annotations
 
 import functools
+import threading
 import time
 from dataclasses import dataclass, field, fields
 from typing import Any, Dict, Optional, Tuple
@@ -54,6 +55,9 @@ import jax.numpy as jnp
 
 from image_analogies_tpu.backends.base import LevelJob, Matcher
 from image_analogies_tpu.obs import device as obs_device
+from image_analogies_tpu.obs import metrics as obs_metrics
+from image_analogies_tpu.obs import trace as obs_trace
+from image_analogies_tpu.utils import logging as ia_logging
 from image_analogies_tpu.ops.features import (
     build_features_jax,
     causal_mask,
@@ -91,8 +95,10 @@ _REFINE_PASSES = 3
 # The wavefront scan's packed (Nb, 2) carry stores source-map indices as
 # exact f32 VALUES (int bit patterns would be denormal-flushed by real TPU
 # data paths — measured round 4); f32 represents integers exactly below
-# 2^24, so exemplars beyond 4096^2 rows are rejected at trace time.
-_WAVEFRONT_MAX_ROWS = 1 << 24
+# 2^24, so exemplars beyond 4096^2 rows are rejected at trace time.  The
+# bound itself resolves through tune.resolve ("wavefront_max_rows" — the
+# last geometry constant to move behind the funnel); resolution clamps
+# any configured value to the 2^24 correctness ceiling.
 
 
 @dataclass
@@ -1267,6 +1273,40 @@ def make_anchor_fn(db: TpuLevelDB, defer_rescore: bool = False):
 # ------------------------------------------------------------ wavefront scan
 
 
+def _wavefront_rows_guard(db: TpuLevelDB) -> None:
+    """Refuse A-row counts the packed carry cannot index exactly.
+
+    Source-map indices ride an f32 lane of the packed (Nb, 2) carry
+    (exact only below 2^24 — a 4096^2 exemplar; see the gather comment).
+    Explicit raise, not assert: `python -O` must not strip the guard.
+    Bucketed levels (static ha/wa = 0 sentinel) check the PADDED row
+    count instead — conservative-safe: real indices are strictly below
+    it, and the host guard cannot read a traced extent.  Called from
+    `synthesize_level` (host side, EVERY dispatch — the in-core check
+    alone only fires at trace time, so a jit cache hit would skip a
+    freshly lowered tune bound) and from `wavefront_scan_core` itself
+    for direct callers.
+    """
+    a_rows_bound = (db.ha * db.wa if db.dims_a is None else db.db.shape[0])
+    max_rows = tune.wavefront_max_rows(
+        dtype="f32", fp=db.db.shape[1], n_rows=a_rows_bound)
+    if a_rows_bound > max_rows:
+        raise ValueError(
+            f"the wavefront strategy caps exemplars at "
+            f"{max_rows} A rows (<= the 2^24 f32-exactness ceiling; a "
+            f"4096x4096 A — tune knob wavefront_max_rows / env "
+            f"IA_WAVEFRONT_ROWS can only lower it): this A is "
+            f"{db.ha}x{db.wa} = {a_rows_bound}.  Why: the scan's packed "
+            f"(Nb, 2) carry stores source-map indices as exact f32 VALUES "
+            f"(exact only below 2^24; int bit patterns in f32 lanes are "
+            f"denormal-flushed by real TPU data paths — measured round "
+            f"4).  Workarounds: strategy='batched' (no packed carry; a "
+            f"different but comparable synthesis), or downsample A/A' — "
+            f"and note a >2^24-row DB also exceeds the HBM the scan "
+            f"needs, so multi-chip db_shards with the batched strategy "
+            f"is the supported route at that scale.")
+
+
 def wavefront_scan_core(db: TpuLevelDB, kappa_mult, anchor_fn,
                         row_fn=None, afilt_fn=None, live_gather=None,
                         data_axis=None, data_axis_size: int = 1):
@@ -1306,26 +1346,7 @@ def wavefront_scan_core(db: TpuLevelDB, kappa_mult, anchor_fn,
     """
     nb = db.hb * db.wb
     hb, wb = db.hb, db.wb
-    # source-map indices ride an f32 lane of the packed (Nb, 2) carry
-    # (exact only below 2^24 — a 4096^2 exemplar; see the gather comment).
-    # Explicit raise, not assert: `python -O` must not strip the guard.
-    # Bucketed levels (static ha/wa = 0 sentinel) check the PADDED row
-    # count instead — conservative-safe: real indices are strictly below
-    # it, and the host guard cannot read a traced extent.
-    a_rows_bound = (db.ha * db.wa if db.dims_a is None else db.db.shape[0])
-    if a_rows_bound > _WAVEFRONT_MAX_ROWS:
-        raise ValueError(
-            f"the wavefront strategy caps exemplars at 2^24 rows "
-            f"({_WAVEFRONT_MAX_ROWS}; a 4096x4096 A): this A is "
-            f"{db.ha}x{db.wa} = {a_rows_bound}.  Why: the scan's packed "
-            f"(Nb, 2) carry stores source-map indices as exact f32 VALUES "
-            f"(exact only below 2^24; int bit patterns in f32 lanes are "
-            f"denormal-flushed by real TPU data paths — measured round "
-            f"4).  Workarounds: strategy='batched' (no packed carry; a "
-            f"different but comparable synthesis), or downsample A/A' — "
-            f"and note a >2^24-row DB also exceeds the HBM the scan "
-            f"needs, so multi-chip db_shards with the batched strategy "
-            f"is the supported route at that scale.")
+    _wavefront_rows_guard(db)
     if data_axis is not None and (
             data_axis_size & (data_axis_size - 1) or data_axis_size > 8):
         raise ValueError(
@@ -1394,8 +1415,8 @@ def wavefront_scan_core(db: TpuLevelDB, kappa_mult, anchor_fn,
             # for small ints and real TPU data paths flush them to zero
             # (measured round 4: bitcast packing scored SSIM 0.69 on-chip
             # while CPU stayed bit-exact); f32<->int conversion is exact
-            # for indices < 2^24, guarded at build time by
-            # _WAVEFRONT_MAX_ROWS.
+            # for indices < 2^24, guarded at build time by the resolved
+            # wavefront_max_rows bound (clamped to that ceiling).
             g = bps[idx]  # (M, nc, 2)
             dyn = g[..., 0] * written * db.fine_sqrtw[None, :nc]
             s_r = g[..., 1].astype(jnp.int32)
@@ -1472,10 +1493,59 @@ def wavefront_scan_core(db: TpuLevelDB, kappa_mult, anchor_fn,
     return bps[:, 0], bps[:, 1].astype(jnp.int32), n_coh
 
 
-@jax.jit
-def _run_wavefront(db: TpuLevelDB, kappa_mult):
+def _run_wavefront_impl(db: TpuLevelDB, kappa_mult):
     return wavefront_scan_core(db, kappa_mult,
                                make_anchor_fn(db, defer_rescore=True))
+
+
+_run_wavefront = jax.jit(_run_wavefront_impl)
+
+# Donated twins (perf PR 8, SNIPPETS [3] donate_argnums pattern): every
+# array leaf of the level's TpuLevelDB — the DB panes, the packed pads,
+# static queries, the chained-plane-derived buffers AND the wavefront
+# step carry XLA allocates from them — may be reused in place for the
+# level's outputs instead of allocating fresh HBM.  Safe because the
+# single-chip build produces FRESH buffers for every leaf (prepare-jit
+# outputs, per-call device_puts) and the driver only routes a level here
+# when nothing else can read them (LevelJob.donate: no retries, no
+# keep_levels/checkpoint/save-levels consumers — models/analogy.py).
+# The batched twin keeps the lru-cached (Nb, p^2) gather maps OUT of the
+# donated argument (donating a cached buffer would poison every later
+# level/run that cache serves); the wavefront DB carries 1-row map
+# placeholders, so its whole pytree donates.
+_run_wavefront_donated = jax.jit(_run_wavefront_impl, donate_argnums=(0,))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _run_batched_donated(db: TpuLevelDB, maps, kappa_mult):
+    import dataclasses
+    db = dataclasses.replace(db, flat_idx=maps[0], valid=maps[1],
+                             written=maps[2])
+    return batched_scan_core(db, kappa_mult, make_approx_fn(db))
+
+
+def _donation_safe_db(db: TpuLevelDB) -> TpuLevelDB:
+    """Re-materialize any db leaf that shares a device buffer with an
+    earlier leaf.  Donation requires every donated leaf to own its
+    buffer: the template aliases placeholder zeros (valid/written are one
+    array) and XLA CSE may alias identical prepare outputs — donating
+    one buffer through two parameters is a runtime error on real TPUs.
+    Copies only the aliased leaves (tiny placeholders in practice)."""
+    leaves, treedef = jax.tree_util.tree_flatten(db)
+    seen = set()
+    out = []
+    for leaf in leaves:
+        if isinstance(leaf, jax.Array):
+            try:
+                key = leaf.unsafe_buffer_pointer()
+            except Exception:  # multi-device/committed: object identity
+                key = id(leaf)
+            if key in seen:
+                leaf = jnp.array(leaf)
+            else:
+                seen.add(key)
+        out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 # Whole-level scan programs: shimmed like the preparation jits (the
@@ -1485,6 +1555,10 @@ _run_exact = obs_device.instrument(_run_exact, "tpu.run_exact")
 _run_rowwise = obs_device.instrument(_run_rowwise, "tpu.run_rowwise")
 _run_batched = obs_device.instrument(_run_batched, "tpu.run_batched")
 _run_wavefront = obs_device.instrument(_run_wavefront, "tpu.run_wavefront")
+_run_wavefront_donated = obs_device.instrument(
+    _run_wavefront_donated, "tpu.run_wavefront_donated")
+_run_batched_donated = obs_device.instrument(
+    _run_batched_donated, "tpu.run_batched_donated")
 
 
 # Strategies with the uniform (db, kappa_mult) -> (bp, s, n_coh) signature;
@@ -1494,6 +1568,99 @@ _RUNNERS = {
     "rowwise": _run_rowwise,
     "wavefront": _run_wavefront,
 }
+
+
+# ------------------------------------------------- bf16 scoring parity gate
+#
+# AnalogyParams.bf16_scoring routes the wavefront anchor through the
+# scan_rescue machinery (bf16 per-tile champion scan + exact-f32 top-T
+# re-score with the lowest-index tie-break).  Unlike the IA_EXPERIMENTAL
+# probe modes it is a supported flag, and the support contract is this
+# gate: the FIRST bf16-scored synthesis on a device class runs a small
+# deterministic probe twice (exact parity engine vs bf16 engine) and
+# audits the source maps with utils/parity.py.  Only a verdict whose
+# mismatches are ALL tie-explained (unexplained == 0, first divergence a
+# tie) enables the mode; anything else auto-disables it process-wide and
+# the synthesis silently keeps the exact parity scan.  The verdict is
+# cached per device kind, logged as a "bf16_gate" event, and counted
+# (bf16.gate_ok / bf16.disabled_unexplained).
+
+_BF16_GATE: Dict[str, Dict[str, Any]] = {}
+_BF16_GATE_LOCK = threading.Lock()
+_BF16_TLS = threading.local()  # .probing: True inside the gate's bf16 run
+
+
+def reset_bf16_gate() -> None:
+    """Forget cached gate verdicts (tests re-probe after monkeypatching)."""
+    with _BF16_GATE_LOCK:
+        _BF16_GATE.clear()
+
+
+def _bf16_probe_pair() -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Deterministic structured probe inputs: textured enough that fine
+    levels carry real near-tie structure, small enough to audit in well
+    under a second of device time."""
+    n = 32
+    yy, xx = np.meshgrid(np.linspace(0.0, 1.0, n, dtype=np.float32),
+                         np.linspace(0.0, 1.0, n, dtype=np.float32),
+                         indexing="ij")
+    a = (0.5 + 0.5 * np.sin(9.0 * xx) * np.cos(7.0 * yy)).astype(np.float32)
+    ap = np.clip(0.8 * a + 0.2 * xx, 0.0, 1.0).astype(np.float32)
+    b = (0.5 + 0.5 * np.sin(5.0 * xx + 1.3)
+         * np.cos(11.0 * yy + 0.7)).astype(np.float32)
+    return a, ap, b
+
+
+def _bf16_probe_verdict(params) -> Dict[str, Any]:
+    """Run the probe pair through both engines and audit (see gate note)."""
+    from image_analogies_tpu.models.analogy import create_image_analogy
+    from image_analogies_tpu.utils.parity import audit_source_map_mismatches
+
+    base = params.replace(
+        levels=2, backend="tpu", strategy="wavefront", match_mode="auto",
+        bf16_scoring=False, db_shards=1, data_shards=1,
+        temporal_weight=0.0, level_retries=0, dispatch_timeout_s=0.0,
+        level_sync=True, checkpoint_dir=None, resume_from_level=None,
+        profile_dir=None, log_path=None, metrics=False,
+        save_levels_dir=None, pipeline=False, donate_buffers=False)
+    a, ap, b = _bf16_probe_pair()
+    exact = create_image_analogy(a, ap, b, base, keep_levels=True)
+    _BF16_TLS.probing = True
+    try:
+        bf16 = create_image_analogy(a, ap, b,
+                                    base.replace(bf16_scoring=True),
+                                    keep_levels=True)
+    finally:
+        _BF16_TLS.probing = False
+    audit = audit_source_map_mismatches(a, ap, b, base,
+                                        bf16.levels, exact.levels)
+    ok = (audit["unexplained"] == 0
+          and audit["first_divergence_is_tie"] is not False)
+    return {"ok": ok, "mismatches": audit["mismatches"],
+            "unexplained": audit["unexplained"],
+            "first_divergence_is_tie": audit["first_divergence_is_tie"]}
+
+
+def _bf16_gate_allows(params) -> bool:
+    if getattr(_BF16_TLS, "probing", False):
+        return True  # the gate's own bf16 probe run must not recurse
+    key = tune.device_kind()
+    with _BF16_GATE_LOCK:
+        verdict = _BF16_GATE.get(key)
+    if verdict is None:
+        fresh = _bf16_probe_verdict(params)
+        with _BF16_GATE_LOCK:
+            verdict = _BF16_GATE.setdefault(key, fresh)
+        if verdict is fresh:  # first prober logs/counts the verdict once
+            obs_metrics.inc("bf16.gate_ok" if verdict["ok"]
+                            else "bf16.disabled_unexplained")
+            ctx = obs_trace._CURRENT
+            ia_logging.emit(
+                {"event": "bf16_gate", "severity":
+                 "info" if verdict["ok"] else "warning",
+                 "device": key, **verdict},
+                ctx.log_path if ctx is not None else None)
+    return verdict["ok"]
 
 
 class TpuMatcher(Matcher):
@@ -1562,6 +1729,12 @@ class TpuMatcher(Matcher):
                     if ha * wa >= _PACKED_CROSSOVER_ROWS else "exact_hi")
         if sharded:
             mode = "exact_hi"
+        if (self.params.bf16_scoring and strategy == "wavefront"
+                and not sharded and _bf16_gate_allows(self.params)):
+            # Opt-in fast scoring: bf16 champion scan + exact-f32 top-T
+            # re-score.  Only reachable after the parity gate's probe
+            # audit came back fully tie-explained on this device class.
+            mode = "scan_rescue"
         if strategy != "wavefront":
             pad_mode = "f32"
         elif mode == "exact_hi2":
@@ -1662,6 +1835,38 @@ class TpuMatcher(Matcher):
             live_idx=arrs["live_idx"],
             db_live=arrs["db_live"])
 
+    def prefetch_level(self, job: LevelJob) -> None:
+        """Warm the next level's host-side caches while the previous
+        level's program is in flight (pipelined driver, perf PR 8).
+
+        Strictly cache-warming: content-hashed device uploads of the
+        host planes (utils/devcache.py) and the shape-keyed schedule /
+        gather-map caches.  `build_features` consults the SAME caches on
+        dispatch and recomputes on any miss, so a skipped, failed, or
+        racing prefetch changes timing only — bit-identity with the
+        sequential driver holds by construction.  `b_filt_coarse` is the
+        chained device plane (nothing to warm) and is deliberately not
+        touched here."""
+        from image_analogies_tpu.utils.devcache import device_put_cached
+
+        spec = job.spec
+        strategy = self.params.strategy
+        if strategy == "auto":
+            strategy = "wavefront"
+        for plane in (job.a_src, job.a_filt, job.a_src_coarse,
+                      job.a_filt_coarse, job.a_temporal, job.b_src,
+                      job.b_src_coarse, job.b_temporal):
+            if isinstance(plane, np.ndarray):
+                device_put_cached(plane, _F32)
+        hb, wb = job.b_shape
+        if strategy == "wavefront":
+            # the numpy segment construction is the host-expensive part
+            # (the device_put in _diag_schedule is per-call on purpose:
+            # fresh buffers keep the donated runners safe)
+            _diag_schedule_np(hb, wb, spec.fine_size // 2 + 1)
+        else:
+            _gather_maps_device(hb, wb, spec.fine_size)
+
     # ------------------------------------------------------------- protocol
 
     def best_match(self, db: TpuLevelDB, job: LevelJob, q: int,
@@ -1721,9 +1926,30 @@ class TpuMatcher(Matcher):
                 wk_shard=db.db_pad, dbl_shard=db.dblive_sharded)
             bp, s, n_coh = bp[0], s[0], n_coh[0]
         elif db.strategy == "batched":
-            bp, s, counts = _run_batched(db, jnp.float32(job.kappa_mult))
+            if job.donate:
+                import dataclasses
+
+                # maps come from the _gather_maps_device cache — split
+                # them out of the donated argument (see the twin's note)
+                maps = (db.flat_idx, db.valid, db.written)
+                nf = int(db.off.shape[0])
+                slim = dataclasses.replace(
+                    db, flat_idx=jnp.zeros((1, nf), jnp.int32),
+                    valid=jnp.zeros((1, nf), _F32),
+                    written=jnp.zeros((1, nf), _F32))
+                bp, s, counts = _run_batched_donated(
+                    _donation_safe_db(slim), maps,
+                    jnp.float32(job.kappa_mult))
+            else:
+                bp, s, counts = _run_batched(db, jnp.float32(job.kappa_mult))
             n_coh, n_ref = counts[0], counts[1]
+        elif job.donate and db.strategy == "wavefront":
+            _wavefront_rows_guard(db)  # host side: jit cache skips traces
+            bp, s, n_coh = _run_wavefront_donated(
+                _donation_safe_db(db), jnp.float32(job.kappa_mult))
         else:
+            if db.strategy == "wavefront":
+                _wavefront_rows_guard(db)
             runner = _RUNNERS[db.strategy]
             bp, s, n_coh = runner(db, jnp.float32(job.kappa_mult))
         hb, wb = job.b_shape

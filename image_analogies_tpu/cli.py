@@ -577,6 +577,7 @@ def cmd_bench(args) -> int:
         os.path.dirname(os.path.abspath(__file__)))
     trajectory = bench.load_trajectory(bench_dir)
     fresh = None
+    fresh_gap = None
     if args.value is not None:
         fresh = args.value
     elif args.result is not None:
@@ -589,6 +590,8 @@ def cmd_bench(args) -> int:
             return 2
         if isinstance(doc, dict) and "value" in doc:
             fresh = float(doc["value"])
+            if doc.get("host_gap_ms") is not None:
+                fresh_gap = float(doc["host_gap_ms"])
         else:
             head = bench.extract_headline(doc if isinstance(doc, dict)
                                           else {})
@@ -597,8 +600,10 @@ def cmd_bench(args) -> int:
                       file=sys.stderr)
                 return 2
             fresh = head["value"]
+            fresh_gap = head.get("host_gap_ms")
     verdict = bench.check_regression(trajectory, fresh_value=fresh,
-                                     threshold_pct=args.threshold)
+                                     threshold_pct=args.threshold,
+                                     fresh_gap=fresh_gap)
     print(json.dumps(verdict, sort_keys=True))
     for problem in verdict.get("problems", []):
         print(f"bench: warning: {problem}", file=sys.stderr)

@@ -216,6 +216,44 @@ class AnalogyParams:
     # 1 GiB default, env IA_DEVCACHE_BYTES overrides.
     devcache_max_bytes: Optional[int] = None
 
+    # Async pipelined engine (perf PR 8).
+    # Host/device overlap: while level d's program is in flight, a helper
+    # thread warms level d-1's host-side inputs (devcache uploads, the
+    # anti-diagonal schedule, gather maps) so the next dispatch finds hot
+    # caches instead of doing that work on the critical path.  Prefetch
+    # only WARMS content/shape-keyed caches — the dispatch path consults
+    # the same caches and recomputes on a miss, so results are
+    # bit-identical to the sequential driver by construction.  None
+    # (default) = auto: on when level_sync=False and level_retries == 0
+    # (the bench configuration); True forces it (including on CPU, for
+    # the bit-identity tests); False disables.  level_retries > 0 always
+    # disables it: a prefetch fault would surface OUTSIDE the §5.3 retry
+    # wrapper, so lock-step mode stays strictly sequential.
+    pipeline: Optional[bool] = None
+    # Buffer donation: the per-level runners and the chained coarser-B'
+    # plane run through donate_argnums twins so XLA reuses the level's
+    # input buffers for its outputs instead of allocating fresh HBM.
+    # None (default) = auto: donate when running on a real TPU backend
+    # and nothing else can read the donated buffers (level_retries == 0,
+    # no keep_levels/checkpoint/save_levels consumers); True forces the
+    # donating code path even on CPU, where jax ignores donation with a
+    # warning — semantics identical, which is what the bit-identity test
+    # pins; False disables.  level_retries > 0 always disables donation:
+    # retries rebuild from host copies and must be able to re-read every
+    # input (§5.3 fault model).
+    donate_buffers: Optional[bool] = None
+    # Opt-in bf16 candidate scoring for the wavefront anchor scan: score
+    # the candidate sweep in bf16 (half the HBM traffic), then re-score
+    # the top-k survivors in exact f32 with the engine's lowest-index
+    # tie-break.  OFF by default and gated behind the oracle-parity
+    # audit: first use on a device runs a small probe twice (exact vs
+    # bf16) and audits the source maps (utils/parity.py); any mismatch
+    # not explained as an exact/fp tie auto-disables the flag for the
+    # process (counter bf16.disabled_unexplained, event bf16_gate).
+    # Unlike IA_EXPERIMENTAL match modes, this is a supported production
+    # flag BECAUSE of that gate — it refuses to run non-parity.
+    bf16_scoring: bool = False
+
     def __post_init__(self):
         if self.levels < 1:
             raise ValueError(f"levels must be >= 1, got {self.levels}")
@@ -259,6 +297,24 @@ class AnalogyParams:
             raise ValueError(
                 "devcache_max_bytes must be positive when set, got "
                 f"{self.devcache_max_bytes}")
+        if self.bf16_scoring and self.backend != "tpu":
+            raise ValueError(
+                "bf16_scoring applies to the TPU wavefront scan; "
+                f"backend {self.backend!r} has no bf16 candidate path")
+        if self.bf16_scoring and self.strategy not in ("wavefront", "auto"):
+            raise ValueError(
+                "bf16_scoring requires strategy 'wavefront' or 'auto', "
+                f"got {self.strategy!r}")
+
+    def pipeline_active(self) -> bool:
+        """Resolved pipeline flag: explicit setting wins, auto enables the
+        prefetch thread only in the async-dispatch configuration; retries
+        always force lock-step (see the `pipeline` field comment)."""
+        if self.level_retries > 0:
+            return False
+        if self.pipeline is not None:
+            return self.pipeline
+        return not self.level_sync
 
     def replace(self, **kw) -> "AnalogyParams":
         return dataclasses.replace(self, **kw)

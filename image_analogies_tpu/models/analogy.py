@@ -45,6 +45,14 @@ class AnalogyResult:
     # tie-audit (utils/parity.py) re-scores mismatched picks against the
     # exact per-level decision context
     levels: Optional[List] = None
+    # Run-level wall-clock accounting (ms), filled by the driver:
+    # host_gap_ms   — host time between successive level dispatches (the
+    #                 window the pipeline tries to hide under the device)
+    # prep_ms / wait_ms / host_hidden_ms — pipeline prefetch worker time,
+    #                 time the driver blocked joining it, and the
+    #                 difference (host work actually overlapped)
+    # donated_levels / prepped_levels — level counts for the two modes
+    timing: Dict[str, float] = field(default_factory=dict)
 
     @property
     def source_map(self) -> np.ndarray:
@@ -197,113 +205,240 @@ def _create_image_analogy(a, ap, b, params, backend, temporal_prev,
     stats: List[Dict[str, Any]] = []
     digest = ckpt.run_digest(params, a_src.shape[:2], b_src.shape[:2])
 
+    # --- async pipeline + donation consent (perf round 8) -------------
+    # Donation frees each level's chained B' plane the moment the next
+    # level's scan consumes it — but ONLY when the driver can prove no
+    # other reader exists.  The hard disables win over an explicit
+    # donate_buffers=True: retries rebuild from the chained plane
+    # (§5.3), keep_levels/checkpoints/save_levels all re-read it.
+    donate_levels = False
+    if (params.level_retries == 0 and not keep_levels
+            and not params.checkpoint_dir and not params.save_levels_dir):
+        if params.donate_buffers is not None:
+            donate_levels = params.donate_buffers
+        elif params.backend == "tpu":
+            import jax
+
+            # auto: only where donation actually reuses memory (real
+            # TPU); the CPU backend ignores donate_argnums with a
+            # warning, so auto stays quiet there.
+            donate_levels = jax.default_backend() == "tpu"
+    # Pipelining overlaps NEXT-level host prep (upload/schedule cache
+    # warming via Matcher.prefetch_level) with the in-flight device
+    # program.  Auto = on exactly when dispatches are async
+    # (level_sync=False); level_retries>0 always disables it so chaos
+    # faults keep firing inside the retry envelope.
+    pipeline_on = params.pipeline_active()
+    prefetch_pool = None
+    pending_prefetch = None
+    timing: Dict[str, float] = {"host_gap_ms": 0.0}
+    if pipeline_on:
+        timing.update(prep_ms=0.0, wait_ms=0.0, host_hidden_ms=0.0,
+                      prepped_levels=0.0)
+    if donate_levels:
+        timing["donated_levels"] = 0.0
+
+    def _prefetch_worker(pf_job):
+        # Cache-warming only; ANY failure is swallowed — the main-path
+        # dispatch redoes the work (and hits chaos sites) on a cold
+        # cache, changing timing but never results.
+        t0 = time.perf_counter()
+        try:
+            backend.prefetch_level(pf_job)
+        except Exception:
+            obs_metrics.inc("pipeline.prefetch_errors")
+        return (time.perf_counter() - t0) * 1e3
+
     prof = contextlib.nullcontext()
     if params.profile_dir:
         import jax
 
         prof = jax.profiler.trace(params.profile_dir)
 
-    with prof:
-        for level in range(levels - 1, -1, -1):  # coarsest -> finest
-            if (params.checkpoint_dir and params.resume_from_level is not None
-                    and level > params.resume_from_level):
-                loaded = ckpt.load_level(params.checkpoint_dir, level,
-                                         digest=digest)
-                if loaded is not None:
-                    bp_pyr[level], s_pyr[level] = loaded
-                    ialog.emit({"event": "resume_level", "level": level},
-                               params.log_path)
-                    continue
-            with obs_trace.span("level", level=level):
-                spec = spec_for_level(params, level, levels, src_channels,
-                                      temporal=temporal)
-                job = LevelJob(
-                    level=level,
-                    spec=spec,
-                    kappa_mult=params.kappa_factor(level) ** 2,
-                    a_src=a_src_pyr[level],
-                    a_filt=a_filt_pyr[level],
-                    b_src=b_src_pyr[level],
-                    a_src_coarse=(a_src_pyr[level + 1]
-                                  if level + 1 < levels else None),
-                    a_filt_coarse=(a_filt_pyr[level + 1]
-                                   if level + 1 < levels else None),
-                    b_src_coarse=(b_src_pyr[level + 1]
-                                  if level + 1 < levels else None),
-                    b_filt_coarse=(bp_pyr[level + 1]
-                                   if level + 1 < levels else None),
-                    a_temporal=(a_filt_pyr[level] if temporal else None),
-                    b_temporal=(b_temporal_pyr[level]
-                                if temporal else None),
-                )
-                t0 = time.perf_counter()
+    gap_t0 = None  # perf_counter at the previous level's dispatch return
+    try:
+        with prof:
+            for level in range(levels - 1, -1, -1):  # coarsest -> finest
+                if pending_prefetch is not None:
+                    # join the helper BEFORE touching this level: from
+                    # here on the caches it warms are read on this thread
+                    twait = time.perf_counter()
+                    with obs_trace.span("pipeline.wait", level=level):
+                        prep_ms = pending_prefetch.result()
+                    wait_ms = (time.perf_counter() - twait) * 1e3
+                    pending_prefetch = None
+                    timing["prep_ms"] += prep_ms
+                    timing["wait_ms"] += wait_ms
+                    timing["host_hidden_ms"] += max(prep_ms - wait_ms, 0.0)
+                    timing["prepped_levels"] += 1.0
+                if (params.checkpoint_dir
+                        and params.resume_from_level is not None
+                        and level > params.resume_from_level):
+                    loaded = ckpt.load_level(params.checkpoint_dir, level,
+                                             digest=digest)
+                    if loaded is not None:
+                        bp_pyr[level], s_pyr[level] = loaded
+                        ialog.emit({"event": "resume_level", "level": level},
+                                   params.log_path)
+                        continue
+                with obs_trace.span("level", level=level):
+                    spec = spec_for_level(params, level, levels,
+                                          src_channels, temporal=temporal)
+                    job = LevelJob(
+                        level=level,
+                        spec=spec,
+                        kappa_mult=params.kappa_factor(level) ** 2,
+                        a_src=a_src_pyr[level],
+                        a_filt=a_filt_pyr[level],
+                        b_src=b_src_pyr[level],
+                        a_src_coarse=(a_src_pyr[level + 1]
+                                      if level + 1 < levels else None),
+                        a_filt_coarse=(a_filt_pyr[level + 1]
+                                       if level + 1 < levels else None),
+                        b_src_coarse=(b_src_pyr[level + 1]
+                                      if level + 1 < levels else None),
+                        b_filt_coarse=(bp_pyr[level + 1]
+                                       if level + 1 < levels else None),
+                        a_temporal=(a_filt_pyr[level] if temporal else None),
+                        b_temporal=(b_temporal_pyr[level]
+                                    if temporal else None),
+                        donate=donate_levels,
+                    )
+                    t0 = time.perf_counter()
+                    if gap_t0 is not None:
+                        timing["host_gap_ms"] += (t0 - gap_t0) * 1e3
 
-                def _level():
-                    chaos.site("level.dispatch", level=level)
-                    db = backend.build_features(job)
-                    return backend.synthesize_level(db, job)
+                    def _level():
+                        chaos.site("level.dispatch", level=level)
+                        db = backend.build_features(job)
+                        return backend.synthesize_level(db, job)
 
-                def _dispatch():
-                    # watchdog wraps the whole dispatch INSIDE the retry
-                    # body: a wedged op raises WatchdogTimeout (transient)
-                    # and the retry wrapper re-runs the level instead of
-                    # the process hanging.  timeout 0 = inline, no thread.
-                    return failure.run_with_watchdog(
-                        _level, params.dispatch_timeout_s,
+                    def _dispatch():
+                        # watchdog wraps the whole dispatch INSIDE the
+                        # retry body: a wedged op raises WatchdogTimeout
+                        # (transient) and the retry wrapper re-runs the
+                        # level instead of the process hanging.  timeout
+                        # 0 = inline, no thread.
+                        return failure.run_with_watchdog(
+                            _level, params.dispatch_timeout_s,
+                            context={"level": level},
+                            log_path=params.log_path)
+
+                    # §5.3: transient device faults retry at level
+                    # granularity
+                    bp, s, st = failure.run_with_retry(
+                        _dispatch, retries=params.level_retries,
                         context={"level": level}, log_path=params.log_path)
+                    gap_t0 = time.perf_counter()
+                    st["total_ms"] = (gap_t0 - t0) * 1e3
+                    if donate_levels and level + 1 < levels:
+                        # the scan consumed (donated) the coarser B'
+                        # buffer — drop the dead reference so nothing can
+                        # read it; the coarser s is merely unreferenced
+                        bp_pyr[level + 1] = None
+                        s_pyr[level + 1] = None
+                        timing["donated_levels"] += 1.0
+                        obs_metrics.inc("pipeline.donated_levels")
+                    if pipeline_on and level > 0:
+                        # the device program for `level` is (at most
+                        # enqueue-deep) in flight: warm the NEXT level's
+                        # host-side caches under it
+                        nxt = level - 1
+                        pf_job = LevelJob(
+                            level=nxt,
+                            spec=spec_for_level(params, nxt, levels,
+                                                src_channels,
+                                                temporal=temporal),
+                            kappa_mult=params.kappa_factor(nxt) ** 2,
+                            a_src=a_src_pyr[nxt],
+                            a_filt=a_filt_pyr[nxt],
+                            b_src=b_src_pyr[nxt],
+                            a_src_coarse=a_src_pyr[level],
+                            a_filt_coarse=a_filt_pyr[level],
+                            b_src_coarse=b_src_pyr[level],
+                            b_filt_coarse=None,  # in flight — never touched
+                            a_temporal=(a_filt_pyr[nxt]
+                                        if temporal else None),
+                            b_temporal=(b_temporal_pyr[nxt]
+                                        if temporal else None),
+                        )
+                        if prefetch_pool is None:
+                            from concurrent.futures import \
+                                ThreadPoolExecutor
 
-                # §5.3: transient device faults retry at level granularity
-                bp, s, st = failure.run_with_retry(
-                    _dispatch, retries=params.level_retries,
-                    context={"level": level}, log_path=params.log_path)
-                st["total_ms"] = (time.perf_counter() - t0) * 1e3
-                # bp/s may be DEVICE arrays (TPU backend): levels chain
-                # through them without host round-trips (the tunnel moves
-                # ~9 MB/s); host copies are fetched only for opt-in host
-                # consumers below and for the final result.  EXCEPT with
-                # level retries armed: the §5.3 fault model promises a
-                # retried level rebuilds from buffers that survive a
-                # device reset, and the coarser plane chained on-device
-                # could be invalidated by the very fault being retried —
-                # so fault-recovery runs keep the pre-chaining host copies
-                # (round-3 ADVICE item 1).
-                if params.level_retries > 0:
-                    bp, s = (np.asarray(bp, np.float32),
-                             np.asarray(s, np.int32))
-                bp_pyr[level], s_pyr[level] = bp, s
-                if params.log_path or "_n_coh" not in st:
-                    # stream the record now: always when a log file is
-                    # configured (observability opt-in pays the ~0.1 s
-                    # scalar fetch), and always for records with no
-                    # deferred device scalars (CPU backend — deferral
-                    # would only delay logs)
-                    ialog.emit(_finalize_stats(st), params.log_path)
-                    st["_emitted"] = True
-                stats.append(st)
-                if params.checkpoint_dir:
-                    ckpt.save_level(params.checkpoint_dir, level,
-                                    np.asarray(bp, np.float32),
-                                    np.asarray(s, np.int32), digest=digest)
-                if params.save_levels_dir:
-                    from image_analogies_tpu.utils.imageio import save_image
-                    import os
+                            prefetch_pool = ThreadPoolExecutor(
+                                max_workers=1,
+                                thread_name_prefix="ia-prefetch")
+                        pending_prefetch = prefetch_pool.submit(
+                            _prefetch_worker, pf_job)
+                    # bp/s may be DEVICE arrays (TPU backend): levels
+                    # chain through them without host round-trips (the
+                    # tunnel moves ~9 MB/s); host copies are fetched only
+                    # for opt-in host consumers below and for the final
+                    # result.  EXCEPT with level retries armed: the §5.3
+                    # fault model promises a retried level rebuilds from
+                    # buffers that survive a device reset, and the
+                    # coarser plane chained on-device could be
+                    # invalidated by the very fault being retried — so
+                    # fault-recovery runs keep the pre-chaining host
+                    # copies (round-3 ADVICE item 1).
+                    if params.level_retries > 0:
+                        bp, s = (np.asarray(bp, np.float32),
+                                 np.asarray(s, np.int32))
+                    bp_pyr[level], s_pyr[level] = bp, s
+                    if params.log_path or "_n_coh" not in st:
+                        # stream the record now: always when a log file
+                        # is configured (observability opt-in pays the
+                        # ~0.1 s scalar fetch), and always for records
+                        # with no deferred device scalars (CPU backend —
+                        # deferral would only delay logs)
+                        ialog.emit(_finalize_stats(st), params.log_path)
+                        st["_emitted"] = True
+                    stats.append(st)
+                    if params.checkpoint_dir:
+                        ckpt.save_level(params.checkpoint_dir, level,
+                                        np.asarray(bp, np.float32),
+                                        np.asarray(s, np.int32),
+                                        digest=digest)
+                    if params.save_levels_dir:
+                        from image_analogies_tpu.utils.imageio import \
+                            save_image
+                        import os
 
-                    os.makedirs(params.save_levels_dir, exist_ok=True)
-                    save_image(os.path.join(params.save_levels_dir,
-                                            f"level_{level:02d}.png"),
-                               np.clip(np.asarray(bp, np.float32),
-                                       0.0, 1.0))
-                # per-level HBM watermark (hbm.peak_bytes.d<N> peak
-                # gauges): one bool check when metrics are off, and a
-                # silent no-op on backends with no allocator stats (CPU)
-                obs_device.record_hbm(level, params.log_path)
+                        os.makedirs(params.save_levels_dir, exist_ok=True)
+                        save_image(os.path.join(params.save_levels_dir,
+                                                f"level_{level:02d}.png"),
+                                   np.clip(np.asarray(bp, np.float32),
+                                           0.0, 1.0))
+                    # per-level HBM watermark (hbm.peak_bytes.d<N> peak
+                    # gauges): one bool check when metrics are off, and a
+                    # silent no-op on backends with no allocator stats
+                    # (CPU)
+                    obs_device.record_hbm(level, params.log_path)
+    finally:
+        if prefetch_pool is not None:
+            prefetch_pool.shutdown(wait=True)
+
+    # pipeline-overlap accounting: `ia report` renders these gauges as
+    # the "how much host prep the device hid" section; host_gap_ms is
+    # recorded unconditionally so `ia bench --check` can gate it even on
+    # non-pipelined baselines
+    obs_metrics.set_gauge("pipeline.host_gap_ms", timing["host_gap_ms"])
+    if pipeline_on:
+        for k in ("prep_ms", "wait_ms", "host_hidden_ms"):
+            obs_metrics.set_gauge(f"pipeline.{k}", timing[k])
+        obs_metrics.inc("pipeline.levels_prepped",
+                        int(timing["prepped_levels"]))
 
     # ONE fetch call for the deferred device scalars AND the finest B'
     # plane: `jax.device_get` on the pair starts both transfers before
     # blocking, so the stats' scalar round-trip (~0.1 s of tunnel
     # latency) hides under the 4 MB plane transfer instead of preceding
     # it serially (round-5; each np.asarray is its own blocking
-    # round-trip)
+    # round-trip).  When a host copy of the finest source map is needed
+    # anyway (source_rgb gather, keep_levels), its transfer joins the
+    # same bundle instead of a separate blocking np.asarray afterwards.
+    need_s_host = params.color_mode == "source_rgb" or keep_levels
     dev = [(st, k) for st in stats for k in ("_n_coh", "_n_ref")
            if k in st and not isinstance(st[k], (int, float, np.number))]
     if dev:
@@ -311,14 +446,19 @@ def _create_image_analogy(a, ap, b, params, backend, temporal_prev,
         import jax.numpy as jnp
 
         with obs_trace.span("fetch"):
-            vals, bp_fetched = jax.device_get(
-                (jnp.stack([st[k] for st, k in dev]), bp_pyr[0]))
+            bundle = (jnp.stack([st[k] for st, k in dev]), bp_pyr[0]) + (
+                (s_pyr[0],) if need_s_host else ())
+            got = jax.device_get(bundle)
+        vals, bp_fetched = got[0], got[1]
         for (st, k), v in zip(dev, vals):
             st[k] = float(v)
         bp_y = np.asarray(bp_fetched, np.float32)
+        s_raw = np.asarray(got[2], np.int32) if need_s_host else s_pyr[0]
         obs_metrics.inc("fetch.bytes", int(vals.nbytes) + int(bp_y.nbytes))
     else:
         bp_y = np.asarray(bp_pyr[0], np.float32)
+        s_raw = (np.asarray(s_pyr[0], np.int32) if need_s_host
+                 else s_pyr[0])
     for st in stats:
         _finalize_stats(st)  # no-op where the streaming path already did
         if not st.pop("_emitted", False):
@@ -330,12 +470,10 @@ def _create_image_analogy(a, ap, b, params, backend, temporal_prev,
             if cr is not None and px:
                 obs_metrics.inc("kappa.coherence_px", cr * px)
                 obs_metrics.inc("kappa.total_px", px)
-    # the source map stays a DEVICE array unless a host consumer needs it
-    # here (source_rgb's color gather, keep_levels' audit planes) — it is
-    # introspection metadata, fetched lazily by AnalogyResult.source_map
-    s_raw = s_pyr[0]
-    if params.color_mode == "source_rgb" or keep_levels:
-        s_raw = np.asarray(s_raw, np.int32)
+    # the source map stays a DEVICE array unless a host consumer needed
+    # it above (source_rgb's color gather, keep_levels' audit planes —
+    # fetched in the fused bundle) — it is introspection metadata,
+    # fetched lazily by AnalogyResult.source_map
     if params.color_mode == "source_rgb":
         ap_flat = ap_rgb.reshape(-1, ap_rgb.shape[-1]) if ap_rgb.ndim == 3 \
             else ap_rgb.reshape(-1)
@@ -355,4 +493,4 @@ def _create_image_analogy(a, ap, b, params, backend, temporal_prev,
             for lv in range(1, levels)]
     return AnalogyResult(
         bp=out, bp_y=bp_y, source_map_raw=s_raw, stats=stats,
-        levels=(levels_np if keep_levels else None))
+        levels=(levels_np if keep_levels else None), timing=timing)

@@ -283,6 +283,14 @@ def _sharded_phase(a, ap, frames, params: AnalogyParams, mesh,
             # §5.3: retried levels must rebuild from host-resident state
             bp, s = np.asarray(bp, np.float32), np.asarray(s, np.int32)
         bp_stacks[level], s_stacks[level] = bp, s
+        if level + 1 < levels:
+            # this level's query build was the coarser stacks' last
+            # reader (retries of THIS level already resolved above):
+            # drop the references so the t_pad-wide (t, Nb) planes free
+            # now instead of at phase end — on the mesh path the stacks
+            # are the dominant per-level HBM residue
+            bp_stacks[level + 1] = None
+            s_stacks[level + 1] = None
         if ck_dir:
             ckpt.save_level(ck_dir, level, np.asarray(bp, np.float32),
                             np.asarray(s, np.int32), digest=digest)
@@ -306,8 +314,19 @@ def _sharded_phase(a, ap, frames, params: AnalogyParams, mesh,
             ialog.emit({k: v for k, v in rec.items()
                         if k != "_n_coh_slot"}, params.log_path)
 
-    # ONE batched fetch resolves every level's deferred coherence counts
-    n_coh_all = np.asarray(jnp.stack([jnp.asarray(c) for c in n_cohs]))
+    # ONE fused fetch for everything the host consumes at phase end: the
+    # deferred per-level coherence counts AND the finest level's stacked
+    # planes — `jax.device_get` on the triple starts all three transfers
+    # before blocking, so the scalar round-trip hides under the plane
+    # payload (the same round-5 fusion the single-chip driver uses)
+    with obs_trace.span("fetch", phase=tag):
+        n_coh_all, bp0, s0 = jax.device_get(
+            (jnp.stack([jnp.asarray(c) for c in n_cohs]),
+             bp_stacks[0], s_stacks[0]))
+    n_coh_all = np.asarray(n_coh_all)
+    bp0 = np.asarray(bp0, np.float32)
+    s0 = np.asarray(s0, np.int32)
+    obs_metrics.inc("fetch.bytes", int(bp0.nbytes) + int(s0.nbytes))
     ratios = {}
     for rec in recs:
         lv_slot, i = rec.pop("_n_coh_slot")
@@ -324,13 +343,7 @@ def _sharded_phase(a, ap, frames, params: AnalogyParams, mesh,
                             rec["coherence_ratio"] * rec["pixels"])
             obs_metrics.inc("kappa.total_px", rec["pixels"])
 
-    # host copies of the FINEST level only — the sole host consumer
     hb, wb = b_src_pyrs[0][0].shape[:2]
-    with obs_trace.span("fetch", phase=tag):
-        bp0 = np.asarray(bp_stacks[0], np.float32)
-        s0 = np.asarray(s_stacks[0], np.int32)
-    obs_metrics.inc("fetch.bytes", int(bp0.nbytes) + int(s0.nbytes))
-
     results = []
     for i in range(t_real):
         bp_y = bp0[i].reshape(hb, wb)

@@ -289,6 +289,32 @@ def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         for dev, v in (hr.get("peaks") or {}).items():
             hbm[dev] = max(hbm.get(dev, 0.0), float(v))
 
+    # --- pipeline-overlap section (driver pipeline.* gauges/counters) -----
+    pipeline_info: Optional[Dict[str, Any]] = None
+    if ("pipeline.host_gap_ms" in gauges
+            or any(k.startswith("pipeline.") for k in counters)):
+        gap = gauges.get("pipeline.host_gap_ms")
+        prep = gauges.get("pipeline.prep_ms")
+        hidden = gauges.get("pipeline.host_hidden_ms")
+        pipeline_info = {
+            # host time between successive level dispatches — the window
+            # prefetch tries to hide; recorded even on sequential runs
+            "host_gap_ms": gap,
+            "prep_ms": prep,
+            "wait_ms": gauges.get("pipeline.wait_ms"),
+            "host_hidden_ms": hidden,
+            "levels_prepped": int(counters.get("pipeline.levels_prepped",
+                                               0)),
+            "donated_levels": int(counters.get("pipeline.donated_levels",
+                                               0)),
+            "prefetch_errors": int(counters.get("pipeline.prefetch_errors",
+                                                0)),
+            # fraction of the prefetch worker's host time that the device
+            # program absorbed (1.0 = fully overlapped)
+            "hidden_fraction": (hidden / prep
+                                if hidden is not None and prep else None),
+        }
+
     # --- SLO section (obs/slo.py counters + run_end gauges) ---------------
     slo_info: Optional[Dict[str, Any]] = None
     if "slo.deadlined" in counters or "slo.target" in gauges:
@@ -319,6 +345,7 @@ def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                               if (hits + misses) else None),
         "compile": compile_info,
         "tune": tune_info,
+        "pipeline": pipeline_info,
         "serve": serve_info,
         "slo": slo_info,
         "journal": journal_info,
@@ -388,7 +415,7 @@ def render(an: Dict[str, Any], run_id: Optional[str] = None) -> str:
     rest = {k: v for k, v in c.items()
             if k not in shown and v
             and not k.startswith(("serve.", "chaos.", "watchdog.",
-                                  "ckpt.", "retry."))}
+                                  "ckpt.", "retry.", "pipeline."))}
     for k in sorted(rest):
         w(f"    {k:<13} {rest[k]:g}")
 
@@ -431,6 +458,27 @@ def render(an: Dict[str, Any], run_id: Optional[str] = None) -> str:
             w(f"    {cfg.get('key', '?'):<36} "
               f"tile_rows={cfg.get('tile_rows')} "
               f"cap={cfg.get('packed_tile_cap')} [{origins}]")
+
+    pl = an.get("pipeline")
+    if pl:
+        w("  pipeline:")
+        gap = pl.get("host_gap_ms")
+        if gap is not None:
+            w(f"    host gap      {gap:.1f} ms between level dispatches")
+        if pl.get("prep_ms") is not None:
+            hid = pl.get("host_hidden_ms") or 0.0
+            frac = pl.get("hidden_fraction")
+            w(f"    overlap       {pl['levels_prepped']} levels prepped, "
+              f"{pl['prep_ms']:.1f} ms prep / {hid:.1f} ms hidden under "
+              f"device"
+              + (f" ({100 * frac:.0f}%)" if frac is not None else ""))
+            w(f"    join wait     {pl.get('wait_ms', 0.0):.1f} ms")
+        if pl.get("donated_levels"):
+            w(f"    donation      {pl['donated_levels']} levels donated "
+              "their chained B' buffer")
+        if pl.get("prefetch_errors"):
+            w(f"    prefetch errs {pl['prefetch_errors']} (swallowed — "
+              "main path rebuilt cold)")
 
     srv = an.get("serve")
     if srv:

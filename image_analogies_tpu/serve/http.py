@@ -18,8 +18,16 @@ API:
                         files, so anything else answers 400)}
                         reply {"request", "status", "bp", "timings", ...}
 
-Planes are nested JSON lists of floats — fine for a loopback demo
-transport, not a production wire format (see ROADMAP follow-ups).
+Content negotiation (serve/wire.py): JSON is the DEFAULT both ways.  A
+request with ``Content-Type: application/x-ia-f32`` ships the three
+planes as one length-prefixed raw-f32 frame (order a, a', b) with
+``deadline_ms`` / ``idempotency_key`` moved to the ``X-IA-Deadline-Ms``
+/ ``X-IA-Idempotency-Key`` headers; a request with that type in its
+``Accept`` header gets B' back as a single-array frame, the JSON
+metadata fields relocated to ``X-IA-Request``/``X-IA-Status``/
+``X-IA-Degraded``/``X-IA-Batch-Size``/``X-IA-Timings`` response
+headers.  The two directions negotiate independently (binary in / JSON
+out and vice versa both work); errors are always JSON.
 """
 
 from __future__ import annotations
@@ -32,6 +40,7 @@ import numpy as np
 
 from image_analogies_tpu.obs import live as obs_live
 from image_analogies_tpu.serve import journal as serve_journal
+from image_analogies_tpu.serve import wire
 from image_analogies_tpu.serve.server import Server
 from image_analogies_tpu.serve.types import DeadlineExceeded, Rejected
 
@@ -74,17 +83,32 @@ def _make_handler(server: Server):
             if self.path != "/v1/analogy":
                 self._reply(404, {"error": "not_found"})
                 return
+            ctype = (self.headers.get("Content-Type") or "").split(";")[0]
+            binary_in = ctype.strip().lower() == wire.CONTENT_TYPE
             try:
                 length = int(self.headers.get("Content-Length", "0"))
-                req = json.loads(self.rfile.read(length) or b"{}")
-                a = np.asarray(req["a"], dtype=np.float32)
-                ap = np.asarray(req["ap"], dtype=np.float32)
-                b = np.asarray(req["b"], dtype=np.float32)
+                body = self.rfile.read(length)
+                if binary_in:
+                    planes = wire.decode_planes(body)
+                    if len(planes) != 3:
+                        raise wire.WireError(
+                            f"expected 3 planes (a, a', b), got "
+                            f"{len(planes)}")
+                    a, ap, b = planes
+                    deadline_ms = self.headers.get("X-IA-Deadline-Ms")
+                    if deadline_ms is not None:
+                        deadline_ms = float(deadline_ms)
+                    idem = self.headers.get("X-IA-Idempotency-Key")
+                else:
+                    req = json.loads(body or b"{}")
+                    a = np.asarray(req["a"], dtype=np.float32)
+                    ap = np.asarray(req["ap"], dtype=np.float32)
+                    b = np.asarray(req["b"], dtype=np.float32)
+                    deadline_ms = req.get("deadline_ms")
+                    idem = req.get("idempotency_key")
             except (KeyError, ValueError, json.JSONDecodeError) as exc:
                 self._reply(400, {"error": "bad_request", "detail": str(exc)})
                 return
-            deadline_ms = req.get("deadline_ms")
-            idem = req.get("idempotency_key")
             if idem is not None:
                 idem = str(idem)
                 if not serve_journal.valid_idem(idem):
@@ -109,14 +133,31 @@ def _make_handler(server: Server):
                 self._reply(500, {"error": "dispatch_failed",
                                   "detail": str(exc)})
                 return
+            timings = {"queue_ms": round(resp.queue_ms, 3),
+                       "dispatch_ms": round(resp.dispatch_ms, 3),
+                       "total_ms": round(resp.total_ms, 3)}
+            accept = (self.headers.get("Accept") or "")
+            if wire.CONTENT_TYPE in accept.lower():
+                frame = wire.encode_planes([np.asarray(resp.bp,
+                                                       np.float32)])
+                self.send_response(200)
+                self.send_header("Content-Type", wire.CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(frame)))
+                self.send_header("X-IA-Request", resp.request_id)
+                self.send_header("X-IA-Status", resp.status)
+                self.send_header("X-IA-Degraded",
+                                 "1" if resp.degraded else "0")
+                self.send_header("X-IA-Batch-Size", str(resp.batch_size))
+                self.send_header("X-IA-Timings", json.dumps(timings))
+                self.end_headers()
+                self.wfile.write(frame)
+                return
             self._reply(200, {
                 "request": resp.request_id,
                 "status": resp.status,
                 "degraded": resp.degraded,
                 "batch_size": resp.batch_size,
-                "timings": {"queue_ms": round(resp.queue_ms, 3),
-                            "dispatch_ms": round(resp.dispatch_ms, 3),
-                            "total_ms": round(resp.total_ms, 3)},
+                "timings": timings,
                 "bp": resp.bp.tolist(),
             })
 

@@ -19,6 +19,8 @@ from image_analogies_tpu.tune.geometry import (
     ARGMIN_TILE,
     DEFAULT_PACKED_TILE_CAP,
     DEFAULT_PACKED_VMEM_LIMIT,
+    DEFAULT_WAVEFRONT_MAX_ROWS,
+    WAVEFRONT_MAX_ROWS_CEILING,
     default_tile_rows,
     scan_tile_rows,
     vmem_bounded_tile_cap,
@@ -39,6 +41,7 @@ from image_analogies_tpu.tune.resolve import (
     scan_tile,
     snap_tile_to_divisor,
     tile_rows,
+    wavefront_max_rows,
 )
 from image_analogies_tpu.tune.store import (
     SCHEMA_VERSION,
@@ -53,6 +56,8 @@ __all__ = [
     "ARGMIN_TILE",
     "DEFAULT_PACKED_TILE_CAP",
     "DEFAULT_PACKED_VMEM_LIMIT",
+    "DEFAULT_WAVEFRONT_MAX_ROWS",
+    "WAVEFRONT_MAX_ROWS_CEILING",
     "SCHEMA_VERSION",
     "TuneConfig",
     "bucket_rows",
@@ -76,4 +81,5 @@ __all__ = [
     "store_path",
     "tile_rows",
     "vmem_bounded_tile_cap",
+    "wavefront_max_rows",
 ]

@@ -24,6 +24,15 @@ ARGMIN_TILE = 8192
 DEFAULT_PACKED_TILE_CAP = 16384
 DEFAULT_PACKED_VMEM_LIMIT = 110 * 2 ** 20
 
+# Wavefront host-scheduling bound (legacy _WAVEFRONT_MAX_ROWS): the scan
+# carry stores source-map indices as exact f32 values, so the A row count
+# must stay below 2^24 (the f32 integer-exactness limit).  4096x4096
+# exemplars fit; anything larger must shard.  Tunable only DOWN from the
+# correctness ceiling (a host with a slow schedule builder may cap rows
+# earlier); resolve.py clamps any larger configured value back to this.
+WAVEFRONT_MAX_ROWS_CEILING = 1 << 24
+DEFAULT_WAVEFRONT_MAX_ROWS = WAVEFRONT_MAX_ROWS_CEILING
+
 
 def round_up(n: int, m: int) -> int:
     return -(-n // m) * m

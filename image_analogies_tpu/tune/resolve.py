@@ -11,9 +11,10 @@ shape-bucket)`` with per-knob precedence:
 - **override**: the autotuner brackets its timed candidates with
   :func:`override` so the swept value flows through the SAME call sites
   production uses.
-- **env**: ``IA_TILE_ROWS`` / ``IA_PACKED_TILE`` / ``IA_PACKED_VMEM``,
-  parsed at CALL time (the legacy module-import read silently ignored
-  later changes); invalid values warn once and are ignored.
+- **env**: ``IA_TILE_ROWS`` / ``IA_PACKED_TILE`` / ``IA_PACKED_VMEM`` /
+  ``IA_WAVEFRONT_ROWS``, parsed at CALL time (the legacy module-import
+  read silently ignored later changes); invalid values warn once and are
+  ignored.
 - **store**: :mod:`tune.store` entries — exact key first, then the
   bucket-wildcard key (``...|b*``) so one measured winner can cover all
   row counts of a device/strategy/dtype/F combination.
@@ -56,6 +57,7 @@ _ENV_VARS = {
     "tile_rows": "IA_TILE_ROWS",
     "packed_tile_cap": "IA_PACKED_TILE",
     "packed_vmem_limit": "IA_PACKED_VMEM",
+    "wavefront_max_rows": "IA_WAVEFRONT_ROWS",
 }
 
 _TLS = threading.local()  # .overrides: Dict[str, int] while tuner active
@@ -78,6 +80,10 @@ class TuneConfig:
     packed_vmem_limit: int
     origin: Tuple[Tuple[str, str], ...] = field(default=())
     store_key: str = ""
+    # Host-scheduling bound, not a kernel shape: the wavefront scan packs
+    # source-map indices into exact f32, so values are clamped to the
+    # 2^24 correctness ceiling (tune DOWN only; see tune.geometry).
+    wavefront_max_rows: int = _geometry.DEFAULT_WAVEFRONT_MAX_ROWS
 
     def origin_of(self, knob: str) -> str:
         return dict(self.origin).get(knob, "default")
@@ -179,6 +185,7 @@ def _record(cfg: TuneConfig, fp: int, bucket: int) -> None:
                 "tile_rows": cfg.tile_rows,
                 "packed_tile_cap": cfg.packed_tile_cap,
                 "packed_vmem_limit": cfg.packed_vmem_limit,
+                "wavefront_max_rows": cfg.wavefront_max_rows,
                 "origin": origins,
             }
     if _metrics._ACTIVE:
@@ -197,6 +204,7 @@ def _record(cfg: TuneConfig, fp: int, bucket: int) -> None:
                            "tile_rows": cfg.tile_rows,
                            "packed_tile_cap": cfg.packed_tile_cap,
                            "packed_vmem_limit": cfg.packed_vmem_limit,
+                           "wavefront_max_rows": cfg.wavefront_max_rows,
                            "origin": origins, "fp": fp, "bucket": bucket},
                           ctx.log_path)
 
@@ -239,6 +247,7 @@ def resolve(*, strategy: str, dtype: str, fp: int, n_rows: int = 0,
         "tile_rows": _geometry.default_tile_rows(fp),
         "packed_tile_cap": _geometry.DEFAULT_PACKED_TILE_CAP,
         "packed_vmem_limit": _geometry.DEFAULT_PACKED_VMEM_LIMIT,
+        "wavefront_max_rows": _geometry.DEFAULT_WAVEFRONT_MAX_ROWS,
     }
     values: Dict[str, int] = {}
     origin: Dict[str, str] = {}
@@ -261,6 +270,12 @@ def resolve(*, strategy: str, dtype: str, fp: int, n_rows: int = 0,
             values[knob], origin[knob] = int(packaged[knob]), "packaged"
             continue
         values[knob], origin[knob] = dflt, "default"
+
+    # wavefront_max_rows is a correctness ceiling, not a perf sweet spot:
+    # a store/env value may only LOWER it (f32-exact index packing caps
+    # the A row count at 2^24 no matter what anyone configures).
+    values["wavefront_max_rows"] = min(
+        values["wavefront_max_rows"], _geometry.WAVEFRONT_MAX_ROWS_CEILING)
 
     cfg = TuneConfig(key=key, store_key=key,
                      origin=tuple(sorted(origin.items())), **values)
@@ -304,6 +319,17 @@ def packed_tile_cap(hb: int, wb: int, n_off: int, *,
                   n_rows=n_rows, store=store)
     return _geometry.vmem_bounded_tile_cap(
         hb, wb, n_off, cfg.packed_tile_cap, cfg.packed_vmem_limit)
+
+
+def wavefront_max_rows(*, strategy: str = "wavefront", dtype: str = "f32",
+                       fp: int = 128, n_rows: int = 0,
+                       store: Optional[str] = None) -> int:
+    """A-row bound for the wavefront scan (legacy ``_WAVEFRONT_MAX_ROWS``):
+    a host-scheduling knob, clamped by resolution to the f32-exactness
+    ceiling (2^24) — store/env entries can only tighten it."""
+    cfg = resolve(strategy=strategy, dtype=_norm_dtype(dtype), fp=fp,
+                  n_rows=n_rows, store=store)
+    return cfg.wavefront_max_rows
 
 
 def scan_tile(npad: int, fp: int, cap_rows: int = 0, *,

@@ -38,7 +38,8 @@ SCHEMA_VERSION = 1
 
 # Integer knobs an entry may carry; each must be a positive int when
 # present.  Unknown keys are allowed (provenance annotations).
-_KNOBS = ("tile_rows", "packed_tile_cap", "packed_vmem_limit")
+_KNOBS = ("tile_rows", "packed_tile_cap", "packed_vmem_limit",
+          "wavefront_max_rows")
 
 _LOCK = threading.Lock()
 # path -> ((mtime_ns, size), entries)

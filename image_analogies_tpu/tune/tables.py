@@ -26,21 +26,29 @@ from typing import Any, Dict, Optional
 
 _MIB = 2 ** 20
 
+# wavefront_max_rows is a host-scheduling bound, not a kernel shape: the
+# f32 index-packing ceiling (2^24, tune.geometry) is a per-architecture
+# fact, so every class ships it explicitly — provenance reads "packaged"
+# on known hardware instead of "default", and a future class with a
+# different carry encoding can lower it here without code changes.
 TABLES: Dict[str, Dict[str, Dict[str, int]]] = {
     "v4": {
         # Reference class: the legacy defaults WERE the v4 sweep winners.
-        "*": {"packed_tile_cap": 16384, "packed_vmem_limit": 110 * _MIB},
+        "*": {"packed_tile_cap": 16384, "packed_vmem_limit": 110 * _MIB,
+              "wavefront_max_rows": 1 << 24},
     },
     "v5e": {
         # 128 MiB VMEM (see pallas guide) but a narrower core than v4:
         # leave more compiler headroom and keep scan tiles smaller.
-        "*": {"packed_tile_cap": 8192, "packed_vmem_limit": 96 * _MIB},
+        "*": {"packed_tile_cap": 8192, "packed_vmem_limit": 96 * _MIB,
+              "wavefront_max_rows": 1 << 24},
         "wavefront|bf16": {"tile_rows": 2048},
     },
     "v5p": {
         # More VMEM headroom + HBM bandwidth: larger tiles amortize the
         # per-grid-step overhead better.
-        "*": {"packed_tile_cap": 32768, "packed_vmem_limit": 120 * _MIB},
+        "*": {"packed_tile_cap": 32768, "packed_vmem_limit": 120 * _MIB,
+              "wavefront_max_rows": 1 << 24},
         "wavefront|bf16": {"tile_rows": 8192},
     },
 }

@@ -1,0 +1,336 @@
+"""Async pipelined engine (PR 8): overlap, donation, bf16 gate, wire.
+
+Tier-1 invariants locked here:
+
+- the pipelined + donating engine is BIT-IDENTICAL to the sequential
+  engine (same bp/bp_y/source_map bytes) on the oracle-parity
+  strategies — pipelining is cache warming and donation is memory
+  reuse; neither may touch results;
+- donation safety under §5.3: with level_retries armed the driver
+  refuses donation, keeps host copies, and recovers bit-identically
+  from an injected level.dispatch transient;
+- pipeline accounting: AnalogyResult.timing + pipeline.* gauges and
+  counters, and the `ia report` pipeline section that renders them;
+- bf16_scoring is opt-in, off by default, validated at config time,
+  and gated behind the oracle-parity probe audit;
+- AnalogyResult.source_map performs exactly ONE device transfer no
+  matter how often it is read;
+- serve/wire.py: the length-prefixed raw-f32 frame round-trips, rejects
+  malformed frames, and both directions of the HTTP content
+  negotiation work end-to-end (JSON stays the default).
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from image_analogies_tpu.chaos import inject
+from image_analogies_tpu.chaos.plan import ChaosPlan, SiteRule
+from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.models.analogy import (
+    AnalogyResult,
+    create_image_analogy,
+)
+from image_analogies_tpu.obs import trace as obs_trace
+from image_analogies_tpu.serve import wire
+from tests.conftest import make_pair
+
+
+def _params(**kw):
+    kw.setdefault("levels", 2)
+    kw.setdefault("backend", "tpu")
+    kw.setdefault("strategy", "wavefront")
+    return AnalogyParams(**kw)
+
+
+# ------------------------------------------------- bit-identity
+
+
+@pytest.mark.parametrize("strategy", ["wavefront", "batched"])
+def test_pipelined_donating_engine_bit_identical(strategy):
+    """pipeline=True + donate_buffers=True (forcing both code paths on
+    the CPU jax backend, where donate_argnums is a no-op warning) must
+    produce byte-identical planes to the sequential lock-step engine."""
+    a, ap, b = make_pair(20, 22, seed=5)
+    seq = create_image_analogy(a, ap, b, _params(
+        strategy=strategy, level_sync=True, pipeline=False,
+        donate_buffers=False))
+    pipe = create_image_analogy(a, ap, b, _params(
+        strategy=strategy, level_sync=False, pipeline=True,
+        donate_buffers=True))
+    np.testing.assert_array_equal(np.asarray(seq.bp_y),
+                                  np.asarray(pipe.bp_y))
+    np.testing.assert_array_equal(np.asarray(seq.bp), np.asarray(pipe.bp))
+    np.testing.assert_array_equal(seq.source_map, pipe.source_map)
+
+
+def test_pipeline_timing_accounting():
+    """The driver reports host_gap_ms always, and the overlap fields +
+    pipeline.* gauges/counters when the pipeline ran."""
+    a, ap, b = make_pair(20, 22, seed=6)
+    with obs_trace.run_scope(AnalogyParams(metrics=True)) as ctx:
+        res = create_image_analogy(a, ap, b, _params(
+            levels=3, level_sync=False, pipeline=True))
+    assert "host_gap_ms" in res.timing
+    assert res.timing["prepped_levels"] == 2  # levels-1 lookaheads
+    assert res.timing["prep_ms"] >= 0.0
+    assert res.timing["host_hidden_ms"] >= 0.0
+    snap = ctx.registry.snapshot()
+    assert "pipeline.host_gap_ms" in snap["gauges"]
+    assert "pipeline.host_hidden_ms" in snap["gauges"]
+    assert snap["counters"]["pipeline.levels_prepped"] == 2
+
+
+def test_sequential_run_still_records_host_gap():
+    a, ap, b = make_pair(16, 16, seed=7)
+    res = create_image_analogy(a, ap, b, _params(
+        level_sync=True, pipeline=False))
+    assert res.timing["host_gap_ms"] >= 0.0
+    assert "prep_ms" not in res.timing  # pipeline off -> no overlap rows
+
+
+def test_retries_disable_pipeline_and_donation():
+    """level_retries > 0 must force both features off (the §5.3 fault
+    envelope): pipeline_active() says so, and the engine recovers
+    bit-identically from an injected level.dispatch transient even when
+    the caller asked for donation."""
+    p = _params(level_retries=1, pipeline=True, donate_buffers=True,
+                level_sync=False)
+    assert p.pipeline_active() is False
+
+    a, ap, b = make_pair(20, 22, seed=8)
+    clean = create_image_analogy(a, ap, b, _params())
+    plan = ChaosPlan(seed=0, name="donate-retry", sites=(
+        ("level.dispatch", SiteRule(kind="transient", schedule=(1,))),))
+    with inject.plan_scope(plan):
+        faulted = create_image_analogy(a, ap, b, p)
+        snap = inject.snapshot()
+    assert snap["level.dispatch"]["injected"] == 1
+    np.testing.assert_array_equal(np.asarray(clean.bp_y),
+                                  np.asarray(faulted.bp_y))
+    np.testing.assert_array_equal(clean.source_map, faulted.source_map)
+    assert "donated_levels" not in faulted.timing
+
+
+# ------------------------------------------------- report section
+
+
+def test_report_renders_pipeline_section():
+    from image_analogies_tpu.obs import report
+
+    records = [{"event": "run_end", "metrics": {
+        "counters": {"pipeline.levels_prepped": 4,
+                     "pipeline.donated_levels": 4},
+        "gauges": {"pipeline.host_gap_ms": 12.5,
+                   "pipeline.prep_ms": 30.0,
+                   "pipeline.wait_ms": 2.0,
+                   "pipeline.host_hidden_ms": 28.0}}}]
+    an = report.analyze(records)
+    assert an["pipeline"]["host_gap_ms"] == 12.5
+    assert an["pipeline"]["hidden_fraction"] == pytest.approx(28.0 / 30.0)
+    text = report.render(an)
+    assert "pipeline:" in text
+    assert "hidden under" in text
+    assert "4 levels donated" in text
+    # pipeline.* counters must not leak into the generic counter dump
+    assert "pipeline.levels_prepped" not in text
+
+
+def test_bench_check_gates_host_gap(tmp_path):
+    """`ia bench --check` fails a fresh result whose host_gap_ms
+    regressed past threshold even when wall-clock held."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "ia_bench_t", os.path.join(os.path.dirname(__file__), "..",
+                                   "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    def point(rnd, value, gap):
+        doc = {"parsed": {"value": value, "metric": "1024x1024 wall",
+                          "host_gap_ms": gap}}
+        (tmp_path / f"BENCH_r{rnd:02d}.json").write_text(json.dumps(doc))
+
+    point(1, 5.0, 100.0)
+    point(2, 5.0, 40.0)
+    traj = bench.load_trajectory(str(tmp_path))
+    assert [p["host_gap_ms"] for p in traj["points"]] == [100.0, 40.0]
+    ok = bench.check_regression(traj, fresh_value=5.0, fresh_gap=41.0)
+    assert ok["ok"] and ok["host_gap_floor"] == 40.0
+    bad = bench.check_regression(traj, fresh_value=5.0, fresh_gap=90.0)
+    assert not bad["ok"]
+    assert any("host_gap_ms regressed" in p for p in bad["problems"])
+    # archives without the field still gate wall-clock alone
+    (tmp_path / "BENCH_r01.json").write_text(
+        json.dumps({"parsed": {"value": 5.0, "metric": "1024x1024 w"}}))
+    traj = bench.load_trajectory(str(tmp_path))
+    legacy = bench.check_regression(traj, fresh_value=5.0)
+    assert legacy["ok"] and "host_gap_ms" not in legacy
+
+
+# ------------------------------------------------- bf16 gate
+
+
+def test_bf16_scoring_config_validation():
+    with pytest.raises(ValueError, match="bf16_scoring"):
+        AnalogyParams(backend="cpu", bf16_scoring=True)
+    with pytest.raises(ValueError, match="bf16_scoring"):
+        AnalogyParams(backend="tpu", strategy="batched",
+                      bf16_scoring=True)
+    assert AnalogyParams().bf16_scoring is False  # off by default
+
+
+def test_bf16_gate_probe_allows_on_parity(monkeypatch):
+    """On this (CPU jax) backend the bf16 pad plane never materializes,
+    so the probe's bf16 run IS the exact scan — the audit comes back
+    clean and the gate opens; results equal the exact engine's."""
+    from image_analogies_tpu.backends import tpu as tpu_backend
+
+    tpu_backend.reset_bf16_gate()
+    a, ap, b = make_pair(20, 22, seed=9)
+    exact = create_image_analogy(a, ap, b, _params())
+    fast = create_image_analogy(a, ap, b, _params(bf16_scoring=True))
+    np.testing.assert_array_equal(np.asarray(exact.bp_y),
+                                  np.asarray(fast.bp_y))
+    assert tpu_backend._bf16_gate_allows(_params(bf16_scoring=True))
+
+
+def test_bf16_gate_refuses_unexplained_mismatch(monkeypatch):
+    """An audit with unexplained mismatches must auto-disable the mode
+    process-wide (cached verdict) without failing the synthesis."""
+    from image_analogies_tpu.backends import tpu as tpu_backend
+
+    tpu_backend.reset_bf16_gate()
+    monkeypatch.setattr(
+        tpu_backend, "_bf16_probe_verdict",
+        lambda params: {"ok": False, "mismatches": 3, "unexplained": 3,
+                        "first_divergence_is_tie": False})
+    p = _params(bf16_scoring=True)
+    assert tpu_backend._bf16_gate_allows(p) is False
+    assert tpu_backend._bf16_gate_allows(p) is False  # cached, no re-probe
+    a, ap, b = make_pair(16, 16, seed=10)
+    res = create_image_analogy(a, ap, b, p)  # silently exact
+    exact = create_image_analogy(a, ap, b, _params())
+    np.testing.assert_array_equal(np.asarray(exact.bp_y),
+                                  np.asarray(res.bp_y))
+    tpu_backend.reset_bf16_gate()
+
+
+# ------------------------------------------------- source_map transfers
+
+
+def test_source_map_fetches_exactly_once():
+    class CountingPlane:
+        def __init__(self, arr):
+            self.arr = arr
+            self.transfers = 0
+
+        def __array__(self, dtype=None, copy=None):
+            self.transfers += 1
+            return np.asarray(self.arr, dtype or np.int32)
+
+    plane = CountingPlane(np.arange(16, dtype=np.int32).reshape(4, 4))
+    res = AnalogyResult(bp=np.zeros((4, 4)), bp_y=np.zeros((4, 4)),
+                        source_map_raw=plane)
+    first = res.source_map
+    for _ in range(5):
+        np.testing.assert_array_equal(res.source_map, first)
+    assert plane.transfers == 1
+
+
+# ------------------------------------------------- wire format
+
+
+def test_wire_roundtrip_shapes():
+    arrays = [np.random.default_rng(0).random((5, 7)).astype(np.float32),
+              np.zeros((3,), np.float32),
+              np.arange(24, dtype=np.float32).reshape(2, 3, 4)]
+    out = wire.decode_planes(wire.encode_planes(arrays))
+    assert len(out) == 3
+    for x, y in zip(arrays, out):
+        assert y.dtype == np.float32
+        np.testing.assert_array_equal(x, y)
+        assert y.flags.writeable
+
+
+def test_wire_rejects_malformed_frames():
+    good = wire.encode_planes([np.ones((2, 2), np.float32)])
+    with pytest.raises(wire.WireError, match="magic"):
+        wire.decode_planes(b"NOPE" + good[4:])
+    with pytest.raises(wire.WireError, match="truncated"):
+        wire.decode_planes(good[:-3])
+    with pytest.raises(wire.WireError, match="trailing"):
+        wire.decode_planes(good + b"\x00")
+    with pytest.raises(wire.WireError, match="too many arrays"):
+        wire.encode_planes([np.zeros(1, np.float32)]
+                           * (wire.MAX_ARRAYS + 1))
+    hostile = wire.MAGIC + np.array([1, 2, 1 << 20, 1 << 20],
+                                    "<u4").tobytes()
+    with pytest.raises(wire.WireError, match="exceeds"):
+        wire.decode_planes(hostile)
+
+
+def test_http_binary_negotiation():
+    """POST a binary frame (planes in body, deadline/idem in headers),
+    Accept binary back; then mix the directions; JSON default intact."""
+    from image_analogies_tpu.serve import ServeConfig, Server
+    from image_analogies_tpu.serve.http import serve_http
+
+    a, ap, b = make_pair(10, 10, seed=30)
+    cfg = ServeConfig(params=AnalogyParams(levels=2, backend="cpu"),
+                      workers=1, max_batch=1, batch_window_ms=0.0,
+                      default_deadline_s=60.0)
+    with Server(cfg) as srv:
+        httpd = serve_http(srv, 0)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            url = f"http://127.0.0.1:{httpd.server_address[1]}/v1/analogy"
+            frame = wire.encode_planes([a, ap, b])
+
+            # binary in, binary out
+            req = urllib.request.Request(url, data=frame, headers={
+                "Content-Type": wire.CONTENT_TYPE,
+                "Accept": wire.CONTENT_TYPE,
+                "X-IA-Deadline-Ms": "60000",
+                "X-IA-Idempotency-Key": "wire-test-1"})
+            with urllib.request.urlopen(req) as r:
+                assert r.headers["Content-Type"] == wire.CONTENT_TYPE
+                assert r.headers["X-IA-Status"] == "ok"
+                assert r.headers["X-IA-Request"]
+                timings = json.loads(r.headers["X-IA-Timings"])
+                bp_bin = wire.decode_planes(r.read())[0]
+            assert set(timings) == {"queue_ms", "dispatch_ms", "total_ms"}
+
+            # JSON in, JSON out (the default) agrees bit-for-bit
+            body = json.dumps({"a": a.tolist(), "ap": ap.tolist(),
+                               "b": b.tolist()}).encode()
+            req = urllib.request.Request(url, data=body, headers={
+                "Content-Type": "application/json"})
+            with urllib.request.urlopen(req) as r:
+                assert r.headers["Content-Type"] == "application/json"
+                bp_json = np.asarray(json.load(r)["bp"], np.float32)
+            np.testing.assert_array_equal(bp_bin, bp_json)
+
+            # binary in, JSON out (no Accept header)
+            req = urllib.request.Request(url, data=frame, headers={
+                "Content-Type": wire.CONTENT_TYPE})
+            with urllib.request.urlopen(req) as r:
+                bp_mixed = np.asarray(json.load(r)["bp"], np.float32)
+            np.testing.assert_array_equal(bp_bin, bp_mixed)
+
+            # malformed binary -> 400, JSON error body
+            req = urllib.request.Request(url, data=b"IAF2garbage",
+                                         headers={"Content-Type":
+                                                  wire.CONTENT_TYPE})
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req)
+            assert err.value.code == 400
+            assert json.load(err.value)["error"] == "bad_request"
+        finally:
+            httpd.shutdown()

@@ -31,7 +31,8 @@ from image_analogies_tpu.tune import store as tune_store
 def _clean_tune_env(monkeypatch, tmp_path):
     """Isolate every test from developer stores and env overrides."""
     for var in ("IA_TILE_ROWS", "IA_PACKED_TILE", "IA_PACKED_VMEM",
-                "IA_SHAPE_BUCKETS", "IA_DEVCACHE_BYTES"):
+                "IA_WAVEFRONT_ROWS", "IA_SHAPE_BUCKETS",
+                "IA_DEVCACHE_BYTES"):
         monkeypatch.delenv(var, raising=False)
     monkeypatch.setenv("IA_TUNE_STORE", str(tmp_path / "no_store.json"))
     tune_store.invalidate_cache()
@@ -88,6 +89,48 @@ def test_env_invalid_value_ignored(monkeypatch):
     monkeypatch.setenv("IA_PACKED_TILE", "-5")
     cfg = tune.resolve(strategy="wavefront", dtype="packed2", fp=256)
     assert cfg.packed_tile_cap == 16384
+
+
+def test_wavefront_max_rows_resolves_and_clamps(monkeypatch, tmp_path):
+    """The last geometry constant: default is the f32-exactness ceiling,
+    env/store may only LOWER it, and the wavefront guard consumes the
+    resolved value (not a module constant — grep lock below)."""
+    assert tune.wavefront_max_rows() == geometry.DEFAULT_WAVEFRONT_MAX_ROWS
+    assert geometry.DEFAULT_WAVEFRONT_MAX_ROWS == 1 << 24
+    monkeypatch.setenv("IA_WAVEFRONT_ROWS", "4096")
+    cfg = tune.resolve(strategy="wavefront", dtype="f32", fp=128)
+    assert cfg.wavefront_max_rows == 4096
+    assert cfg.origin_of("wavefront_max_rows") == "env"
+    # a value above the ceiling clamps (correctness bound, not a knob
+    # you can raise): origin still records where it came from
+    monkeypatch.setenv("IA_WAVEFRONT_ROWS", str(1 << 30))
+    cfg = tune.resolve(strategy="wavefront", dtype="f32", fp=128)
+    assert cfg.wavefront_max_rows == geometry.WAVEFRONT_MAX_ROWS_CEILING
+    monkeypatch.delenv("IA_WAVEFRONT_ROWS")
+    # store entries flow through the same chain
+    path = str(tmp_path / "s.json")
+    key = tune.make_key(tune.device_kind(), "wavefront", "f32", 128, "*")
+    tune_store.save_entries({key: {"wavefront_max_rows": 1 << 20}}, path)
+    monkeypatch.setenv("IA_TUNE_STORE", path)
+    assert tune.wavefront_max_rows() == 1 << 20
+
+
+def test_wavefront_guard_uses_resolved_bound(monkeypatch):
+    """Lowering the bound below a small exemplar makes the wavefront
+    build refuse it — proof the guard reads tune/, not a constant."""
+    a = np.tile(np.linspace(0, 1, 24, dtype=np.float32), (24, 1))
+    params = AnalogyParams(levels=1, backend="tpu", strategy="wavefront")
+    from image_analogies_tpu.backends.tpu import TpuMatcher
+    from image_analogies_tpu.backends.base import LevelJob
+    from image_analogies_tpu.ops.features import spec_for_level
+    spec = spec_for_level(params, level=0, levels=1, src_channels=1)
+    job = LevelJob(level=0, spec=spec, kappa_mult=1.0, a_src=a, a_filt=a,
+                   b_src=a)
+    monkeypatch.setenv("IA_WAVEFRONT_ROWS", "64")
+    with pytest.raises(ValueError, match="wavefront strategy caps"):
+        m = TpuMatcher(params)
+        db = m.build_features(job)
+        m.synthesize_level(db, job)
 
 
 def test_env_beats_store(monkeypatch, tmp_path):
@@ -338,7 +381,8 @@ def test_no_call_site_reads_legacy_geometry_constants():
                  os.path.join(root, "ops", "pallas_match.py")]
     legacy = re.compile(
         r"\b_tile_rows\b|\b_scan_tile\b|\b_packed_tile_cap\b"
-        r"|_PACKED_TILE_CAP|_PACKED_VMEM_LIMIT|_ARGMIN_TILE")
+        r"|_PACKED_TILE_CAP|_PACKED_VMEM_LIMIT|_ARGMIN_TILE"
+        r"|_WAVEFRONT_MAX_ROWS")
     for path in consumers:
         with open(path) as f:
             src = f.read()
