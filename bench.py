@@ -156,7 +156,7 @@ def load_trajectory(bench_dir: str = ".") -> dict:
 
 def check_regression(trajectory: dict, fresh_value=None,
                      threshold_pct: float = 20.0,
-                     fresh_gap=None) -> dict:
+                     fresh_gap=None, fresh_key=None) -> dict:
     """Gate a wall-clock number against the trajectory floor.
 
     With ``fresh_value`` (a just-measured number), it is compared against
@@ -165,6 +165,15 @@ def check_regression(trajectory: dict, fresh_value=None,
     checked against the best of the points before it.  ``ok`` is False
     when the candidate exceeds the floor by more than ``threshold_pct``
     percent.
+
+    ``fresh_key`` names the metric a ``fresh_value`` belongs to (default:
+    the latest archived point's key, the historic behavior).  A fresh
+    value whose metric has NO archived points — a brand-new metric, or an
+    empty archive — is NOT a crash and NOT a gate: it passes explicitly
+    as ``reason: "no_floor_recorded_only"`` (with a
+    ``bench.check.no_floor`` counter when an obs registry is live), so
+    the first measurement of a new metric can ride the same CI command
+    that later gates it.
 
     ``host_gap_ms`` (the pipelined engine's inter-level host time)
     rides the same gate wherever BOTH the candidate and at least one
@@ -176,13 +185,31 @@ def check_regression(trajectory: dict, fresh_value=None,
     """
     points = trajectory.get("points") or []
     problems = list(trajectory.get("problems", []))
-    if not points:
+    if not points and fresh_value is None:
         return {"ok": False, "reason": "no_trajectory_points",
                 "problems": problems}
-    latest = points[-1]
-    key = latest["metric_key"]
-    same = [p for p in points if p["metric_key"] == key]
-    if fresh_value is None:
+    if fresh_value is not None:
+        key = fresh_key or (points[-1]["metric_key"] if points
+                            else "unknown")
+        same = [p for p in points if p["metric_key"] == key]
+        if not same:
+            try:
+                from image_analogies_tpu.obs import metrics as _obs_m
+                _obs_m.inc("bench.check.no_floor")
+            except Exception:
+                pass
+            return {"ok": True, "reason": "no_floor_recorded_only",
+                    "metric_key": key, "candidate": float(fresh_value),
+                    "candidate_source": "fresh", "no_floor": 1,
+                    "points": len(points), "problems": problems}
+        candidate, cand_src = float(fresh_value), "fresh"
+        cand_gap = fresh_gap
+        prior = same
+        floor = min(p["value"] for p in same)
+    else:
+        latest = points[-1]
+        key = latest["metric_key"]
+        same = [p for p in points if p["metric_key"] == key]
         candidate, cand_src = latest["value"], latest["file"]
         cand_gap = latest.get("host_gap_ms")
         prior = same[:-1]
@@ -193,11 +220,6 @@ def check_regression(trajectory: dict, fresh_value=None,
                     "points": len(points),
                     "problems": problems}
         floor = min(p["value"] for p in prior)
-    else:
-        candidate, cand_src = float(fresh_value), "fresh"
-        cand_gap = fresh_gap
-        prior = same
-        floor = min(p["value"] for p in same)
     regression_pct = (candidate - floor) / floor * 100.0
     out = {
         "ok": regression_pct <= threshold_pct,

@@ -42,7 +42,20 @@ _KIND_NOTES = {
     "crash": "worker crash containment requeues the batch",
     "process_death": "journal replay answers every admitted request "
                      "exactly once after kill+restart",
+    "fleet_death": "router hands a dead worker's journal to its "
+                   "replacement; spillover + dedupe answer exactly once",
 }
+
+# What `selftest` (and the tier-1 parametrization) iterates: every raw
+# fault kind plus the composite fleet drill, which arms TWO sites
+# (process_death at serve.journal, transient at router.forward) and so
+# is a drill name rather than a member of FAULT_KINDS.
+def _drill_kinds():
+    from image_analogies_tpu.chaos import FAULT_KINDS
+    return tuple(FAULT_KINDS) + ("fleet_death",)
+
+
+DRILL_KINDS = _drill_kinds()
 
 
 def plan_for_kind(kind: str, seed: int = 0) -> ChaosPlan:
@@ -74,6 +87,20 @@ def plan_for_kind(kind: str, seed: int = 0) -> ChaosPlan:
         # same bytes), and two admitted-only (plain replay).
         sites = (("serve.journal", SiteRule(kind="process_death",
                                             schedule=(7,))),)
+    elif kind == "fleet_death":
+        # Fleet drill geometry (2 workers, one shared exemplar so all 4
+        # requests hash to ONE home worker; max_batch == n == 4): the
+        # serve.journal schedule reuses the kill-restart placement —
+        # visit 7 is "done r1" on the home worker, leaving one request
+        # done, one computed-but-unrecorded, two admitted-only.  The
+        # router.forward schedule fires on visit 4: visits 0..3 are the
+        # four original routed submits, so the FIRST post-handoff
+        # resubmit eats a transient hop fault and must spill to the
+        # ring successor (which computes fresh, bit-identically).
+        sites = (("serve.journal", SiteRule(kind="process_death",
+                                            schedule=(7,))),
+                 ("router.forward", SiteRule(kind="transient",
+                                             schedule=(4,))))
     else:
         raise ValueError(f"unknown fault kind {kind!r}")
     return ChaosPlan(seed=seed, sites=sites, name=f"selftest-{kind}")
@@ -113,6 +140,7 @@ def _reconcile(plan: ChaosPlan, counters: Dict[str, float]) -> List[str]:
     # raising kind at a serve batch boundary is contained as a crash
     # regardless of its class — the containment layer can't tell.
     retries = watchdogs = quarantines = crashes = deaths = 0.0
+    hop_faults = 0.0
     for name, rule in plan.sites:
         n = counters.get(f"chaos.site.{name}", 0)
         if not n:
@@ -123,6 +151,11 @@ def _reconcile(plan: ChaosPlan, counters: Dict[str, float]) -> List[str]:
             # not contained: the worker thread dies; the only matching
             # evidence is the death counter (recovery is the journal's)
             deaths += n
+        elif name == "router.forward" and rule.kind in (
+                "transient", "oom", "crash"):
+            # a raising fault on the hop is absorbed by the router's
+            # spillover walk, not a level retry
+            hop_faults += n
         elif name in ("serve.dispatch",) and rule.kind in (
                 "transient", "oom", "crash"):
             crashes += n
@@ -145,6 +178,8 @@ def _reconcile(plan: ChaosPlan, counters: Dict[str, float]) -> List[str]:
         want("serve.worker_crashes", crashes)
     if deaths:
         want("serve.process_deaths", deaths)
+    if hop_faults:
+        want("router.hop_faults", hop_faults)
     return problems
 
 
@@ -401,8 +436,140 @@ def drill_kill_restart(plan: ChaosPlan, *, n: int = 4, seed: int = 7
         }
 
 
+def drill_fleet(plan: ChaosPlan, *, n: int = 4, seed: int = 7
+                ) -> Dict[str, Any]:
+    """Fleet kill-restart drill: 2 routed workers, one shared exemplar so
+    all n requests hash to ONE home worker.  The injected
+    :class:`~chaos.faults.ProcessDeath` kills the home worker mid-batch;
+    the fleet health loop declares it dead, hands its journal directory
+    to a replacement (same wid, same ring slot), whose ``recover()``
+    replays the incomplete entries while the router re-chains the
+    stranded in-flight futures by idempotency key.  Every original
+    request must still be answered exactly once, bit-identical to direct
+    engine runs.  Then every request is RESUBMITTED under its original
+    key: the first resubmit eats a scheduled transient at the new
+    ``router.forward`` site and must spill to the ring successor (which
+    computes fresh, bit-identically, in its own journal); the rest
+    dedupe instantly against the home journal's done records."""
+    from image_analogies_tpu.obs import trace as obs_trace
+    from image_analogies_tpu.serve.fleet import Fleet
+    from image_analogies_tpu.serve.types import FleetConfig
+
+    with tempfile.TemporaryDirectory() as tmp:
+        # Wide batch window: the home worker must coalesce all n submits
+        # into one batch for the serve.journal visit schedule to mean
+        # what plan_for_kind's geometry comment says (same reasoning as
+        # drill_kill_restart; one template serves both incarnations, so
+        # the replacement's replay batch idles out one window).
+        cfg = drills.serve_config(workers=1, max_batch=n,
+                                  batch_window_ms=1000.0)
+        fcfg = FleetConfig(serve=cfg, size=2, vnodes=16,
+                           journal_root=os.path.join(tmp, "journals"),
+                           health_interval_s=0.05, death_checks=2,
+                           backoff_s=0.01, backoff_cap_s=0.05)
+        load = drills.make_serve_load(n, seed=seed)
+        baseline = {item["index"]: drills.run_image(
+            item["a"], item["ap"], item["b"], cfg.params)
+            for item in load}
+        ikey = "fleet-kill-{}".format
+
+        problems: List[str] = []
+        with obs_trace.run_scope(cfg.params) as ctx:
+            inject.arm(plan)
+            try:
+                with Fleet(fcfg) as fl:
+                    futures = {}
+                    for item in load:
+                        futures[item["index"]] = fl.submit(
+                            item["a"], item["ap"], item["b"],
+                            idempotency_key=ikey(item["index"]))
+                    # the scheduled death fires mid-batch on the home
+                    # worker; the health loop replaces it
+                    end = time.monotonic() + 60.0
+                    while not fl.handoffs and time.monotonic() < end:
+                        time.sleep(0.01)
+                    handoffs = list(fl.handoffs)
+                    # every ORIGINAL future must still answer (rechained
+                    # onto the replacement's recovery futures)
+                    originals = {i: f.result(timeout=120)
+                                 for i, f in futures.items()}
+                    # resubmit EVERY request under its original key: the
+                    # router.forward schedule makes the first one spill
+                    # to the ring successor; the rest dedupe
+                    replies = {}
+                    for item in load:
+                        replies[item["index"]] = fl.submit(
+                            item["a"], item["ap"], item["b"],
+                            idempotency_key=ikey(item["index"])
+                        ).result(timeout=120)
+                    fleet_health = fl.health()
+                    snap = inject.snapshot()
+            finally:
+                inject.disarm()
+            counters = _counters(ctx)
+
+        if not handoffs:
+            problems.append("no journal handoff happened (dead drill)")
+        else:
+            rec = handoffs[0].get("recovered", {})
+            if rec.get("entries") != n:
+                problems.append(
+                    f"handoff recovered {rec.get('entries')} entries "
+                    f"!= {n} admitted")
+            if rec.get("poisoned"):
+                problems.append(
+                    f"handoff poisoned {rec.get('poisoned')} entries")
+        identical = all(
+            np.array_equal(originals[i].bp, baseline[i])
+            for i in originals)
+        identical = identical and all(
+            np.array_equal(replies[i].bp, baseline[i]) for i in replies)
+        if not identical:
+            problems.append("fleet output differs from clean run")
+        # exactly-once ledger across the handoff: the home journal holds
+        # one done per original request; the spilled resubmit adds one
+        # admit+done in the SUCCESSOR's journal; the other resubmits
+        # dedupe against the home journal's records.
+        for name, expect in (("serve.journal.admitted", n + 1),
+                             ("serve.journal.done", n + 1),
+                             ("serve.journal.deduped", n - 1),
+                             ("router.deaths", 1),
+                             ("router.handoffs", 1),
+                             ("router.spills", 1)):
+            got = counters.get(name, 0)
+            if got != expect:
+                problems.append(f"{name}={got} != expected {expect}")
+        problems += _reconcile(plan, counters)
+        injected = sum(st["injected"] for st in snap.values())
+        if injected < 2:
+            problems.append(
+                f"expected both sites to inject, got {injected}")
+        return {
+            "workload": "fleet",
+            "plan": plan.to_dict(),
+            "injected": injected,
+            "sites": snap,
+            "handoffs": handoffs,
+            "fleet": {"pending": fleet_health.get("pending"),
+                      "ring": fleet_health.get("ring")},
+            "outcomes": {
+                "answered": len(originals),
+                "resubmitted": len(replies),
+                "rechained": int(counters.get("router.rechained", 0)),
+                "deduped": int(counters.get("serve.journal.deduped", 0)),
+            },
+            "counters": {k: v for k, v in counters.items()
+                         if k.startswith(("chaos.", "serve.", "router."))},
+            "identical": identical,
+            "ok": not problems,
+            "problems": problems,
+        }
+
+
 def run_drill(plan: ChaosPlan, **kw) -> Dict[str, Any]:
     """Dispatch a plan to the workload its sites target."""
+    if any(name == "router.forward" for name, _ in plan.sites):
+        return drill_fleet(plan, **kw)
     if any(name == "serve.journal" for name, _ in plan.sites):
         return drill_kill_restart(plan, **kw)
     if _wants_serve(plan):
@@ -435,11 +602,9 @@ def check_determinism(seed: int = 0) -> Dict[str, Any]:
 
 def selftest(seed: int = 0, kinds: Optional[Sequence[str]] = None
              ) -> Dict[str, Any]:
-    """One canonical drill per fault kind + the determinism check."""
-    from image_analogies_tpu.chaos import FAULT_KINDS
-
+    """One canonical drill per drill kind + the determinism check."""
     reports = []
-    for kind in (kinds or FAULT_KINDS):
+    for kind in (kinds or DRILL_KINDS):
         plan = plan_for_kind(kind, seed)
         report = run_drill(plan)
         report["kind"] = kind

@@ -425,6 +425,63 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def cmd_fleet(args) -> int:
+    """Router + worker fleet (serve/fleet.py, serve/router.py): N
+    in-process servers behind a consistent-hash router with health-gated
+    spillover and dead-worker journal handoff.  --selftest routes the
+    synthetic load through the ring and gates on bit-identity; --http
+    binds the loopback front end on the fleet."""
+    from image_analogies_tpu.serve.types import FleetConfig, ServeConfig
+
+    base = PRESETS["oil_filter"]
+    params = _params_from_args(args, base)
+    scfg = ServeConfig(
+        params=params,
+        queue_depth=args.queue_depth,
+        batch_window_ms=args.batch_window_ms,
+        max_batch=args.max_batch,
+        workers=args.workers,
+        cost_persist=False,
+        journal_dir=None,  # per-worker dirs derive from journal_root
+    )
+    fcfg = FleetConfig(
+        serve=scfg,
+        size=args.size,
+        journal_root=args.journal,
+        wire=args.wire,
+    )
+
+    if args.selftest is not None:
+        from image_analogies_tpu.serve import loadgen
+
+        summary = loadgen.fleet_selftest(fcfg, args.selftest,
+                                         seed=args.seed)
+        print(loadgen.render_fleet(summary))
+        print(json.dumps(summary, sort_keys=True), file=sys.stderr)
+        return 0 if (summary["errors"] == 0
+                     and summary["bit_identical"]) else 1
+
+    if args.http is None:
+        print("fleet: pass --selftest N or --http PORT", file=sys.stderr)
+        return 2
+
+    from image_analogies_tpu.serve.fleet import Fleet
+    from image_analogies_tpu.serve.http import serve_fleet_http
+
+    with Fleet(fcfg) as fl:
+        httpd = serve_fleet_http(fl, args.http)
+        print(f"fleet of {fcfg.size} serving on "
+              f"http://127.0.0.1:{args.http} "
+              f"(POST /v1/analogy, GET /healthz); Ctrl-C to drain+exit")
+        try:
+            httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            httpd.shutdown()
+    return 0
+
+
 def cmd_chaos(args) -> int:
     """Seeded fault-injection drills (chaos/): run a workload under a
     fault plan and assert full recovery — bit-identical output, no lost
@@ -578,6 +635,7 @@ def cmd_bench(args) -> int:
     trajectory = bench.load_trajectory(bench_dir)
     fresh = None
     fresh_gap = None
+    fresh_key = args.metric_key
     if args.value is not None:
         fresh = args.value
     elif args.result is not None:
@@ -601,9 +659,12 @@ def cmd_bench(args) -> int:
                 return 2
             fresh = head["value"]
             fresh_gap = head.get("host_gap_ms")
+            if fresh_key is None:
+                fresh_key = head.get("metric_key")
     verdict = bench.check_regression(trajectory, fresh_value=fresh,
                                      threshold_pct=args.threshold,
-                                     fresh_gap=fresh_gap)
+                                     fresh_gap=fresh_gap,
+                                     fresh_key=fresh_key)
     print(json.dumps(verdict, sort_keys=True))
     for problem in verdict.get("problems", []):
         print(f"bench: warning: {problem}", file=sys.stderr)
@@ -723,6 +784,13 @@ def build_parser() -> argparse.ArgumentParser:
     bn.add_argument("--result", default=None, metavar="FILE",
                     help="JSON file carrying the fresh number: a bench "
                          "headline line or a BENCH_r0N.json driver doc")
+    bn.add_argument("--metric-key", default=None,
+                    help="metric the fresh --value belongs to (e.g. "
+                         "north_star_1024); defaults to --result's "
+                         "parsed key, else the latest archived point's. "
+                         "A key with no archived floor passes as "
+                         "'no floor, recorded only' instead of gating "
+                         "against an unrelated metric")
     bn.add_argument("--threshold", type=float, default=20.0,
                     help="max tolerated regression percent (default 20)")
     bn.add_argument("--dir", default=None,
@@ -830,6 +898,42 @@ def build_parser() -> argparse.ArgumentParser:
     _add_engine_flags(sv)
     sv.set_defaults(fn=cmd_serve)
 
+    fp = sub.add_parser("fleet",
+                        help="router + worker fleet: consistent-hash "
+                             "affinity on the batch key, health-gated "
+                             "spillover, dead-worker journal handoff "
+                             "(--selftest N for the routed synthetic "
+                             "load, --http PORT for the loopback front "
+                             "end)")
+    fp.add_argument("--selftest", type=int, default=None, metavar="N",
+                    help="route N synthetic mixed-shape requests through "
+                         "the ring against a sequential baseline; gates "
+                         "on zero errors and bit-identity")
+    fp.add_argument("--http", type=int, default=None, metavar="PORT",
+                    help="bind the loopback-only HTTP front end on the "
+                         "fleet (fleet-view /healthz, routed "
+                         "/v1/analogy)")
+    fp.add_argument("--size", type=int, default=2,
+                    help="number of in-process Server workers")
+    fp.add_argument("--wire", choices=("auto", "binary", "json"),
+                    default="auto",
+                    help="router<->worker hop encoding: auto/binary "
+                         "negotiate the IAF2 raw-f32 frame, json forces "
+                         "the fallback list transport")
+    fp.add_argument("--journal", default=None, metavar="DIR",
+                    help="journal ROOT: each worker journals under "
+                         "DIR/<wid>; a dead worker's directory is handed "
+                         "to its replacement for exactly-once replay")
+    fp.add_argument("--queue-depth", type=int, default=32)
+    fp.add_argument("--batch-window-ms", type=float, default=4.0)
+    fp.add_argument("--max-batch", type=int, default=8)
+    fp.add_argument("--workers", type=int, default=1,
+                    help="worker THREADS per server (the fleet dimension "
+                         "is --size)")
+    fp.add_argument("--seed", type=int, default=0)
+    _add_engine_flags(fp)
+    fp.set_defaults(fn=cmd_fleet)
+
     ch = sub.add_parser("chaos",
                         help="seeded fault-injection drills: run a "
                              "workload under a fault plan and assert "
@@ -840,10 +944,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="ChaosPlan JSON (seed + per-site fault rules) "
                          "to replay against the matching drill workload")
     ch.add_argument("--selftest", action="store_true",
-                    help="one canonical drill per fault kind "
+                    help="one canonical drill per kind "
                          "(transient, oom, latency, corrupt, crash, "
-                         "process_death) plus the same-seed "
-                         "schedule-determinism check")
+                         "process_death, fleet_death) plus the "
+                         "same-seed schedule-determinism check")
     ch.add_argument("--kinds", default=None,
                     help="comma-separated fault-kind subset for "
                          "--selftest (default: all)")

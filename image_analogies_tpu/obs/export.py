@@ -82,6 +82,28 @@ def _classify(rec: Dict[str, Any]) -> Tuple[str, int, str, Optional[float]]:
             name = (f"recovery replayed={rec.get('replayed', 0)} "
                     f"done={rec.get('done', 0)}")
         return "i", SERVE_TID, name, None
+    if ev in ("router_route", "router_spill", "router_rechain",
+              "router_resubmit"):
+        # routing-plane instants share the serve track: a request's hop
+        # (or spillover walk) sits next to the serve interval it fed
+        if ev == "router_route":
+            name = f"route {rec.get('idem', '?')} -> {rec.get('worker', '?')}"
+        elif ev == "router_spill":
+            name = (f"spill {rec.get('idem', '?')} "
+                    f"{rec.get('home', '?')} -> {rec.get('to', '?')}")
+        else:
+            verb = "rechain" if ev == "router_rechain" else "resubmit"
+            name = f"{verb} {rec.get('idem', '?')}"
+        return "i", SERVE_TID, name, None
+    if ev in ("router_death", "router_handoff"):
+        # fleet lifecycle instants on the fault track, next to the
+        # process death that caused them
+        if ev == "router_death":
+            name = f"worker death {rec.get('worker', '?')}"
+        else:
+            name = (f"journal handoff {rec.get('worker', '?')} "
+                    f"gen {rec.get('generation', '?')}")
+        return "i", CHAOS_TID, name, None
     if ev in ("chaos_inject", "ckpt_quarantined", "journal_quarantined",
               "watchdog_timeout",
               "retry_exhausted", "serve_worker_crash", "serve_process_death",

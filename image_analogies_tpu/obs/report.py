@@ -221,6 +221,35 @@ def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                                 for k, v in sorted(batch_hist.items())},
         }
 
+    # --- fleet section (router.* counters + router_* records) -------------
+    handoff_recs = [r for r in records
+                    if r.get("event") == "router_handoff"]
+    router_info: Optional[Dict[str, Any]] = None
+    if handoff_recs or any(k.startswith("router.") for k in counters):
+        routed = {k.split("router.routed.", 1)[1]: int(v)
+                  for k, v in counters.items()
+                  if k.startswith("router.routed.")}
+        codecs = {k.split("router.wire.", 1)[1]: int(v)
+                  for k, v in counters.items()
+                  if k.startswith("router.wire.")}
+        router_info = {
+            "requests": int(counters.get("router.requests", 0)),
+            "routed": routed,
+            "spills": int(counters.get("router.spills", 0)),
+            "hop_faults": int(counters.get("router.hop_faults", 0)),
+            "rejected": int(counters.get("router.rejected", 0)),
+            "deaths": int(counters.get("router.deaths", 0)),
+            "handoffs": int(counters.get("router.handoffs", 0)),
+            "rechained": int(counters.get("router.rechained", 0)),
+            "resubmitted": int(counters.get("router.resubmitted", 0)),
+            "wire_bytes": int(counters.get("router.wire_bytes", 0)),
+            "codecs": codecs,
+            # each journal handoff, in order
+            "handoff_events": [
+                {k: r[k] for k in ("worker", "generation", "recovered")
+                 if k in r} for r in handoff_recs],
+        }
+
     # --- chaos section (chaos_inject records + chaos.* counters) ----------
     # The reconciliation ledger: injections on the left, the recovery
     # counters they caused on the right.  A drill (or an operator reading
@@ -347,6 +376,7 @@ def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "tune": tune_info,
         "pipeline": pipeline_info,
         "serve": serve_info,
+        "router": router_info,
         "slo": slo_info,
         "journal": journal_info,
         "chaos": chaos_info,
@@ -415,7 +445,8 @@ def render(an: Dict[str, Any], run_id: Optional[str] = None) -> str:
     rest = {k: v for k, v in c.items()
             if k not in shown and v
             and not k.startswith(("serve.", "chaos.", "watchdog.",
-                                  "ckpt.", "retry.", "pipeline."))}
+                                  "ckpt.", "retry.", "pipeline.",
+                                  "router."))}
     for k in sorted(rest):
         w(f"    {k:<13} {rest[k]:g}")
 
@@ -496,6 +527,34 @@ def render(an: Dict[str, Any], run_id: Optional[str] = None) -> str:
             hist = ", ".join(f"{k}x{v}" for k, v in
                              srv["batch_size_hist"].items())
             w(f"    batch sizes   {hist}  (size x count)")
+
+    rt = an.get("router")
+    if rt:
+        w("  fleet:")
+        routed = ", ".join(f"{k}x{v}" for k, v in
+                           sorted(rt["routed"].items()))
+        w(f"    routing       {rt['requests']} requests -> "
+          f"{routed or '-'}  (worker x count)")
+        w(f"    resilience    {rt['spills']} spills, "
+          f"{rt['hop_faults']} hop faults, {rt['rejected']} rejected")
+        if rt["deaths"] or rt["handoffs"]:
+            w(f"    handoff       {rt['deaths']} deaths -> "
+              f"{rt['handoffs']} journal handoffs, "
+              f"{rt['rechained']} futures rechained, "
+              f"{rt['resubmitted']} resubmitted")
+        for i, ho in enumerate(rt["handoff_events"]):
+            rcv = ho.get("recovered") or {}
+            w(f"    handoff {i:<5} {ho.get('worker', '?')} "
+              f"gen {ho.get('generation', '?')}: "
+              f"entries={rcv.get('entries', 0)} "
+              f"replayed={rcv.get('replayed', 0)} "
+              f"done={rcv.get('done', 0)} "
+              f"poisoned={rcv.get('poisoned', 0)}")
+        if rt["codecs"]:
+            codecs = ", ".join(f"{k}x{v}" for k, v in
+                               sorted(rt["codecs"].items()))
+            w(f"    wire          {codecs} "
+              f"({_fmt_bytes(rt['wire_bytes'])} framed)")
 
     slo = an.get("slo")
     if slo:

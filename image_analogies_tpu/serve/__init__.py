@@ -18,6 +18,12 @@ Layering (each module one concern):
 - :mod:`serve.loadgen`  — ``ia serve --selftest N`` synthetic load.
 - :mod:`serve.http`     — optional loopback stdlib ``http.server`` front
   end (``ia serve --http PORT``); never required by tests.
+- :mod:`serve.router`   — consistent-hash ring (sha256 positions) +
+  spillover routing by batch key; re-answers in-flight futures across a
+  worker death by idempotency key.
+- :mod:`serve.fleet`    — N stable-identity Server workers behind the
+  router: health-gate loop, dead-worker detection, and journal-directory
+  handoff to the replacement (``ia fleet``).
 
 Everything here is host-side orchestration: no jax imports at module
 scope, no direct jit/pjit anywhere (the grep-lock test enforces it) —
@@ -28,11 +34,12 @@ tune.resolve funnels.
 from image_analogies_tpu.serve.server import Client, Server
 from image_analogies_tpu.serve.types import (
     DeadlineExceeded,
+    FleetConfig,
     Rejected,
     Request,
     Response,
     ServeConfig,
 )
 
-__all__ = ["Client", "Server", "ServeConfig", "Request", "Response",
-           "Rejected", "DeadlineExceeded"]
+__all__ = ["Client", "Server", "ServeConfig", "FleetConfig", "Request",
+           "Response", "Rejected", "DeadlineExceeded"]

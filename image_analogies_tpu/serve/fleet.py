@@ -1,0 +1,348 @@
+"""In-process worker fleet: N Servers behind one consistent-hash Router.
+
+The management half of ROADMAP direction 1.  Each worker is a full
+:class:`serve.server.Server` (own queue, batcher, breaker, journal
+directory) with a STABLE identity ``w0..w{size-1}``: the wid owns the
+ring slot and the journal directory, so a replacement worker inherits
+both — affinity for untouched keys is preserved trivially and the
+dead worker's write-ahead journal is recovered by whoever takes the
+wid next (the handoff the PR 7 roadmap note promised).
+
+Health gate loop (daemon thread, ``health_interval_s`` cadence):
+
+- ``Server.health()`` raising, or reporting not-accepting / zero alive
+  worker threads, counts a MISS; ``death_checks`` consecutive misses
+  declare the worker dead and trigger :meth:`_replace` — kill the old
+  incarnation (releasing the journal lock), start a replacement on the
+  SAME journal dir (``Server.start`` runs ``recover()`` before
+  traffic: done-dedupe, admit-order replay, poison preserved), then
+  hand the router every stranded in-flight future to re-answer by
+  idempotency key.
+- An open breaker or a queue at ``spill_queue_frac`` of depth GATES the
+  worker: the router spills its keys to the next ring successor until
+  the gate clears.  Gating is advisory and reversible; death is not.
+
+Wire negotiation (satellite of the IAF2 work in serve/wire.py): every
+router->worker hop round-trips the three request planes (and the
+response planes) through the negotiated codec — IAF2 binary frames by
+default, JSON lists on fallback — so the in-process fleet exercises the
+exact encode/decode path a remote fleet would, and the bit-identity
+gates prove both codecs are exact for f32.
+
+Host-side only: no jax imports, no jit (serve grep-lock scans this
+file).  Device work happens inside each worker's engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json as _json
+import os
+import threading
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from image_analogies_tpu.obs import metrics as obs_metrics
+from image_analogies_tpu.obs import trace as obs_trace
+from image_analogies_tpu.serve import wire
+from image_analogies_tpu.serve.router import Router
+from image_analogies_tpu.serve.server import Server
+from image_analogies_tpu.serve.types import FleetConfig, Response
+
+
+def _roundtrip_iaf2(arrays: List[np.ndarray]) -> List[np.ndarray]:
+    return wire.decode_planes(wire.encode_planes(arrays))
+
+
+def _roundtrip_json(arrays: List[np.ndarray]) -> List[np.ndarray]:
+    # Exact for f32: tolist() yields doubles holding each f32 exactly;
+    # JSON repr round-trips doubles; nearest-f32 of that double is the
+    # original value.  The bit-identity gates re-verify, not assume.
+    return [np.asarray(_json.loads(_json.dumps(
+        np.asarray(a, np.float32).tolist())), dtype=np.float32)
+        for a in arrays]
+
+
+class WorkerHandle:
+    """One fleet slot: stable wid + the current Server incarnation."""
+
+    # What a worker advertises to codec negotiation.  In-process
+    # workers always speak both; a remote worker would advertise its
+    # own set here.
+    wire_formats = ("iaf2", "json")
+
+    def __init__(self, wid: str, server: Server, generation: int,
+                 codec: str):
+        self.wid = wid
+        self.server = server
+        self.generation = generation
+        self.codec = codec
+
+    def recovery_future(self, idem: str) -> Optional["Future[Response]"]:
+        """The replay future recover() registered for ``idem`` (already
+        codec-wrapped), or None if the journal had no incomplete entry."""
+        src = self.server.recovery.get(idem)
+        if src is None:
+            return None
+        return _wrap_response(src, self.codec)
+
+
+def _wrap_response(src: "Future[Response]", codec: str
+                   ) -> "Future[Response]":
+    """Chain a worker future through the response-side wire codec."""
+    out: "Future[Response]" = Future()
+
+    def _done(f: "Future[Response]") -> None:
+        if out.done():
+            return
+        exc = f.exception()
+        if exc is not None:
+            out.set_exception(exc)
+            return
+        resp = f.result()
+        try:
+            if codec == "iaf2":
+                frame = wire.encode_planes(
+                    [np.asarray(resp.bp, np.float32),
+                     np.asarray(resp.bp_y, np.float32)])
+                obs_metrics.inc("router.wire_bytes", len(frame))
+                bp, bp_y = wire.decode_planes(frame)
+            else:
+                bp, bp_y = _roundtrip_json([resp.bp, resp.bp_y])
+            out.set_result(dataclasses.replace(resp, bp=bp, bp_y=bp_y))
+        except Exception as wexc:  # noqa: BLE001 - protocol error
+            out.set_exception(wexc)
+
+    src.add_done_callback(_done)
+    return out
+
+
+class Fleet:
+    """Owns the workers, the health-gate loop, and the Router."""
+
+    def __init__(self, cfg: FleetConfig):
+        self.cfg = cfg
+        self.workers: Dict[str, WorkerHandle] = {}
+        self.router = Router(self, vnodes=cfg.vnodes,
+                             spill_retries=cfg.spill_retries,
+                             backoff_s=cfg.backoff_s,
+                             backoff_cap_s=cfg.backoff_cap_s)
+        self.handoffs: List[Dict[str, Any]] = []
+        self._gates: Dict[str, str] = {}   # wid -> reason
+        self._misses: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._health_thread: Optional[threading.Thread] = None
+        self._started = False
+
+    # ------------------------------------------------------------------
+    # lifecycle
+
+    def _worker_cfg(self, wid: str):
+        if self.cfg.journal_root:
+            return dataclasses.replace(
+                self.cfg.serve,
+                journal_dir=os.path.join(self.cfg.journal_root, wid))
+        return self.cfg.serve
+
+    def _negotiate(self, advertised) -> str:
+        if self.cfg.wire in ("auto", "binary") and "iaf2" in advertised:
+            return "iaf2"
+        return "json"
+
+    def _spawn(self, wid: str, generation: int) -> WorkerHandle:
+        server = Server(self._worker_cfg(wid)).start()
+        codec = self._negotiate(WorkerHandle.wire_formats)
+        handle = WorkerHandle(wid, server, generation, codec)
+        with self._lock:
+            self.workers[wid] = handle
+            self._misses[wid] = 0
+        obs_metrics.inc("router.wire.{}".format(codec), 0)
+        return handle
+
+    def start(self) -> "Fleet":
+        if self._started:
+            return self
+        self._started = True
+        for i in range(self.cfg.size):
+            wid = "w{}".format(i)
+            self._spawn(wid, generation=0)
+            self.router.ring.add(wid)
+        self._health_thread = threading.Thread(
+            target=self._health_loop, name="fleet-health", daemon=True)
+        self._health_thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        if not self._started:
+            return
+        # Stop the health loop FIRST so a draining worker is not
+        # mistaken for a dead one and "replaced" mid-shutdown.
+        self._stop.set()
+        if self._health_thread is not None:
+            self._health_thread.join(5.0)
+        for handle in list(self.workers.values()):
+            handle.server.shutdown()
+        self._started = False
+
+    def __enter__(self) -> "Fleet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    # ------------------------------------------------------------------
+    # router-facing surface
+
+    def default_params(self):
+        return self.cfg.serve.params
+
+    def gated(self, wid: str) -> bool:
+        with self._lock:
+            return wid in self._gates
+
+    def gate_worker(self, wid: str, reason: str) -> None:
+        """Ops/test hook: force-gate a worker (router spills its keys)."""
+        with self._lock:
+            self._gates[wid] = reason
+
+    def ungate_worker(self, wid: str) -> None:
+        with self._lock:
+            self._gates.pop(wid, None)
+
+    def forward(self, wid: str, a, ap, b, params,
+                deadline_s: Optional[float], idem: Optional[str]
+                ) -> "Future[Response]":
+        """One router->worker hop: request planes through the negotiated
+        codec, submit, response planes back through the codec."""
+        handle = self.workers[wid]
+        if handle.codec == "iaf2":
+            planes = [np.asarray(x, np.float32) for x in (a, ap, b)]
+            frame = wire.encode_planes(planes)
+            obs_metrics.inc("router.wire_bytes", len(frame))
+            a, ap, b = wire.decode_planes(frame)
+        else:
+            a, ap, b = _roundtrip_json([a, ap, b])
+        obs_metrics.inc("router.wire.{}".format(handle.codec))
+        src = handle.server.submit(a, ap, b, params=params,
+                                   deadline_s=deadline_s,
+                                   idempotency_key=idem)
+        return _wrap_response(src, handle.codec)
+
+    def submit(self, a, ap, b, params=None, deadline_s=None,
+               idempotency_key=None) -> "Future[Response]":
+        """Client entry point — delegates to the router."""
+        return self.router.submit(a, ap, b, params=params,
+                                  deadline_s=deadline_s,
+                                  idempotency_key=idempotency_key)
+
+    # ------------------------------------------------------------------
+    # health gate loop
+
+    def _judge(self, handle: WorkerHandle) -> Optional[str]:
+        """None = healthy; "dead" = missed; else a gate reason."""
+        try:
+            h = handle.server.health()
+        except Exception:  # noqa: BLE001 - unresponsive counts as dead
+            return "dead"
+        workers = h.get("workers") or {}
+        if not h.get("accepting") or workers.get("alive", 0) == 0:
+            return "dead"
+        breakers = h.get("breakers") or {}
+        if any(state == "open" for state in breakers.values()):
+            return "breaker_open"
+        depth_gate = self.cfg.spill_queue_frac * self.cfg.serve.queue_depth
+        if h.get("queue_depth", 0) >= depth_gate:
+            return "saturated"
+        return None
+
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self.cfg.health_interval_s):
+            for wid in list(self.workers):
+                if self._stop.is_set():
+                    return
+                handle = self.workers.get(wid)
+                if handle is None:
+                    continue
+                verdict = self._judge(handle)
+                if verdict == "dead":
+                    with self._lock:
+                        self._misses[wid] = self._misses.get(wid, 0) + 1
+                        misses = self._misses[wid]
+                    if misses >= self.cfg.death_checks:
+                        try:
+                            self._replace(wid)
+                        except Exception:  # noqa: BLE001 - keep looping
+                            obs_metrics.inc("router.replace_errors")
+                    continue
+                with self._lock:
+                    self._misses[wid] = 0
+                    if verdict is None:
+                        self._gates.pop(wid, None)
+                    else:
+                        self._gates[wid] = verdict
+
+    # ------------------------------------------------------------------
+    # death + journal handoff
+
+    def _replace(self, wid: str) -> WorkerHandle:
+        """Declare ``wid`` dead, hand its journal dir to a replacement,
+        and let the router re-answer stranded futures."""
+        old = self.workers[wid]
+        with self._lock:
+            self._gates[wid] = "dead"
+        obs_metrics.inc("router.deaths")
+        obs_trace.emit_record({"event": "router_death", "worker": wid,
+                               "generation": old.generation})
+        # kill() releases the journal lock; the replacement's open()
+        # starts a fresh segment and recover() replays what's left.
+        old.server.kill()
+        handle = self._spawn(wid, generation=old.generation + 1)
+        recovered = handle.server.recovery_stats or {}
+        obs_metrics.inc("router.handoffs")
+        obs_trace.emit_record({"event": "router_handoff", "worker": wid,
+                               "generation": handle.generation,
+                               "recovered": recovered})
+        self.handoffs.append({"worker": wid,
+                              "generation": handle.generation,
+                              "recovered": recovered})
+        with self._lock:
+            self._gates.pop(wid, None)
+            self._misses[wid] = 0
+        self.router.on_worker_replaced(wid, handle)
+        return handle
+
+    # ------------------------------------------------------------------
+    # observability
+
+    def health(self) -> Dict[str, Any]:
+        """Fleet /healthz view: per-worker liveness + ring membership."""
+        workers: Dict[str, Any] = {}
+        for wid, handle in sorted(self.workers.items()):
+            try:
+                h = handle.server.health()
+                workers[wid] = {
+                    "ok": h.get("ok", False),
+                    "generation": handle.generation,
+                    "codec": handle.codec,
+                    "queue_depth": h.get("queue_depth", 0),
+                    "breakers": h.get("breakers", {}),
+                    "journal": h.get("journal"),
+                    "gate": self._gates.get(wid),
+                }
+            except Exception as exc:  # noqa: BLE001 - report, not raise
+                workers[wid] = {"ok": False, "error": str(exc),
+                                "generation": handle.generation,
+                                "gate": self._gates.get(wid)}
+        return {
+            "ok": all(w.get("ok") for w in workers.values()),
+            "size": self.cfg.size,
+            "wire": self.cfg.wire,
+            "ring": {"members": self.router.ring.members(),
+                     "vnodes": self.cfg.vnodes},
+            "pending": self.router.pending_count(),
+            "handoffs": len(self.handoffs),
+            "workers": workers,
+        }

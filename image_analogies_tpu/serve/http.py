@@ -46,6 +46,11 @@ from image_analogies_tpu.serve.types import DeadlineExceeded, Rejected
 
 
 def _make_handler(server: Server):
+    return _make_handler_from(server.health, server.submit,
+                              server.refresh_gauges)
+
+
+def _make_handler_from(health_fn, submit_fn, refresh_fn):
     class Handler(BaseHTTPRequestHandler):
         # Silence per-request stderr chatter; obs records cover it.
         def log_message(self, fmt, *args):  # noqa: A003
@@ -69,9 +74,9 @@ def _make_handler(server: Server):
 
         def do_GET(self):  # noqa: N802 - stdlib API
             if self.path == "/healthz":
-                self._reply(200, server.health())
+                self._reply(200, health_fn())
             elif self.path == "/metrics":
-                server.refresh_gauges()
+                refresh_fn()
                 self._reply_text(
                     200,
                     obs_live.render_prometheus(obs_live.snapshot_or_none()),
@@ -118,7 +123,7 @@ def _make_handler(server: Server):
                                   "[A-Za-z0-9_-]{1,64}"})
                     return
             try:
-                resp = server.submit(
+                resp = submit_fn(
                     a, ap, b,
                     deadline_s=None if deadline_ms is None
                     else float(deadline_ms) / 1e3,
@@ -167,3 +172,20 @@ def _make_handler(server: Server):
 def serve_http(server: Server, port: int) -> ThreadingHTTPServer:
     """Bind a loopback-only HTTP server; caller runs serve_forever()."""
     return ThreadingHTTPServer(("127.0.0.1", port), _make_handler(server))
+
+
+def serve_fleet_http(fleet, port: int) -> ThreadingHTTPServer:
+    """Fleet front end: same transport, but /healthz is the FLEET view
+    (per-worker liveness, ring membership, gates, journal ownership) and
+    POST /v1/analogy routes through the consistent-hash Router."""
+
+    def _refresh():
+        for handle in list(fleet.workers.values()):
+            try:
+                handle.server.refresh_gauges()
+            except Exception:  # noqa: BLE001 - a dying worker is fine
+                pass
+
+    return ThreadingHTTPServer(
+        ("127.0.0.1", port),
+        _make_handler_from(fleet.health, fleet.submit, _refresh))

@@ -611,6 +611,13 @@ class RequestJournal:
                 for k, v in counters.items()
                 if k.startswith("serve.journal.")}
 
+    def info(self) -> Dict[str, Any]:
+        """Ownership facts for /healthz: which pid holds the advisory
+        lock and which segment this incarnation appends to — what a
+        router (or operator) checks before handing the directory to a
+        replacement worker."""
+        return {"lock_pid": self.active_pid(), "segment": self._segment}
+
 
 def _corrupt_count(path: str) -> int:
     try:

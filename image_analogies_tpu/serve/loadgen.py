@@ -157,6 +157,129 @@ def selftest(cfg: ServeConfig, n: int, *, seed: int = 0,
     }
 
 
+def fleet_selftest(fcfg: "Any", n: int, *, seed: int = 0,
+                   deadline_ms: Optional[Any] = None,
+                   shapes: Sequence[Tuple[int, int]] = DEFAULT_SHAPES
+                   ) -> Dict[str, Any]:
+    """``ia fleet --selftest N``: the synthetic load routed through the
+    consistent-hash Router over a worker fleet, against the same
+    sequential baseline.  On top of the single-server gates it verifies
+    ring affinity did something (per-worker routed counts), reports the
+    negotiated wire codec (the ``--wire`` flag exercises IAF2 vs JSON),
+    and counts spills/handoffs — all under the same bit-identity bar."""
+    from image_analogies_tpu.models.analogy import create_image_analogy
+    from image_analogies_tpu.obs import metrics as obs_metrics
+    from image_analogies_tpu.serve.fleet import Fleet
+
+    load = make_load(n, shapes, seed)
+
+    def deadline_s(i: int) -> Optional[float]:
+        if deadline_ms is None:
+            return None
+        if isinstance(deadline_ms, (int, float)):
+            return deadline_ms / 1e3
+        v = deadline_ms[i % len(deadline_ms)]
+        return None if v is None else v / 1e3
+
+    seq_params = fcfg.serve.params.replace(metrics=False, log_path=None)
+    baseline = {}
+    t0 = time.perf_counter()
+    for item in load:
+        baseline[item["index"]] = create_image_analogy(
+            item["a"], item["ap"], item["b"], seq_params).bp
+    seq_s = time.perf_counter() - t0
+
+    responses: Dict[int, Any] = {}
+    errors: Dict[int, BaseException] = {}
+    rejected = 0
+    with Fleet(fcfg) as fl:
+        t0 = time.perf_counter()
+        futures = {}
+        for item in load:
+            try:
+                futures[item["index"]] = fl.submit(
+                    item["a"], item["ap"], item["b"],
+                    deadline_s=deadline_s(item["index"]))
+            except Rejected:
+                rejected += 1
+        for idx, fut in futures.items():
+            try:
+                responses[idx] = fut.result(timeout=600)
+            except BaseException as exc:  # noqa: BLE001 - summarized
+                errors[idx] = exc
+        srv_s = time.perf_counter() - t0
+        health = fl.health()
+        snap = obs_metrics.snapshot() or {}
+        counters = snap.get("counters", {})
+
+    ok = [r for r in responses.values() if r.degraded is None]
+    degraded = [r for r in responses.values() if r.degraded is not None]
+    identical = all(
+        np.array_equal(responses[idx].bp, baseline[idx])
+        for idx in responses if responses[idx].degraded is None)
+    latencies = [r.total_ms for r in responses.values()]
+    routed = {k.split("router.routed.", 1)[1]: int(v)
+              for k, v in counters.items()
+              if k.startswith("router.routed.")}
+    codecs = {k.split("router.wire.", 1)[1]: int(v)
+              for k, v in counters.items()
+              if k.startswith("router.wire.")}
+
+    return {
+        "n": n,
+        "fleet_size": fcfg.size,
+        "wire": fcfg.wire,
+        "shapes": [list(s) for s in shapes],
+        "sequential_s": round(seq_s, 3),
+        "served_s": round(srv_s, 3),
+        "sequential_rps": round(n / seq_s, 3) if seq_s else 0.0,
+        "served_rps": round(len(responses) / srv_s, 3) if srv_s else 0.0,
+        "speedup": round(seq_s / srv_s, 3) if srv_s else 0.0,
+        "p50_ms": round(percentile(latencies, 50), 2),
+        "p95_ms": round(percentile(latencies, 95), 2),
+        "completed": len(ok),
+        "degraded": len(degraded),
+        "timeouts": sum(1 for e in errors.values()
+                        if type(e).__name__ == "DeadlineExceeded"),
+        "errors": sum(1 for e in errors.values()
+                      if type(e).__name__ != "DeadlineExceeded"),
+        "rejected": rejected,
+        "routed": routed,
+        "codecs": codecs,
+        "wire_bytes": int(counters.get("router.wire_bytes", 0)),
+        "spills": int(counters.get("router.spills", 0)),
+        "hop_faults": int(counters.get("router.hop_faults", 0)),
+        "handoffs": health.get("handoffs", 0),
+        "ring": health.get("ring", {}),
+        "bit_identical": bool(identical),
+    }
+
+
+def render_fleet(summary: Dict[str, Any]) -> str:
+    lines = [
+        f"fleet selftest: {summary['n']} requests over "
+        f"{summary['fleet_size']} workers (wire={summary['wire']})",
+        f"  sequential: {summary['sequential_s']}s "
+        f"({summary['sequential_rps']} req/s)",
+        f"  routed:     {summary['served_s']}s "
+        f"({summary['served_rps']} req/s, speedup x{summary['speedup']})",
+        f"  latency:    p50 {summary['p50_ms']}ms  p95 {summary['p95_ms']}ms",
+        f"  outcomes:   {summary['completed']} ok, "
+        f"{summary['degraded']} degraded, {summary['timeouts']} timeout, "
+        f"{summary['rejected']} rejected, {summary['errors']} error",
+        f"  affinity:   routed {summary['routed']} "
+        f"(ring members {summary['ring'].get('members', [])})",
+        f"  wire:       {summary['codecs']} "
+        f"({summary['wire_bytes']} frame bytes)",
+        f"  resilience: {summary['spills']} spills, "
+        f"{summary['hop_faults']} hop faults, "
+        f"{summary['handoffs']} handoffs",
+        f"  bit-identical to singleton dispatch: "
+        f"{summary['bit_identical']}",
+    ]
+    return "\n".join(lines)
+
+
 def render(summary: Dict[str, Any]) -> str:
     lines = [
         f"selftest: {summary['n']} requests over shapes "

@@ -390,8 +390,10 @@ class Server:
                  if k.startswith("hbm.peak_bytes.")), default=0),
             "slo": self.slo.snapshot(),
             # durability plane: live serve.journal.* counter tallies
-            # (None when the journal is disabled)
-            "journal": (self._journal.stats()
+            # plus lock-holder pid / active segment index, so a router
+            # can tell which incarnation owns the journal before a
+            # handoff (None when the journal is disabled)
+            "journal": ({**self._journal.stats(), **self._journal.info()}
                         if self._journal is not None else None),
         }
 

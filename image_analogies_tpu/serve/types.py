@@ -126,6 +126,53 @@ class ServeConfig:
                 "slo windows must satisfy 0 < fast <= slow")
 
 
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Router + worker-fleet knobs (serve/fleet.py, serve/router.py).
+
+    ``serve`` is the per-worker template; each worker gets a copy with
+    ``journal_dir`` pointed at ``<journal_root>/<wid>`` (when
+    ``journal_root`` is set) so a dead worker's journal directory can be
+    handed, whole, to its replacement."""
+
+    serve: ServeConfig
+    size: int = 2                  # number of in-process Server workers
+    journal_root: Optional[str] = None
+    vnodes: int = 32               # virtual nodes per worker on the ring
+    # Router<->worker hop encoding: "auto"/"binary" negotiate the IAF2
+    # frame (serve/wire.py) when the worker advertises it, "json" forces
+    # the list transport (the fallback both sides always speak).
+    wire: str = "auto"
+    health_interval_s: float = 0.25  # health-gate poll cadence
+    death_checks: int = 2          # consecutive failed polls -> dead
+    # Gate a worker (spill its keys to the next ring successor) when its
+    # queue depth reaches this fraction of queue_depth, or any breaker
+    # reports "open".
+    spill_queue_frac: float = 0.8
+    spill_retries: int = 3         # extra route attempts after the first
+    backoff_s: float = 0.05        # utils.failure.backoff_delay base
+    backoff_cap_s: float = 1.0
+
+    def __post_init__(self):
+        if self.size < 1:
+            raise ValueError("size must be >= 1")
+        if self.vnodes < 1:
+            raise ValueError("vnodes must be >= 1")
+        if self.wire not in ("auto", "binary", "json"):
+            raise ValueError("wire must be auto|binary|json")
+        if self.health_interval_s <= 0:
+            raise ValueError("health_interval_s must be > 0")
+        if self.death_checks < 1:
+            raise ValueError("death_checks must be >= 1")
+        if not 0.0 < self.spill_queue_frac <= 1.0:
+            raise ValueError("spill_queue_frac must be in (0, 1]")
+        if self.spill_retries < 0:
+            raise ValueError("spill_retries must be >= 0")
+        if self.backoff_s <= 0 or self.backoff_cap_s < self.backoff_s:
+            raise ValueError(
+                "backoff must satisfy 0 < backoff_s <= backoff_cap_s")
+
+
 @dataclasses.dataclass
 class Request:
     """One enqueued synthesis job.  ``deadline`` is absolute

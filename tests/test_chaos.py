@@ -28,14 +28,22 @@ from image_analogies_tpu.chaos.plan import ChaosPlan, SiteRule
 # ------------------------------------------------- drills (per kind)
 
 
-@pytest.mark.parametrize("kind", chaos.FAULT_KINDS)
+@pytest.mark.parametrize("kind", runner.DRILL_KINDS)
 def test_drill_recovers_per_fault_kind(kind):
     """The seeded smoke `ia chaos --selftest` runs in CI: one canonical
-    plan per fault kind, each asserting full recovery."""
+    plan per drill kind (every raw fault kind plus the composite fleet
+    kill-restart), each asserting full recovery."""
     report = runner.run_drill(runner.plan_for_kind(kind, seed=0))
     assert report["ok"], report["problems"]
     assert report["injected"] >= 1
     assert report["identical"] is True
+
+
+def test_drill_kinds_cover_fault_kinds():
+    """DRILL_KINDS is FAULT_KINDS plus the composite fleet drill — a new
+    fault kind automatically gains a tier-1 drill."""
+    assert set(chaos.FAULT_KINDS) <= set(runner.DRILL_KINDS)
+    assert "fleet_death" in runner.DRILL_KINDS
 
 
 def test_same_seed_same_schedule():

@@ -1,0 +1,242 @@
+"""Fleet resilience plane (ISSUE 9): consistent-hash router, health-gated
+workers, dead-worker journal handoff (serve/router.py, serve/fleet.py).
+
+Locked here:
+
+- ring determinism (sha256 positions, never hash()) and the rebalance
+  property: adding/removing a worker only remaps keys whose home WAS
+  that worker — every untouched key keeps its home;
+- router affinity: batch-compatible requests land on one home worker,
+  and routed responses stay bit-identical to direct engine runs through
+  BOTH wire codecs (IAF2 binary and JSON fallback);
+- spillover re-submit bit-identity: the same idempotency key answered
+  once on each of two workers (home gated between submissions) yields
+  identical bytes, with the spill visible in router.spills;
+- non-chaos kill -> health-loop replacement: generation bump, journal
+  handed to the replacement (lock pid / fresh segment in /healthz),
+  recovery stats reconciled, resubmission deduped from the journal;
+- `ia fleet --selftest` CLI smoke riding the obs pipeline (report
+  "fleet:" section, trace router instants).
+
+The chaos-armed fleet kill-restart drill itself rides the per-kind
+tier-1 parametrization in test_chaos.py (kind="fleet_death").
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from image_analogies_tpu.chaos import drills
+from image_analogies_tpu.obs import metrics as obs_metrics
+from image_analogies_tpu.serve.fleet import Fleet
+from image_analogies_tpu.serve.router import Ring, _point
+from image_analogies_tpu.serve.types import FleetConfig
+
+# ------------------------------------------------------------------ ring
+
+
+def test_ring_positions_deterministic():
+    """Two independently-built rings agree on every key's successor walk
+    (sha256 positions are process- and PYTHONHASHSEED-independent)."""
+    r1, r2 = Ring(vnodes=16), Ring(vnodes=16)
+    for r in (r1, r2):
+        for wid in ("w0", "w1", "w2"):
+            r.add(wid)
+    for key in ("a/b/c", "digest/1024/1024/beef", "x" * 64):
+        assert r1.successors(key) == r2.successors(key)
+    assert r1.members() == ["w0", "w1", "w2"]
+    # positions come from sha256, so they are stable across releases too
+    assert _point("w0#0") == int.from_bytes(
+        __import__("hashlib").sha256(b"w0#0").digest()[:8], "big")
+
+
+def test_ring_rebalance_keeps_untouched_keys():
+    """Join: a new worker only steals keys (they move TO it, never
+    between old workers).  Leave: removing it restores every stolen key
+    to its original home."""
+    ring = Ring(vnodes=32)
+    for i in range(4):
+        ring.add(f"w{i}")
+    keys = [f"key-{i}" for i in range(200)]
+    before = {k: ring.successors(k)[0] for k in keys}
+
+    ring.add("w4")
+    after_join = {k: ring.successors(k)[0] for k in keys}
+    moved = [k for k in keys if after_join[k] != before[k]]
+    assert moved, "w4 took no keys — vnode count too low to matter"
+    assert all(after_join[k] == "w4" for k in moved), (
+        "a key moved between OLD workers on join")
+
+    ring.remove("w4")
+    assert {k: ring.successors(k)[0] for k in keys} == before
+
+
+def test_fleet_config_validation():
+    cfg = drills.serve_config()
+    with pytest.raises(ValueError):
+        FleetConfig(serve=cfg, size=0)
+    with pytest.raises(ValueError):
+        FleetConfig(serve=cfg, wire="msgpack")
+    with pytest.raises(ValueError):
+        FleetConfig(serve=cfg, spill_queue_frac=0.0)
+    with pytest.raises(ValueError):
+        FleetConfig(serve=cfg, backoff_s=0.5, backoff_cap_s=0.1)
+
+
+# ------------------------------------------------------ routed serving
+
+
+def _fleet_cfg(tmp_path=None, wire="auto", **kw):
+    scfg = drills.serve_config(workers=1, max_batch=4,
+                               batch_window_ms=20.0)
+    return FleetConfig(
+        serve=scfg, size=2, vnodes=16, wire=wire,
+        journal_root=str(tmp_path / "journals") if tmp_path else None,
+        health_interval_s=0.05, death_checks=2,
+        backoff_s=0.01, backoff_cap_s=0.05, **kw)
+
+
+def _routed_counts():
+    snap = obs_metrics.snapshot() or {}
+    return {k.split("router.routed.", 1)[1]: int(v)
+            for k, v in (snap.get("counters") or {}).items()
+            if k.startswith("router.routed.")}
+
+
+@pytest.mark.parametrize("wire", ["binary", "json"])
+def test_router_affinity_and_bit_identity(wire):
+    """Batch-compatible requests (one shared exemplar -> one batch key)
+    all land on ONE home worker, and every routed response — through
+    either wire codec — is bit-identical to a direct engine run."""
+    fcfg = _fleet_cfg(wire=wire)
+    load = drills.make_serve_load(4)
+    baseline = {it["index"]: drills.run_image(
+        it["a"], it["ap"], it["b"], fcfg.serve.params) for it in load}
+    with Fleet(fcfg) as fl:
+        futs = {it["index"]: fl.submit(it["a"], it["ap"], it["b"])
+                for it in load}
+        resp = {i: f.result(timeout=120) for i, f in futs.items()}
+        routed = _routed_counts()
+    # one home worker took everything (consistent-hash affinity)
+    assert sorted(routed.values()) == [4], routed
+    for i, r in resp.items():
+        assert np.array_equal(np.asarray(r.bp), baseline[i])
+
+
+def test_spillover_resubmit_bit_identity(tmp_path):
+    """The same idempotency key answered once on EACH of two workers
+    (home gated between submissions) returns identical bytes: the
+    successor computes fresh in its own journal, so exactly-once holds
+    per journal and bit-identity holds across the fleet."""
+    fcfg = _fleet_cfg(tmp_path)
+    item = drills.make_serve_load(1)[0]
+    with Fleet(fcfg) as fl:
+        r1 = fl.submit(item["a"], item["ap"], item["b"],
+                       idempotency_key="spill-me").result(timeout=120)
+        (home,) = _routed_counts().keys()
+        fl.gate_worker(home, "test_spill")
+        try:
+            r2 = fl.submit(item["a"], item["ap"], item["b"],
+                           idempotency_key="spill-me").result(timeout=120)
+            routed = _routed_counts()
+            snap = obs_metrics.snapshot() or {}
+            counters = snap.get("counters") or {}
+        finally:
+            fl.ungate_worker(home)
+    assert len(routed) == 2 and all(v == 1 for v in routed.values()), (
+        "the gated resubmission did not land on the other worker")
+    assert counters.get("router.spills", 0) >= 1
+    # both workers journaled their own copy; neither deduped the other's
+    assert counters.get("serve.journal.admitted", 0) == 2
+    assert counters.get("serve.journal.done", 0) == 2
+    assert counters.get("serve.journal.deduped", 0) == 0
+    assert np.array_equal(np.asarray(r1.bp), np.asarray(r2.bp))
+
+
+def test_kill_triggers_handoff_and_dedupe(tmp_path):
+    """Non-chaos worker death: the health loop detects the dead worker,
+    hands its journal directory to a replacement (same wid, bumped
+    generation, fresh segment, this process's lock pid), and a
+    resubmission under the original key dedupes against the recovered
+    journal instead of recomputing."""
+    fcfg = _fleet_cfg(tmp_path)
+    load = drills.make_serve_load(2)
+    with Fleet(fcfg) as fl:
+        futs = {it["index"]: fl.submit(
+            it["a"], it["ap"], it["b"],
+            idempotency_key=f"handoff-{it['index']}") for it in load}
+        resp = {i: f.result(timeout=120) for i, f in futs.items()}
+        (home,) = _routed_counts().keys()
+        gen0 = fl.workers[home].generation
+
+        fl.workers[home].server.kill()
+        deadline = time.monotonic() + 30.0
+        while not fl.handoffs and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert len(fl.handoffs) == 1, "health loop never replaced the worker"
+        ho = fl.handoffs[0]
+        assert ho["worker"] == home and ho["generation"] == gen0 + 1
+        # both requests were already done: handoff replays nothing,
+        # preserves both done records
+        assert ho["recovered"]["entries"] == 2
+        assert ho["recovered"]["done"] == 2
+        assert ho["recovered"]["replayed"] == 0
+
+        health = fl.health()
+        wh = health["workers"][home]
+        assert wh["ok"] is True and wh["generation"] == gen0 + 1
+        # satellite: /healthz journal section reports lock owner + segment
+        assert wh["journal"]["lock_pid"] == os.getpid()
+        assert wh["journal"]["segment"] == 2  # incarnation 2's segment
+        assert health["handoffs"] == 1
+
+        again = fl.submit(load[0]["a"], load[0]["ap"], load[0]["b"],
+                          idempotency_key="handoff-0").result(timeout=120)
+        snap = obs_metrics.snapshot() or {}
+        deduped = (snap.get("counters") or {}).get(
+            "serve.journal.deduped", 0)
+    assert deduped == 1
+    assert again.request_id == resp[0].request_id  # the recorded response
+    assert np.array_equal(np.asarray(again.bp), np.asarray(resp[0].bp))
+
+
+# --------------------------------------------------------- CLI smoke
+
+
+def test_fleet_cli_selftest_report_and_trace(tmp_path, capsys):
+    """`ia fleet --selftest` routes the synthetic load, gates on
+    bit-identity, and its run log renders the fleet section in
+    `ia report` and router instants in `ia trace`."""
+    from image_analogies_tpu.cli import main
+    from image_analogies_tpu.obs import export as obs_export
+
+    log = str(tmp_path / "fleet.jsonl")
+    rc = main(["fleet", "--selftest", "3", "--size", "2",
+               "--max-batch", "3", "--batch-window-ms", "50",
+               "--levels", "2", "--backend", "cpu", "--log-path", log])
+    captured = capsys.readouterr()
+    assert rc == 0, captured.err
+    assert "fleet selftest: 3 requests over 2 workers" in captured.out
+    assert "bit-identical to singleton dispatch: True" in captured.out
+    summary = json.loads(captured.err.strip().splitlines()[-1])
+    assert summary["errors"] == 0 and summary["bit_identical"] is True
+    assert sum(summary["routed"].values()) == 3
+    assert summary["codecs"].get("iaf2", 0) == 3  # auto negotiates binary
+
+    rc = main(["report", log])
+    assert rc == 0
+    rep = capsys.readouterr().out
+    assert "fleet:" in rep and "routing" in rep
+
+    out = str(tmp_path / "trace.json")
+    rc = main(["trace", log, "-o", out])
+    assert rc == 0
+    capsys.readouterr()
+    trace = json.load(open(out))
+    routes = [e for e in trace["traceEvents"]
+              if e.get("tid") == obs_export.SERVE_TID
+              and e["ph"] == "i" and e["name"].startswith("route ")]
+    assert len(routes) == 3  # one routing instant per request

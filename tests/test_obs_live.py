@@ -497,6 +497,58 @@ def test_bench_sentry_groups_by_metric_key(tmp_path):
     assert bench.check_regression(traj, fresh_value=14.0)["ok"] is True
 
 
+def test_bench_check_empty_trajectory_is_no_floor_pass(tmp_path, capsys):
+    """A fresh value whose metric has no archived floor (new metric, or
+    an empty archive) passes explicitly as 'no floor, recorded only'
+    instead of crashing or gating against an unrelated metric's floor;
+    the dry-run path (nothing to check at all) still fails."""
+    import importlib.util
+
+    from image_analogies_tpu import cli
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_probe2", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    # empty archive + fresh value: explicit recorded-only pass
+    traj = bench.load_trajectory(str(tmp_path))
+    verdict = bench.check_regression(traj, fresh_value=5.0)
+    assert verdict["ok"] is True
+    assert verdict["reason"] == "no_floor_recorded_only"
+    assert verdict["no_floor"] == 1
+
+    # empty archive WITHOUT a fresh value: still an explicit failure
+    assert bench.check_regression(traj)["ok"] is False
+    assert bench.check_regression(traj)["reason"] == "no_trajectory_points"
+
+    # archive exists, but the fresh value names a BRAND-NEW metric:
+    # no-floor pass under its own key, never the other metric's floor
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"parsed": {"value": 1.0, "metric": "1024x1024 north star"},
+         "tail": ""}))
+    traj = bench.load_trajectory(str(tmp_path))
+    verdict = bench.check_regression(traj, fresh_value=500.0,
+                                     fresh_key="fleet_selftest_s")
+    assert verdict["ok"] is True
+    assert verdict["reason"] == "no_floor_recorded_only"
+    assert verdict["metric_key"] == "fleet_selftest_s"
+    # ... while a MATCHING fresh_key still gates against the floor
+    verdict = bench.check_regression(traj, fresh_value=500.0,
+                                     fresh_key="1024x1024")
+    assert verdict["ok"] is False and verdict["floor"] == 1.0
+
+    # CLI plumbing: --metric-key rides --value end to end
+    rc = cli.main(["bench", "--check", "--value", "5.0",
+                   "--metric-key", "brand_new_metric",
+                   "--dir", str(tmp_path)])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["reason"] == "no_floor_recorded_only"
+    assert out["metric_key"] == "brand_new_metric"
+
+
 # ------------------------------------------------ grep locks
 
 

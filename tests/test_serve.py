@@ -587,11 +587,16 @@ def test_serve_never_calls_jit_directly():
     # call syntax, so prose mentions in docstrings don't trip the lock
     forbidden = re.compile(r"\bjax\.jit\s*\(|\bpjit\s*\(|\bjax\.pmap\s*\(")
     toplevel_jax = re.compile(r"^(import jax|from jax)", re.MULTILINE)
+    scanned = set()
     for name in sorted(os.listdir(root)):
         if not name.endswith(".py"):
             continue
+        scanned.add(name)
         with open(os.path.join(root, name)) as f:
             src = f.read()
         assert not forbidden.findall(src), f"serve/{name} calls jit/pjit"
         assert not toplevel_jax.findall(src), (
             f"serve/{name} imports jax at module scope")
+    # the fleet plane must stay under this lock — a rename that moves
+    # router/fleet out of serve/ must move the jax-free guarantee with it
+    assert {"router.py", "fleet.py"} <= scanned
