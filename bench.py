@@ -387,6 +387,72 @@ def _run_tpu(a, ap, b, params, keep_levels=False, reps=3):
     return res, t_min, t_med
 
 
+def bench_batched(k: int, size: int = 256, levels: int = 2,
+                  reps: int = 3) -> int:
+    """`ia bench --batch K`: batched B-axis engine throughput point.
+
+    Synthesizes K same-shape B' planes twice — sequentially (K singleton
+    engine runs, the bit-identity reference) and through
+    batch/engine.py's single vmapped launch — and prints ONE JSON line
+    whose headline ``value`` is the batched MARGINAL per-lane wall-clock
+    (batched seconds / K, min-of-reps).  Lower is better, so the number
+    rides the same `ia bench --check` trajectory gate as the north star:
+    the metric string leads with the ``batched_qps`` key, giving the
+    sentry a distinct metric family (a batched point never gates against
+    a 1024^2 singleton point).  Raw lanes-per-second rides along as
+    ``qps``.
+
+    The run refuses to report a throughput win that broke correctness:
+    ``bit_identical`` compares every batched member against its
+    sequential singleton, and a False fails the command (exit 1) —
+    a fast wrong engine must not record a trajectory point.
+    """
+    from image_analogies_tpu.batch.engine import create_image_analogy_batch
+    from image_analogies_tpu.config import AnalogyParams
+    from image_analogies_tpu.models.analogy import create_image_analogy
+
+    import jax
+
+    dev = jax.devices()[0].device_kind
+    a, ap, _ = make_structured(size)
+    # distinct targets per lane: identical B planes would let a broken
+    # lane-broadcast masquerade as a working batch
+    targets = [make_structured(size, 11 + i)[2] for i in range(k)]
+    # batched strategy (the throughput path); remap off — per-member
+    # luminance remap diverges the shared A/A' DB and the engine refuses
+    p = AnalogyParams(levels=levels, kappa=5.0, backend="tpu",
+                      strategy="batched", level_sync=False,
+                      remap_luminance=False)
+
+    seq_res, seq_s, seq_med = _timed(
+        lambda: [create_image_analogy(a, ap, b, p) for b in targets], reps)
+    bat_res, bat_s, bat_med = _timed(
+        lambda: create_image_analogy_batch(a, ap, targets, p), reps)
+
+    errors = [r for r in bat_res if isinstance(r, Exception)]
+    identical = not errors and all(
+        np.array_equal(np.asarray(s.bp), np.asarray(r.bp))
+        for s, r in zip(seq_res, bat_res))
+    print(json.dumps({
+        "metric": f"batched_qps marginal per-lane wall-clock, "
+                  f"k={k} x {size}^2 B', {levels}-level pyramid, "
+                  f"batched strategy on {dev}",
+        "value": round(bat_s / k, 4),
+        "value_median": round(bat_med / k, 4),
+        "unit": "s/lane",
+        "qps": round(k / bat_s, 3),
+        "k": k,
+        "batched_s": round(bat_s, 3),
+        "sequential_s": round(seq_s, 3),
+        "sequential_s_median": round(seq_med, 3),
+        "batch_speedup": round(seq_s / bat_s, 2),
+        "bit_identical": bool(identical),
+        "lane_errors": len(errors),
+        **_obs_fields(),
+    }), flush=True)
+    return 0 if identical else 1
+
+
 def main() -> int:
     import jax
 

@@ -188,6 +188,16 @@ class TpuLevelDB:
     # keeps ha/wa static and the generated HLO bit-identical to the
     # unbucketed engine; all consumers go through a_dims()/a_rows().
     dims_a: Optional[jax.Array] = None
+    # QUERY-side bucketing (batched strategy only, ROADMAP direction 4
+    # stepping stone): the REAL B row count hb as a traced (1,) int32
+    # leaf with static hb set to the 0 sentinel, so the batched scan
+    # caches on the BUCKETED static_q row count and differently-sized
+    # targets share one program (and one batched-lane program —
+    # batch/engine.py).  ``wb`` stays STATIC always: it is the
+    # `dynamic_slice` SIZE in `_row_queries`.  The wavefront strategy
+    # cannot query-bucket — its packed (Nb, 2) carry and anti-diagonal
+    # schedule are program structure keyed on the exact (hb, wb).
+    dims_b: Optional[jax.Array] = None
 
     def a_dims(self):
         """(ha, wa) as ints (static path) or traced scalars (bucketed)."""
@@ -199,6 +209,18 @@ class TpuLevelDB:
         """Real DB row count ha*wa (excludes bucket padding rows)."""
         ha, wa = self.a_dims()
         return ha * wa
+
+    def b_dims(self):
+        """(hb, wb): hb an int (static path) or traced scalar (query-
+        bucketed); wb is always the static int (dynamic_slice size)."""
+        if self.dims_b is not None:
+            return self.dims_b[0], self.wb
+        return self.hb, self.wb
+
+    def b_rows(self):
+        """Real query row count hb*wb (excludes bucket padding rows)."""
+        hb, wb = self.b_dims()
+        return hb * wb
 
 
 jax.tree_util.register_dataclass(
@@ -371,11 +393,12 @@ def _packed_weight_arrays(src, spec, npad: int, mode2p: bool):
 
 
 @functools.partial(jax.jit, static_argnames=("spec", "pad_tile", "pad_full",
-                                             "pad_mode", "db_rows_pad"))
+                                             "pad_mode", "db_rows_pad",
+                                             "q_rows_pad"))
 def _prepare_level_arrays(
     spec, a_src, a_filt, a_src_coarse, a_filt_coarse, a_temporal,
     b_src, b_src_coarse, b_filt_coarse, b_temporal, rowsafe, pad_tile,
-    pad_full=False, pad_mode="f32", db_rows_pad=0,
+    pad_full=False, pad_mode="f32", db_rows_pad=0, q_rows_pad=0,
 ):
     """All device-side level preparation fused into ONE program: eager
     per-op dispatch over the PJRT tunnel costs ~1s/level otherwise.
@@ -404,7 +427,14 @@ def _prepare_level_arrays(
     carry +inf norms so the argmin never picks them, and full-array pads
     are zero rows that no gather reaches (coherence candidates clip to
     the real A extent; the anchor clamps to the real row count).  0 (the
-    default) reproduces the unbucketed arrays bit-for-bit."""
+    default) reproduces the unbucketed arrays bit-for-bit.
+
+    ``q_rows_pad`` (query-side bucketing, batched strategy only) grows
+    ``static_q`` to the bucketed QUERY row count with zero rows.  The
+    batched scan's row loop runs only over the REAL hb (traced through
+    ``TpuLevelDB.dims_b``), so padded query rows are never read and
+    never written — padding honesty holds by construction, whatever the
+    pad contents (tests/test_batch.py adversarially overwrites them)."""
     db = build_features_jax(spec, a_src, a_filt, a_src_coarse, a_filt_coarse,
                             temporal_fine=a_temporal)
     static_q = build_features_jax(spec, b_src, None, b_src_coarse,
@@ -508,13 +538,16 @@ def _prepare_level_arrays(
         out["a_filt_flat"] = zrows(out["a_filt_flat"])
         if out["db_live"] is not None:
             out["db_live"] = zrows(out["db_live"])
+    if q_rows_pad and q_rows_pad > out["static_q"].shape[0]:
+        grow_q = q_rows_pad - out["static_q"].shape[0]
+        out["static_q"] = jnp.pad(out["static_q"], ((0, grow_q), (0, 0)))
     return out
 
 
 _prepare_level_arrays = obs_device.instrument(
     _prepare_level_arrays, "tpu.prepare_level_arrays",
-    # spec, pad_tile, pad_full, pad_mode, db_rows_pad
-    static_argnums=(0, 11, 12, 13, 14))
+    # spec, pad_tile, pad_full, pad_mode, db_rows_pad, q_rows_pad
+    static_argnums=(0, 11, 12, 13, 14, 15))
 
 
 @functools.lru_cache(maxsize=None)
@@ -962,10 +995,19 @@ def batched_scan_core(db: TpuLevelDB, kappa_mult, approx_fn,
     Returns (bp, s, counts) with counts = [n_coherence_picks (pre-refine,
     comparable with the CPU oracle's stat), n_refined_picks (picks the
     left-propagation refinement switched to a same-row candidate)].
+
+    Query bucketing (TpuLevelDB.dims_b): the carry is sized by
+    ``static_q``'s (possibly bucketed) row count while the row loop runs
+    only to the REAL hb — padded query rows are never read, never
+    scored, never written, so padded lanes cannot influence real lanes'
+    argmins and the caller crops the trailing pad rows off bp/s.
+    Unbucketed (dims_b None) the shapes and bounds are the ints they
+    always were — the generated HLO is unchanged.
     """
     nf = int(db.off.shape[0])
     nrs = db.n_rowsafe
-    wb, hb = db.wb, db.hb
+    wb = db.wb  # ALWAYS static: the dynamic_slice width in _row_queries
+    hb = db.b_dims()[0]
     if row_fn is None:
         row_fn = lambda i: db.db_rowsafe[i]
     if afilt_fn is None:
@@ -1000,8 +1042,9 @@ def batched_scan_core(db: TpuLevelDB, kappa_mult, approx_fn,
         n_ref = (d_pick < jnp.inf).sum(dtype=jnp.int32) - n_coh
         return bp, s, counts + jnp.stack([n_coh, n_ref])
 
-    bp0 = jnp.zeros((hb * wb,), _F32)
-    s0 = jnp.zeros((hb * wb,), jnp.int32)
+    nq = db.static_q.shape[0]  # == hb*wb unbucketed; the bucket otherwise
+    bp0 = jnp.zeros((nq,), _F32)
+    s0 = jnp.zeros((nq,), jnp.int32)
     return jax.lax.fori_loop(0, hb, row_body,
                              (bp0, s0, jnp.zeros((2,), jnp.int32)))
 
@@ -1570,6 +1613,46 @@ _RUNNERS = {
 }
 
 
+# ----------------------------------------------------- batched-lane runner
+
+
+@jax.jit
+def _run_lanes(db: TpuLevelDB, qsides, kappa_mult):
+    """ONE device program synthesizing k B' lanes (batch/engine.py).
+
+    ``db`` is lane 0's full TpuLevelDB — the A/A' scoring arrays are
+    shared by construction (the engine preflights that every member
+    preps the identical A planes); ``qsides`` is a dict of the QUERY-
+    side leaves (static_q, flat_idx, valid, written, and dims_b when
+    bucketed), each stacked on a leading lane axis — everything about a
+    member that depends on its own B plane, so same-bucket members with
+    DIFFERENT real row counts still share this one program (each lane's
+    scan bound rides its own traced hb).  Each lane is the EXACT
+    singleton scan (`batched_scan_core` / `wavefront_scan_core` with
+    the same anchor machinery) vmapped over the query side only, so the
+    compiled program is the batched twin of the singleton program: same
+    contraction shapes, same gathers, same kappa rule — bit-identity
+    per lane is locked by tests/test_batch.py and the loadgen selftest
+    gate.  Returns (bp (k, Nq), s (k, Nq), counts (k, 2)).
+    """
+    import dataclasses
+
+    def lane(qside):
+        lane_db = dataclasses.replace(db, **qside)
+        if db.strategy == "wavefront":
+            bp, s, n_coh = wavefront_scan_core(
+                lane_db, kappa_mult,
+                make_anchor_fn(lane_db, defer_rescore=True))
+            return bp, s, jnp.stack([n_coh, jnp.int32(0)])
+        return batched_scan_core(lane_db, kappa_mult,
+                                 make_approx_fn(lane_db))
+
+    return jax.vmap(lane)(qsides)
+
+
+_run_lanes = obs_device.instrument(_run_lanes, "tpu.run_lanes")
+
+
 # ------------------------------------------------- bf16 scoring parity gate
 #
 # AnalogyParams.bf16_scoring routes the wavefront anchor through the
@@ -1762,9 +1845,19 @@ class TpuMatcher(Matcher):
         # instead of the exact exemplar size.  Single-chip only — the
         # sharded builders have their own pad geometry.
         db_rows_pad = 0
+        q_rows_pad = 0
+        hb, wb = job.b_shape
         if (not sharded and self.params.data_shards == 1
                 and tune_buckets.buckets_enabled(self.params)):
             db_rows_pad = tune_buckets.bucket_rows(ha * wa)
+            if strategy == "batched":
+                # QUERY-side bucketing (ROADMAP direction 4 stepping
+                # stone): only the batched scan can trace its query row
+                # count — its carry is sized by static_q and its row
+                # loop bound rides dims_b.  The wavefront scan cannot
+                # (packed (Nb, 2) carry + diag schedule are program
+                # structure), so it keeps exact-(hb, wb)-keyed programs.
+                q_rows_pad = tune_buckets.bucket_rows(hb * wb)
         pad_tile = 0
         if strategy in ("batched", "wavefront") and not sharded \
                 and self.params.data_shards == 1 \
@@ -1779,6 +1872,19 @@ class TpuMatcher(Matcher):
             template = dataclasses.replace(
                 template, ha=0, wa=0,
                 dims_a=jnp.asarray([ha, wa], jnp.int32))
+        if q_rows_pad:
+            # pad the (Nb, nf) gather maps to the query bucket (zero
+            # rows — the scan's row loop never reaches them) and carry
+            # the real hb as the traced dims_b leaf; wb stays static
+            # (the dynamic_slice width in _row_queries).  Fresh padded
+            # arrays, so the donated twin's map split stays safe.
+            qgrow = q_rows_pad - hb * wb
+            template = dataclasses.replace(
+                template,
+                flat_idx=jnp.pad(template.flat_idx, ((0, qgrow), (0, 0))),
+                valid=jnp.pad(template.valid, ((0, qgrow), (0, 0))),
+                written=jnp.pad(template.written, ((0, qgrow), (0, 0))),
+                hb=0, dims_b=jnp.asarray([hb], jnp.int32))
 
         if sharded:
             from image_analogies_tpu.parallel.mesh import make_mesh
@@ -1818,7 +1924,7 @@ class TpuMatcher(Matcher):
             to_j(job.a_temporal), to_j(job.b_src),
             to_j(job.b_src_coarse), to_j(job.b_filt_coarse),
             to_j(job.b_temporal), template.rowsafe, pad_tile, pad_full,
-            pad_mode, db_rows_pad)
+            pad_mode, db_rows_pad, q_rows_pad)
         return dataclasses.replace(
             template,
             db=arrs["db"],
@@ -1953,6 +2059,12 @@ class TpuMatcher(Matcher):
             runner = _RUNNERS[db.strategy]
             bp, s, n_coh = runner(db, jnp.float32(job.kappa_mult))
         hb, wb = job.b_shape
+        if bp.shape[0] != hb * wb:
+            # query-bucketed batched level: crop the pad rows (never
+            # written — the scan loop stops at the real hb) off the
+            # bucket-sized planes before the (hb, wb) reshape
+            bp = bp[:hb * wb]
+            s = s[:hb * wb]
         bp = bp.reshape(hb, wb)
         s = s.reshape(hb, wb)
         n = hb * wb
@@ -1983,3 +2095,62 @@ class TpuMatcher(Matcher):
             # stays comparable with the CPU oracle's.
             stats["_n_ref"] = n_ref
         return bp, s, stats
+
+    def synthesize_level_lanes(self, dbs, jobs):
+        """Batched-lane twin of `synthesize_level` (batch/engine.py):
+        k same-bucket members share ONE compiled program and ONE launch.
+
+        ``dbs``/``jobs`` are the members' per-level TpuLevelDBs (from
+        `build_features`) and LevelJobs — bit-identical A/A' arrays
+        (engine-preflighted), differing only in the query side.  Lane
+        0's DB rides whole; the other lanes contribute ONLY their
+        query-side leaves (static_q plus the per-pixel gather maps,
+        and, when bucketed, their traced ``dims_b`` row counts),
+        stacked on a leading axis for the vmapped `_run_lanes` core.
+        Returns a list of per-lane (bp (hb, wb), s (hb, wb), stats) in
+        member order, cropped to each member's REAL shape.
+
+        Per-lane timing is the LAUNCH wall-clock (one program ran), with
+        ``lanes`` in each stats dict so obs/report can attribute the
+        marginal cost / k — mirroring serve's one-observe-per-launch
+        cost accounting (serve/worker.py)."""
+        t0 = time.perf_counter()
+        db0 = dbs[0]
+        if db0.strategy == "wavefront":
+            _wavefront_rows_guard(db0)  # host side: jit cache skips traces
+        qnames = ["static_q", "flat_idx", "valid", "written"]
+        if db0.dims_b is not None:
+            qnames.append("dims_b")
+        qsides = {nm: jnp.stack([getattr(d, nm) for d in dbs])
+                  for nm in qnames}
+        bp, s, counts = _run_lanes(db0, qsides,
+                                   jnp.float32(jobs[0].kappa_mult))
+        sync = self.params.level_sync or self.params.level_retries > 0
+        if sync:
+            jax.block_until_ready((bp, s))
+        dt = time.perf_counter() - t0
+        outs = []
+        for i, job in enumerate(jobs):
+            hb, wb = job.b_shape
+            n = hb * wb
+            bpi, si = bp[i], s[i]
+            if bpi.shape[0] != n:  # query-bucketed: crop the pad rows
+                bpi, si = bpi[:n], si[:n]
+            stats = {
+                "level": job.level,
+                "db_rows": job.a_shape[0] * job.a_shape[1],
+                "pixels": n,
+                "_n_coh": counts[i, 0],
+                "backend": "tpu",
+                "strategy": db0.strategy,
+                "lanes": len(dbs),
+            }
+            if sync:
+                stats["pixels_per_s"] = n / max(dt, 1e-9)
+                stats["ms"] = dt * 1e3
+            else:
+                stats["enqueue_ms"] = dt * 1e3
+            if db0.strategy == "batched":
+                stats["_n_ref"] = counts[i, 1]
+            outs.append((bpi.reshape(hb, wb), si.reshape(hb, wb), stats))
+        return outs

@@ -1,0 +1,15 @@
+"""Batched B-axis engine: one device program synthesizes k B' planes.
+
+`create_image_analogy_batch` stacks k same-bucket targets on a leading
+lane axis and drives the existing level programs through a vmapped twin
+(`backends.tpu._run_lanes`), sharing ONE compiled program, one devcache
+upload of the A/A' DB, and one coarse-to-fine driver loop per launch.
+Every batched member is bit-identical to its sequential singleton run;
+incompatible batches raise `BatchIncompatible` so callers (serve/) fall
+back to the sequential path with the reason on a counter label.
+"""
+
+from image_analogies_tpu.batch.engine import (BatchIncompatible,
+                                              create_image_analogy_batch)
+
+__all__ = ["BatchIncompatible", "create_image_analogy_batch"]

@@ -1,0 +1,384 @@
+"""Batched B-axis synthesis engine (ROADMAP direction 4).
+
+The serial driver (`models.analogy`) runs one B plane per coarse-to-fine
+loop: every request re-walks the level loop, re-enqueues one device
+program per level, and pays the full launch overhead alone.  For serve
+workloads the common case is k small same-shape targets against ONE
+exemplar pair — the A/A' feature DB, the level schedule, and the
+compiled programs are all shared; only the query planes differ.  This
+engine stacks those k query planes on a leading lane axis and runs the
+EXACT singleton scan vmapped over lanes (`backends.tpu._run_lanes`):
+one compiled program, one devcache upload of the A/A' DB, one driver
+loop, k results.
+
+Correctness contract — the non-negotiable invariant every test gates:
+each batched member is **bit-identical** to its sequential singleton
+run.  That holds because nothing about a lane's computation changes:
+per-lane `build_features` runs the identical jitted prep program on the
+identical inputs (so `static_q` is bitwise the singleton's), the A/A'
+arrays are preflighted bitwise-equal across members, and `jax.vmap`
+adds a batch dimension without reassociating the per-lane arithmetic.
+Anything that WOULD diverge refuses the batch instead
+(:class:`BatchIncompatible`), and the caller falls back to the
+sequential path — refusal reasons ride the
+``batch.fallback_sequential.<reason>`` counter so operators can see why
+batching isn't engaging:
+
+  level_retries     §5.3 retries rebuild one member's level; a shared
+                    launch cannot re-run one lane
+  sharded           data_shards > 1 composes with the mesh wavefront,
+                    not the lane axis
+  cpu_backend       params.backend == "cpu" is the NumPy oracle — not
+                    vmappable (backend "tpu" under JAX_PLATFORMS=cpu IS
+                    supported; the XLA programs compile anywhere)
+  unsupported       strategy/feature outside the lanes runner
+                    (exact/rowwise probes, checkpoints, profiling)
+  shape_mismatch    members disagree on shape where sharing needs
+                    equality (wavefront lanes, unbucketed batched)
+  mixed_bucket      bucketed members land in different query buckets at
+                    some level
+  remap_divergence  remap_luminance couples the A/A' DB to each
+                    member's B stats and the members' stats differ
+  pad_waste         a member's finest-level query pad exceeds the tuned
+                    ceiling (tune.resolve.batch_pad_waste_pct) — dead
+                    padded rows cost real FLOPs in every scan row
+  degrade_divergence (serve-layer) members' degrade plans differ; the
+                    worker refuses before calling the engine
+
+Query-side bucketing (tune/buckets.py) is what lets same-bucket members
+with DIFFERENT real row counts share the one program: each lane's scan
+bound rides its own traced ``dims_b`` leaf, padded query rows are never
+read (padding honesty is by construction — the row loop bound is the
+real hb), and results are cropped back to each member's real shape on
+exit.  The pad-waste ceiling keeps the shared-program win from losing
+to dead-row compute on pathological just-past-a-bucket-edge shapes.
+
+Lane-fault isolation: a chaos/device fault in ONE lane's host-side
+dispatch (`engine.batch` site, `build_features`) marks that member
+failed and duplicates a live lane's query plane in its slot — k stays
+shape-stable so the compiled program is reused — and the other k-1
+members complete bit-identically.  The engine returns a mixed list
+(AnalogyResult | Exception per member) so callers re-dispatch only the
+failed members.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from image_analogies_tpu import chaos
+from image_analogies_tpu.backends import get_backend
+from image_analogies_tpu.backends.base import LevelJob
+from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.models.analogy import (AnalogyResult,
+                                                _finalize_stats,
+                                                _prep_planes,
+                                                create_image_analogy)
+from image_analogies_tpu.obs import device as obs_device
+from image_analogies_tpu.obs import metrics as obs_metrics
+from image_analogies_tpu.obs import trace as obs_trace
+from image_analogies_tpu.ops import color
+from image_analogies_tpu.ops.features import spec_for_level
+from image_analogies_tpu.ops.pyramid import build_pyramid_np, num_feasible_levels
+from image_analogies_tpu.tune import buckets as tune_buckets
+from image_analogies_tpu.utils import logging as ialog
+
+
+class BatchIncompatible(RuntimeError):
+    """This batch cannot share one device program; run members
+    sequentially.  ``reason`` is the counter label (see module
+    docstring for the vocabulary)."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        self.reason = reason
+        super().__init__(f"batch incompatible ({reason})"
+                         + (f": {detail}" if detail else ""))
+
+
+def _refuse(reason: str, detail: str = "") -> None:
+    obs_metrics.inc(f"batch.fallback_sequential.{reason}")
+    raise BatchIncompatible(reason, detail)
+
+
+def create_image_analogy_batch(
+    a: np.ndarray,
+    ap: np.ndarray,
+    targets: Sequence[np.ndarray],
+    params: AnalogyParams = AnalogyParams(),
+    backend=None,
+) -> List[Any]:
+    """Synthesize B'_i for every B_i in ``targets`` against one (A, A')
+    pair, sharing one compiled program and one driver loop per level.
+
+    Returns a list the length of ``targets`` holding AnalogyResult for
+    members that completed and the Exception for members whose lane
+    faulted (per-lane isolation; see module docstring).  Raises
+    :class:`BatchIncompatible` when the batch as a whole cannot take
+    the shared path — callers fall back to sequential singletons.
+    """
+    targets = list(targets)
+    if not targets:
+        return []
+    if len(targets) == 1:
+        # A 1-batch IS the sequential path; delegating keeps the jit
+        # cache warm for real singletons instead of tracing a k=1 twin.
+        try:
+            return [create_image_analogy(a, ap, targets[0], params,
+                                         backend=backend)]
+        except Exception as e:  # uniform per-member fault contract
+            return [e]
+
+    from image_analogies_tpu.tune import resolve as tune_resolve
+    from image_analogies_tpu.tune import warmup as tune_warmup
+
+    tune_warmup.apply_runtime_config(params)
+    with obs_trace.run_scope(params,
+                             manifest_extra=tune_resolve.manifest_info()):
+        with tune_resolve.pin_scope():
+            return _run_batch(a, ap, targets, params, backend)
+
+
+def _effective_strategy(params: AnalogyParams) -> str:
+    # mirrors TpuMatcher.build_features: auto resolves to wavefront
+    return "wavefront" if params.strategy == "auto" else params.strategy
+
+
+def _preflight(a, ap, targets, params):
+    """Refuse anything that would break single-program sharing or the
+    bit-identity contract.  Returns the per-member prepped planes."""
+    if params.level_retries > 0:
+        _refuse("level_retries", "per-member level retries cannot re-run "
+                "one lane of a shared launch")
+    if params.data_shards > 1:
+        _refuse("sharded", "data_shards composes with the mesh wavefront, "
+                "not the lane axis")
+    if params.backend != "tpu":
+        _refuse("cpu_backend", "the NumPy oracle backend is not vmappable")
+    strategy = _effective_strategy(params)
+    if strategy not in ("wavefront", "batched"):
+        _refuse("unsupported", f"strategy {strategy!r} has no lanes runner")
+    if (params.checkpoint_dir or params.save_levels_dir
+            or params.profile_dir or params.resume_from_level is not None):
+        _refuse("unsupported", "checkpoint/save-levels/profile runs need "
+                "the sequential driver")
+
+    preps = []
+    try:
+        for b in targets:
+            preps.append(_prep_planes(a, ap, b, params))
+    except ValueError as e:
+        _refuse("shape_mismatch", str(e))
+    # remap_luminance couples the A/A' DB to each member's B stats
+    # (Hertzmann §3.4): lanes share lane 0's DB, so every member must
+    # have prepped bitwise-identical A planes.  Compared unconditionally
+    # — any A-side divergence, whatever its cause, breaks sharing.
+    a0_src, _, a0_filt = preps[0][0], preps[0][1], preps[0][2]
+    for p in preps[1:]:
+        if not (np.array_equal(a0_src, p[0]) and np.array_equal(a0_filt,
+                                                                p[2])):
+            _refuse("remap_divergence", "members' luminance stats remap "
+                    "the A/A' DB differently; batch with "
+                    "remap_luminance=False or identical-stats targets")
+    return preps, strategy
+
+
+def _check_level_shapes(b_pyrs, strategy, params, levels):
+    """Per-level shape compatibility across members; returns the finest
+    -level max pad-waste fraction (0.0 when unbucketed)."""
+    bucketed = (strategy == "batched"
+                and tune_buckets.buckets_enabled(params))
+    waste = 0.0
+    for level in range(levels):
+        shapes = [p[level].shape[:2] for p in b_pyrs]
+        if not bucketed:
+            if any(sh != shapes[0] for sh in shapes[1:]):
+                _refuse("shape_mismatch",
+                        f"level {level} B shapes {shapes} must be "
+                        "identical for the "
+                        + ("wavefront" if strategy == "wavefront"
+                           else "unbucketed") + " lanes runner")
+            continue
+        if any(sh[1] != shapes[0][1] for sh in shapes[1:]):
+            # wb is the dynamic_slice width in the row-query gather — a
+            # STATIC program constant that bucketing cannot absorb.
+            _refuse("shape_mismatch",
+                    f"level {level} B widths {[sh[1] for sh in shapes]} "
+                    "must be identical (wb is static)")
+        bks = [tune_buckets.bucket_rows(h * w) for h, w in shapes]
+        if any(bk != bks[0] for bk in bks[1:]):
+            _refuse("mixed_bucket",
+                    f"level {level} query buckets {bks} diverge")
+        if level == 0:
+            # Waste gate at the FINEST level only: level sizes shrink
+            # geometrically, so the finest level dominates the dead-row
+            # FLOPs the ceiling protects against.
+            waste = max(tune_buckets.pad_waste_frac(h * w, bks[0])
+                        for h, w in shapes)
+    return waste
+
+
+def _finalize_lane(bp_dev, s_dev, stats, params, ap_rgb, b_yiq):
+    """Per-lane tail of the sequential driver: fetch the deferred device
+    scalars fused with the finest plane, then reconstruct color exactly
+    as `models.analogy._create_image_analogy` does (same ops, same
+    order — the fetch moves bits, it never computes)."""
+    need_s_host = params.color_mode == "source_rgb"
+    dev = [(st, k) for st in stats for k in ("_n_coh", "_n_ref")
+           if k in st and not isinstance(st[k], (int, float, np.number))]
+    if dev:
+        import jax
+        import jax.numpy as jnp
+
+        with obs_trace.span("fetch"):
+            bundle = (jnp.stack([st[k] for st, k in dev]), bp_dev) + (
+                (s_dev,) if need_s_host else ())
+            got = jax.device_get(bundle)
+        vals, bp_fetched = got[0], got[1]
+        for (st, k), v in zip(dev, vals):
+            st[k] = float(v)
+        bp_y = np.asarray(bp_fetched, np.float32)
+        s_raw = np.asarray(got[2], np.int32) if need_s_host else s_dev
+        obs_metrics.inc("fetch.bytes", int(vals.nbytes) + int(bp_y.nbytes))
+    else:
+        bp_y = np.asarray(bp_dev, np.float32)
+        s_raw = np.asarray(s_dev, np.int32) if need_s_host else s_dev
+    for st in stats:
+        _finalize_stats(st)
+        ialog.emit(st, params.log_path)
+    if obs_metrics._ACTIVE:
+        for st in stats:
+            cr, px = st.get("coherence_ratio"), st.get("pixels", 0)
+            if cr is not None and px:
+                obs_metrics.inc("kappa.coherence_px", cr * px)
+                obs_metrics.inc("kappa.total_px", px)
+    if params.color_mode == "source_rgb":
+        ap_flat = ap_rgb.reshape(-1, ap_rgb.shape[-1]) if ap_rgb.ndim == 3 \
+            else ap_rgb.reshape(-1)
+        out = ap_flat[np.asarray(s_raw, np.int32).reshape(-1)].reshape(
+            bp_y.shape + (() if ap_rgb.ndim == 2 else (ap_rgb.shape[-1],)))
+    elif b_yiq is not None:
+        out = color.yiq2rgb(
+            np.stack([bp_y, b_yiq[..., 1], b_yiq[..., 2]], axis=-1))
+    else:
+        out = np.clip(bp_y, 0.0, 1.0)
+    return AnalogyResult(bp=out, bp_y=bp_y, source_map_raw=s_raw,
+                         stats=stats, levels=None, timing={})
+
+
+def _run_batch(a, ap, targets, params, backend) -> List[Any]:
+    preps, strategy = _preflight(a, ap, targets, params)
+    k = len(targets)
+    backend = backend or get_backend(params)
+    if not hasattr(backend, "synthesize_level_lanes"):
+        _refuse("unsupported",
+                f"backend {type(backend).__name__} has no lanes runner")
+
+    # A-side planes are bitwise-equal across members (preflighted), so
+    # member 0's pyramids serve every lane; query pyramids are per-lane.
+    a_src, _, a_filt, ap_rgb, _ = preps[0]
+    min_shapes = [(min(a_src.shape[0], p[1].shape[0]),
+                   min(a_src.shape[1], p[1].shape[1])) for p in preps]
+    levels_per = [num_feasible_levels(ms, params.levels, params.patch_size)
+                  for ms in min_shapes]
+    if any(lv != levels_per[0] for lv in levels_per[1:]):
+        _refuse("shape_mismatch",
+                f"members disagree on feasible levels {levels_per}")
+    levels = levels_per[0]
+
+    a_src_pyr = build_pyramid_np(a_src, levels)
+    a_filt_pyr = build_pyramid_np(a_filt, levels)
+    b_pyrs = [build_pyramid_np(p[1], levels) for p in preps]
+    src_channels = 1 if a_src.ndim == 2 else a_src.shape[-1]
+
+    waste = _check_level_shapes(b_pyrs, strategy, params, levels)
+    if waste > 0.0:
+        from image_analogies_tpu.tune import resolve as tune_resolve
+
+        h0, w0 = b_pyrs[0][0].shape[:2]
+        ceiling = tune_resolve.batch_pad_waste_pct(
+            strategy=strategy, n_rows=h0 * w0) / 100.0
+        if waste > ceiling:
+            _refuse("pad_waste",
+                    f"finest-level pad waste {waste:.0%} exceeds the "
+                    f"tuned ceiling {ceiling:.0%} (IA_BATCH_PAD_WASTE)")
+    obs_metrics.inc("batch.launches")
+    obs_metrics.inc("batch.lanes", k)
+    obs_metrics.set_gauge("batch.pad_waste_frac", waste)
+
+    failed: List[Optional[Exception]] = [None] * k
+    bp_pyr = [[None] * levels for _ in range(k)]
+    s_pyr = [[None] * levels for _ in range(k)]
+    stats: List[List[Dict[str, Any]]] = [[] for _ in range(k)]
+
+    for level in range(levels - 1, -1, -1):  # coarsest -> finest
+        with obs_trace.span("batch_level", level=level, lanes=k):
+            spec = spec_for_level(params, level, levels, src_channels)
+            jobs: List[Optional[LevelJob]] = [None] * k
+            dbs: List[Any] = [None] * k
+            for i in range(k):
+                if failed[i] is not None:
+                    continue
+                job = LevelJob(
+                    level=level,
+                    spec=spec,
+                    kappa_mult=params.kappa_factor(level) ** 2,
+                    a_src=a_src_pyr[level],
+                    a_filt=a_filt_pyr[level],
+                    b_src=b_pyrs[i][level],
+                    a_src_coarse=(a_src_pyr[level + 1]
+                                  if level + 1 < levels else None),
+                    a_filt_coarse=(a_filt_pyr[level + 1]
+                                   if level + 1 < levels else None),
+                    b_src_coarse=(b_pyrs[i][level + 1]
+                                  if level + 1 < levels else None),
+                    b_filt_coarse=(bp_pyr[i][level + 1]
+                                   if level + 1 < levels else None),
+                    # lanes>0 read lane 0's DB buffers; donation would
+                    # free them under the other lanes' feet
+                    donate=False,
+                )
+                try:
+                    # per-lane fault boundary: the chaos site and the
+                    # host-side feature dispatch are where one lane can
+                    # die without taking the launch down
+                    chaos.site("engine.batch", lane=i, level=level)
+                    dbs[i] = backend.build_features(job)
+                    jobs[i] = job
+                except Exception as e:
+                    failed[i] = e
+                    obs_metrics.inc("batch.lane_faults")
+            live = [i for i in range(k) if failed[i] is None]
+            if not live:
+                break
+            # dead lanes duplicate a live lane's query plane: k stays
+            # shape-stable so the compiled program is reused, and the
+            # duplicate lane's results are simply never read
+            ref = live[0]
+            run_dbs = [dbs[i] if dbs[i] is not None else dbs[ref]
+                       for i in range(k)]
+            run_jobs = [jobs[i] if jobs[i] is not None else jobs[ref]
+                        for i in range(k)]
+            try:
+                outs = backend.synthesize_level_lanes(run_dbs, run_jobs)
+            except Exception as e:
+                # whole-launch fault: every live member failed together
+                for i in live:
+                    failed[i] = e
+                break
+            for i in live:
+                bp, s, st = outs[i]
+                bp_pyr[i][level], s_pyr[i][level] = bp, s
+                stats[i].append(st)
+            obs_device.record_hbm(level, params.log_path)
+
+    results: List[Any] = [None] * k
+    for i in range(k):
+        if failed[i] is not None:
+            results[i] = failed[i]
+            continue
+        results[i] = _finalize_lane(bp_pyr[i][0], s_pyr[i][0], stats[i],
+                                    params, ap_rgb, preps[i][4])
+        results[i].timing["lanes"] = float(k)
+    return results

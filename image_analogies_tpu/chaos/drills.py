@@ -47,6 +47,30 @@ def run_image(a: np.ndarray, ap: np.ndarray, b: np.ndarray, params
     return np.asarray(create_image_analogy(a, ap, b, params).bp)
 
 
+def batch_params(*, levels: int = 2):
+    """Batched-engine drill config: TPU-backend XLA programs (they
+    compile on any host), no luminance remap (random targets would
+    diverge the A/A' DB and refuse the batch), no level retries (the
+    engine refuses those — per-lane isolation IS its recovery story)."""
+    from image_analogies_tpu.config import AnalogyParams
+
+    return AnalogyParams(backend="tpu", strategy="batched", levels=levels,
+                         patch_size=3, coarse_patch_size=3,
+                         remap_luminance=False, level_retries=0,
+                         metrics=True)
+
+
+def make_batch_load(k: int, size: Tuple[int, int] = (16, 16), seed: int = 7
+                    ) -> Tuple[np.ndarray, np.ndarray, List[np.ndarray]]:
+    """One exemplar pair + k distinct same-shape targets (the batched
+    engine's admission shape)."""
+    rng = np.random.RandomState(seed)
+    h, w = size
+    return (rng.rand(h, w).astype(np.float32),
+            rng.rand(h, w).astype(np.float32),
+            [rng.rand(h, w).astype(np.float32) for _ in range(k)])
+
+
 def make_serve_load(n: int, size: Tuple[int, int] = (12, 12), seed: int = 7
                     ) -> List[Dict[str, np.ndarray]]:
     """N batch-compatible requests (shared exemplars, distinct targets)."""

@@ -8,6 +8,7 @@ Engine layers call ``site("name", **ctx)`` at their boundaries:
     ckpt.load         utils/checkpoint.py — checkpoint read
     serve.admit       serve/queue.py      — request admission
     serve.dispatch    serve/worker.py     — batch dispatch
+    engine.batch      batch/engine.py     — per-lane batched dispatch
     mesh.step         parallel/step.py    — multichip level step
 
 Disarmed (the production default), ``site()`` is one module-bool check

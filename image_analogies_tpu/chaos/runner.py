@@ -44,15 +44,18 @@ _KIND_NOTES = {
                      "exactly once after kill+restart",
     "fleet_death": "router hands a dead worker's journal to its "
                    "replacement; spillover + dedupe answer exactly once",
+    "batch_partial": "one lane faults mid-batch; the other lanes resolve "
+                     "bit-identically",
 }
 
 # What `selftest` (and the tier-1 parametrization) iterates: every raw
-# fault kind plus the composite fleet drill, which arms TWO sites
-# (process_death at serve.journal, transient at router.forward) and so
-# is a drill name rather than a member of FAULT_KINDS.
+# fault kind plus the composite drills — fleet_death arms TWO sites
+# (process_death at serve.journal, transient at router.forward) and
+# batch_partial targets the batched engine's per-lane boundary — which
+# are drill names rather than members of FAULT_KINDS.
 def _drill_kinds():
     from image_analogies_tpu.chaos import FAULT_KINDS
-    return tuple(FAULT_KINDS) + ("fleet_death",)
+    return tuple(FAULT_KINDS) + ("fleet_death", "batch_partial")
 
 
 DRILL_KINDS = _drill_kinds()
@@ -101,6 +104,15 @@ def plan_for_kind(kind: str, seed: int = 0) -> ChaosPlan:
                                             schedule=(7,))),
                  ("router.forward", SiteRule(kind="transient",
                                              schedule=(4,))))
+    elif kind == "batch_partial":
+        # Batched-engine drill geometry (k=3 lanes, 2 levels): the
+        # engine.batch site is visited once per (level, lane), coarsest
+        # level first — visits 0..2 are the coarse level's lanes 0..2.
+        # Firing at visit 1 kills lane 1 at the COARSEST level, so the
+        # drill proves a first-level fault stays contained for the whole
+        # remaining coarse-to-fine run, not just the last launch.
+        sites = (("engine.batch", SiteRule(kind="transient",
+                                           schedule=(1,))),)
     else:
         raise ValueError(f"unknown fault kind {kind!r}")
     return ChaosPlan(seed=seed, sites=sites, name=f"selftest-{kind}")
@@ -140,14 +152,19 @@ def _reconcile(plan: ChaosPlan, counters: Dict[str, float]) -> List[str]:
     # raising kind at a serve batch boundary is contained as a crash
     # regardless of its class — the containment layer can't tell.
     retries = watchdogs = quarantines = crashes = deaths = 0.0
-    hop_faults = 0.0
+    hop_faults = lane_faults = 0.0
     for name, rule in plan.sites:
         n = counters.get(f"chaos.site.{name}", 0)
         if not n:
             continue
         if name == "serve.admit":
             continue  # surfaces synchronously to the client; no recovery
-        if rule.kind == "process_death":
+        if name == "engine.batch":
+            # a faulted lane is ISOLATED, not retried — the batch engine
+            # marks the member failed and finishes the other lanes; the
+            # only matching evidence is its lane-fault counter
+            lane_faults += n
+        elif rule.kind == "process_death":
             # not contained: the worker thread dies; the only matching
             # evidence is the death counter (recovery is the journal's)
             deaths += n
@@ -180,6 +197,8 @@ def _reconcile(plan: ChaosPlan, counters: Dict[str, float]) -> List[str]:
         want("serve.process_deaths", deaths)
     if hop_faults:
         want("router.hop_faults", hop_faults)
+    if lane_faults:
+        want("batch.lane_faults", lane_faults)
     return problems
 
 
@@ -566,8 +585,68 @@ def drill_fleet(plan: ChaosPlan, *, n: int = 4, seed: int = 7
         }
 
 
+def drill_batch_partial(plan: ChaosPlan, *, k: int = 3, seed: int = 7
+                        ) -> Dict[str, Any]:
+    """Batched-engine lane-fault drill: k targets dispatch as ONE engine
+    launch; the plan faults one lane's dispatch mid-batch.  Invariants:
+    exactly the faulted member comes back as its Exception, every other
+    member resolves bit-identical to its sequential singleton run, and
+    the injection reconciles against ``batch.lane_faults``."""
+    from image_analogies_tpu.obs import trace as obs_trace
+
+    a, ap, targets = drills.make_batch_load(k, seed=seed)
+    params = drills.batch_params()
+
+    # clean reference: each member's SEQUENTIAL singleton run — the bit-
+    # identity bar the surviving lanes are held to
+    baseline = [drills.run_image(a, ap, b, params) for b in targets]
+
+    with obs_trace.run_scope(params) as ctx:
+        with inject.plan_scope(plan):
+            from image_analogies_tpu.batch import create_image_analogy_batch
+
+            results = create_image_analogy_batch(a, ap, targets, params)
+            snap = inject.snapshot()
+        counters = _counters(ctx)
+
+    problems = []
+    faulted = [i for i, r in enumerate(results) if isinstance(r, Exception)]
+    survived = [i for i, r in enumerate(results)
+                if not isinstance(r, Exception)]
+    injected = sum(st["injected"] for st in snap.values())
+    if injected == 0:
+        problems.append("plan injected nothing (dead drill)")
+    if len(faulted) != injected:
+        problems.append(
+            f"{injected} injections but {len(faulted)} faulted members "
+            "(isolation leaked or swallowed)")
+    if len(survived) != k - len(faulted):
+        problems.append("member count does not reconcile")
+    identical = all(
+        np.array_equal(np.asarray(results[i].bp), baseline[i])
+        for i in survived)
+    if not identical:
+        problems.append("surviving lanes differ from sequential runs")
+    problems += _reconcile(plan, counters)
+    return {
+        "workload": "batch_partial",
+        "plan": plan.to_dict(),
+        "injected": injected,
+        "sites": snap,
+        "outcomes": {"lanes": k, "faulted": len(faulted),
+                     "survived": len(survived)},
+        "counters": {key: v for key, v in counters.items()
+                     if key.startswith(("chaos.", "batch."))},
+        "identical": identical,
+        "ok": not problems,
+        "problems": problems,
+    }
+
+
 def run_drill(plan: ChaosPlan, **kw) -> Dict[str, Any]:
     """Dispatch a plan to the workload its sites target."""
+    if any(name == "engine.batch" for name, _ in plan.sites):
+        return drill_batch_partial(plan, **kw)
     if any(name == "router.forward" for name, _ in plan.sites):
         return drill_fleet(plan, **kw)
     if any(name == "serve.journal" for name, _ in plan.sites):

@@ -392,6 +392,7 @@ def cmd_serve(args) -> int:
         slo_fast_window_s=args.slo_fast_window_s,
         slo_slow_window_s=args.slo_slow_window_s,
         journal_dir=args.journal,
+        batch_engine=not args.no_batch_engine,
     )
 
     if args.selftest is not None:
@@ -627,6 +628,12 @@ def cmd_bench(args) -> int:
         print(f"bench: bench.py not found at {exc}", file=sys.stderr)
         return 2
 
+    if args.batch:
+        # batched-engine throughput point (bench.bench_batched): K lanes
+        # through ONE vmapped launch, headline = marginal s/lane under
+        # the distinct `batched_qps` trajectory metric
+        return int(bench.bench_batched(args.batch) or 0)
+
     if not args.check and not args.dry_run:
         return int(bench.main() or 0)
 
@@ -771,6 +778,13 @@ def build_parser() -> argparse.ArgumentParser:
                         help="run the benchmark harness, or with --check "
                              "gate a wall-clock number against the "
                              "BENCH_r*.json trajectory (regression sentry)")
+    bn.add_argument("--batch", type=int, default=None, metavar="K",
+                    help="measure the batched B-axis engine instead of "
+                         "the full harness: K lanes through one vmapped "
+                         "launch vs K sequential singletons, gated on "
+                         "bit-identity; records the 'batched_qps' "
+                         "trajectory metric (marginal s/lane, lower is "
+                         "better)")
     bn.add_argument("--check", action="store_true",
                     help="no measurement: parse the trajectory and fail "
                          "(exit 1) when the candidate regresses past "
@@ -894,6 +908,12 @@ def build_parser() -> argparse.ArgumentParser:
                          "finished requests dedupe exactly-once, "
                          "interrupted ones re-enqueue, poison ones shed "
                          "(omit to disable; disabled costs nothing)")
+    sv.add_argument("--no-batch-engine", action="store_true",
+                    help="dispatch every batch member as its own engine "
+                         "call instead of fusing compatible same-key "
+                         "batches into one batched B-axis launch "
+                         "(batch/engine.py); outputs are bit-identical "
+                         "either way")
     sv.add_argument("--seed", type=int, default=0)
     _add_engine_flags(sv)
     sv.set_defaults(fn=cmd_serve)

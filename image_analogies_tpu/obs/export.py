@@ -70,6 +70,12 @@ def _classify(rec: Dict[str, Any]) -> Tuple[str, int, str, Optional[float]]:
         # joined by the shared `request` id in args.
         verb = "admit" if ev == "serve_admit" else "degrade"
         return "i", SERVE_TID, f"{verb} r{rec.get('request', '?')}", None
+    if ev == "serve_batch_lane":
+        # batched-engine lane instants on the serve track: which lane of
+        # the shared launch answered (or faulted) which request
+        return ("i", SERVE_TID,
+                f"lane {rec.get('lane', '?')} r{rec.get('request', '?')} "
+                f"{rec.get('status', '?')}", None)
     if ev in ("serve_replay", "serve_recovery", "serve_dedupe"):
         # durability-plane instants on the serve track: journal replay
         # actions, the recovery summary, and dedupe short-circuits sit

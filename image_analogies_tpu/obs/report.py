@@ -361,6 +361,23 @@ def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             "burn_rate_slow": gauges.get("slo.burn_rate.slow"),
         }
 
+    # --- batched-engine section (batch.* counters + lane records) ---------
+    lane_recs = [r for r in records if r.get("event") == "serve_batch_lane"]
+    batch_info: Optional[Dict[str, Any]] = None
+    if lane_recs or any(k.startswith("batch.") for k in counters):
+        fallbacks = {k.split("batch.fallback_sequential.", 1)[1]: int(v)
+                     for k, v in counters.items()
+                     if k.startswith("batch.fallback_sequential.")}
+        batch_info = {
+            "launches": int(counters.get("batch.launches", 0)),
+            "lanes": int(counters.get("batch.lanes", 0)),
+            "lane_faults": int(counters.get("batch.lane_faults", 0)),
+            # finest-level dead-row fraction of the last admitted launch
+            # (frozen at run_end); 0 when every member filled its bucket
+            "pad_waste_frac": gauges.get("batch.pad_waste_frac"),
+            "fallbacks": fallbacks,
+        }
+
     return {
         "manifest": manifest,
         "run_end": run_end,
@@ -376,6 +393,7 @@ def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "tune": tune_info,
         "pipeline": pipeline_info,
         "serve": serve_info,
+        "batch": batch_info,
         "router": router_info,
         "slo": slo_info,
         "journal": journal_info,
@@ -446,7 +464,7 @@ def render(an: Dict[str, Any], run_id: Optional[str] = None) -> str:
             if k not in shown and v
             and not k.startswith(("serve.", "chaos.", "watchdog.",
                                   "ckpt.", "retry.", "pipeline.",
-                                  "router."))}
+                                  "router.", "batch."))}
     for k in sorted(rest):
         w(f"    {k:<13} {rest[k]:g}")
 
@@ -527,6 +545,24 @@ def render(an: Dict[str, Any], run_id: Optional[str] = None) -> str:
             hist = ", ".join(f"{k}x{v}" for k, v in
                              srv["batch_size_hist"].items())
             w(f"    batch sizes   {hist}  (size x count)")
+
+    be = an.get("batch")
+    if be:
+        w("  batched engine:")
+        launches, lanes = be["launches"], be["lanes"]
+        w(f"    launches      {launches} device launches / {lanes} lanes"
+          + (f" (mean {lanes / launches:.1f} lanes/launch)"
+             if launches else ""))
+        if be["pad_waste_frac"] is not None:
+            w(f"    pad waste     {100 * be['pad_waste_frac']:.1f}% dead "
+              "rows at the finest level")
+        if be["lane_faults"]:
+            w(f"    lane faults   {be['lane_faults']} isolated "
+              "(surviving lanes completed)")
+        if be["fallbacks"]:
+            fb = ", ".join(f"{k}x{v}" for k, v in
+                           sorted(be["fallbacks"].items()))
+            w(f"    fallbacks     {fb}  (reason x count)")
 
     rt = an.get("router")
     if rt:

@@ -62,6 +62,7 @@ def selftest(cfg: ServeConfig, n: int, *, seed: int = 0,
     what the queue's EDF ordering exists for: the summary's timeout count
     under such a load is the thing deadline ordering lowers."""
     from image_analogies_tpu.models.analogy import create_image_analogy
+    from image_analogies_tpu.obs import metrics as obs_metrics
 
     load = make_load(n, shapes, seed)
 
@@ -104,6 +105,22 @@ def selftest(cfg: ServeConfig, n: int, *, seed: int = 0,
             except BaseException as exc:  # noqa: BLE001 - summarized
                 errors[idx] = exc
         srv_s = time.perf_counter() - t0
+        # Batched-engine ledger (read inside the server's run scope):
+        # launches vs completions is the compression the lane axis buys —
+        # with batching engaged, completed requests strictly exceed
+        # engine launches; fallback reasons say why it didn't engage.
+        snap = obs_metrics.snapshot() or {}
+        counters = snap.get("counters", {})
+        batch_ledger = {
+            "launches": int(counters.get("batch.launches", 0)),
+            "lanes": int(counters.get("batch.lanes", 0)),
+            "lane_faults": int(counters.get("batch.lane_faults", 0)),
+            "completed": int(counters.get("serve.completed", 0)),
+            "fallbacks": {
+                k.split("batch.fallback_sequential.", 1)[1]: int(v)
+                for k, v in sorted(counters.items())
+                if k.startswith("batch.fallback_sequential.")},
+        }
         if cfg.journal_dir:
             # journaled smoke: every completed request resubmitted under
             # its derived content key must dedupe, not recompute
@@ -152,6 +169,7 @@ def selftest(cfg: ServeConfig, n: int, *, seed: int = 0,
                       if type(e).__name__ != "DeadlineExceeded"),
         "rejected": rejected,
         "batch_size_hist": {str(k): v for k, v in sorted(batch_hist.items())},
+        "batch_engine": batch_ledger,
         "bit_identical": bool(identical),
         "journal": journal_stats,
     }
@@ -296,6 +314,14 @@ def render(summary: Dict[str, Any]) -> str:
         f"  bit-identical to singleton dispatch: "
         f"{summary['bit_identical']}",
     ]
+    be = summary.get("batch_engine")
+    if be:
+        lines.insert(-1,
+                     f"  batch eng:  {be['launches']} launches / "
+                     f"{be['lanes']} lanes for {be['completed']} "
+                     f"completions, {be['lane_faults']} lane faults"
+                     + (f", fallbacks {be['fallbacks']}"
+                        if be["fallbacks"] else ""))
     jn = summary.get("journal")
     if jn:
         lines.append(

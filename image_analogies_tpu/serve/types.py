@@ -106,6 +106,13 @@ class ServeConfig:
     # fsync each journal append (the durability guarantee).  Tests and
     # throughput-over-durability embedders may turn it off.
     journal_fsync: bool = True
+    # Batched B-axis engine (batch/engine.py): a compatible same-key
+    # batch of >= 2 TPU-backend requests dispatches as ONE engine call
+    # (one compiled program, k lanes) with per-member fault isolation.
+    # Incompatible batches fall back to the sequential per-member loop
+    # with the reason on batch.fallback_sequential.<reason>.  Outputs
+    # are bit-identical either way (the loadgen selftest gates it).
+    batch_engine: bool = True
 
     def __post_init__(self):
         if self.queue_depth < 1:

@@ -1,11 +1,16 @@
 """Worker pool: owns device dispatch for batches popped off the queue.
 
-One engine backend is constructed per batch and shared by every member —
-the batch key guarantees identical params + exemplar content, so the
-backend's per-level caches (CPU KD-tree memo, TPU devcache/program
-cache) amortize across the batch.  Degraded members run with their own
-substituted params and therefore their own backend; correctness first,
-sharing second.
+A compatible TPU batch of >= 2 members dispatches as ONE batched-engine
+call (batch/engine.py, ``ServeConfig.batch_engine``): one compiled
+program synthesizes every member's B' lane, with per-member fault
+isolation and bit-identical outputs.  Everything else — and every
+refused batch, reason on ``batch.fallback_sequential.<reason>`` — runs
+the sequential per-member loop: one engine backend is constructed per
+batch and shared by every member (the batch key guarantees identical
+params + exemplar content, so the backend's per-level caches amortize
+across the batch).  Degraded members run with their own substituted
+params and therefore their own backend; correctness first, sharing
+second.
 
 Every engine call goes through ``utils.failure.run_with_retry`` so an
 injected (or real) transient device failure retries inside the server
@@ -149,11 +154,131 @@ class WorkerPool:
         try:
             with obs_trace.span("serve_batch", size=len(batch),
                                 key=batcher.key_str(batch[0].key)):
+                if (self._cfg.batch_engine and len(batch) >= 2
+                        and batch[0].params.backend == "tpu"
+                        and self._dispatch_batch(batch)):
+                    return
                 backend = None
                 for req in batch:
                     backend = self._run_one(req, backend, len(batch))
         finally:
             self._track_inflight(-len(batch))
+
+    def _dispatch_batch(self, batch: List[Request]) -> bool:
+        """Dispatch a compatible batch as ONE batched-engine call
+        (batch/engine.py): one compiled program synthesizes every
+        member's B' lane.  Returns True when every member was resolved
+        here; False means "not handled" — the caller runs the
+        sequential per-member loop, whose ``set_running`` tolerance
+        covers members this path already claimed."""
+        from image_analogies_tpu.batch import engine as batch_engine
+
+        # Serve-side preflight the engine can't see: the batch key
+        # guarantees identical request params, but degrade plans depend
+        # on per-request deadlines and may diverge — a shared launch
+        # cannot run members at different fidelity.
+        plans = [serve_degrade.plan(req, self._cost,
+                                    allow_degrade=self._cfg.degrade)
+                 for req in batch]
+        if any(action != "run" or degraded is not None
+               for action, _, degraded in plans):
+            obs_metrics.inc("batch.fallback_sequential.degrade_divergence")
+            return False
+        if not self.breaker.allow():
+            return False  # sequential path fails each member fast
+        params = plans[0][1]
+
+        # claim every member; a cancelled member would break lane
+        # alignment, so hand the whole batch back to the sequential loop
+        for req in batch:
+            try:
+                if not req.future.set_running_or_notify_cancel():
+                    return False
+            except RuntimeError:
+                if req.future.done():
+                    return False
+
+        # WAL transition for every member BEFORE the engine call (same
+        # contract as the sequential path; replay treats a repeated
+        # `dispatched` append from a later fallback as the same state)
+        if self._journal is not None:
+            for req in batch:
+                if req.idem:
+                    self._journal.record_dispatched(req.idem)
+
+        t0 = time.monotonic()
+        try:
+            results = batch_engine.create_image_analogy_batch(
+                batch[0].a, batch[0].ap, [req.b for req in batch], params)
+        except batch_engine.BatchIncompatible:
+            # reason already counted by the engine's refusal path
+            return False
+        except Exception:  # noqa: BLE001 - whole-launch failure
+            # below per-lane isolation: the sequential path gives each
+            # member its own retry envelope and breaker accounting
+            obs_metrics.inc("batch.fallback_sequential.launch_error")
+            return False
+        dispatch_s = time.monotonic() - t0
+
+        # ONE cost observation per launch with the SUMMED work units:
+        # the EWMA rate is seconds per unit, so this attributes the
+        # marginal per-member cost at dispatch_s / k automatically.
+        # Observing the full launch wall-clock once per member would
+        # inflate the learned rate k-fold and over-fire the degrade
+        # ladder on every deadlined request that follows.
+        units = 0.0
+        ok_lanes = 0
+        for req, res in zip(batch, results):
+            if isinstance(res, Exception):
+                continue
+            units += serve_degrade.work_units(
+                int(req.b.shape[0]) * int(req.b.shape[1]),
+                params.levels, params.patch_size)
+            ok_lanes += 1
+        if ok_lanes:
+            self._cost.observe(units, dispatch_s)
+            self.breaker.record_success()
+
+        for lane, (req, res) in enumerate(zip(batch, results)):
+            with obs_trace.request_context(request=req.request_id):
+                if isinstance(res, Exception):
+                    # per-lane fault isolation: only this member
+                    # re-runs, sequentially, with its own retry budget
+                    obs_trace.emit_record({"event": "serve_batch_lane",
+                                           "lane": lane,
+                                           "request": req.request_id,
+                                           "status": "fault",
+                                           "error": type(res).__name__})
+                    self._dispatch_one(req, None, len(batch))
+                    continue
+                now = time.monotonic()
+                resp = Response(
+                    request_id=req.request_id,
+                    bp=res.bp,
+                    bp_y=res.bp_y,
+                    stats=res.stats,
+                    batch_size=len(batch),
+                    queue_ms=((req.t_dequeue or t0) - req.t_submit) * 1e3,
+                    dispatch_ms=dispatch_s * 1e3,
+                    total_ms=(now - req.t_submit) * 1e3,
+                    degraded=None,
+                )
+                obs_metrics.inc("serve.completed")
+                self._record_slo(req,
+                                 req.deadline is None or now <= req.deadline)
+                obs_metrics.observe("serve.latency_ms", resp.total_ms)
+                obs_metrics.observe("serve.queue_ms", resp.queue_ms)
+                obs_trace.emit_record({"event": "serve_batch_lane",
+                                       "lane": lane,
+                                       "request": req.request_id,
+                                       "status": "ok"})
+                self._emit_request_record(req, resp.status,
+                                          batch_size=len(batch),
+                                          dispatch_ms=resp.dispatch_ms)
+                if self._journal is not None and req.idem:
+                    self._journal.record_done(req.idem, resp)
+                req.future.set_result(resp)
+        return True
 
     def _emit_request_record(self, req: Request, status: str, *,
                              batch_size: int, dispatch_ms: float = 0.0,

@@ -17,6 +17,14 @@ power-of-two divisor >= 256, which is exactly what ``_scan_tile`` /
 Bucketing is opt-in (``AnalogyParams.shape_buckets`` or
 ``IA_SHAPE_BUCKETS=1``): with it off, pad shapes — and therefore program
 signatures and outputs — are bit-identical to the pre-tune engine.
+
+The same bucket ladder also serves the QUERY side (batch/engine.py):
+the batched scan core pads each B plane's ``static_q`` row count up to
+``bucket_rows(hb*wb)`` so differently-sized targets share one lane
+program.  Query padding is honest by construction — the scan's row loop
+only ever reads rows ``< hb*wb`` — and :func:`pad_waste_frac` quantifies
+the dead rows so the engine can refuse lanes past the tuned ceiling
+(``tune.resolve.batch_pad_waste_pct``).
 """
 
 from __future__ import annotations
@@ -34,6 +42,17 @@ def bucket_rows(n: int) -> int:
     if three >= n and (three & -three) >= 256:
         return three
     return 1 << k
+
+
+def pad_waste_frac(n: int, bucket: int = 0) -> float:
+    """Fraction of a bucket that is padding for ``n`` real rows.  The
+    batched engine compares this against the tuned waste ceiling before
+    admitting a lane (dead padded rows cost real FLOPs in every scan
+    row, unlike the A-side pad which only widens one argmin)."""
+    bucket = bucket or bucket_rows(n)
+    if bucket <= 0 or n >= bucket:
+        return 0.0
+    return (bucket - n) / float(bucket)
 
 
 def buckets_enabled(params: Any = None) -> bool:

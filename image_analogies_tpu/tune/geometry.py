@@ -33,6 +33,15 @@ DEFAULT_PACKED_VMEM_LIMIT = 110 * 2 ** 20
 WAVEFRONT_MAX_ROWS_CEILING = 1 << 24
 DEFAULT_WAVEFRONT_MAX_ROWS = WAVEFRONT_MAX_ROWS_CEILING
 
+# Batched B-axis engine waste ceiling, in percent: a lane whose query
+# rows must pad by more than this fraction of its bucket refuses the
+# batched path and falls back to sequential (the padded rows are dead
+# FLOPs in every scan row, so past ~1/4 the "shared program" win loses
+# to the wasted compute).  Worst-case bucket pad is ~33% (just past a
+# 3*2^k midpoint), so 25 admits most bucket residents while refusing
+# the pathological just-past-a-bucket-edge shapes.
+DEFAULT_BATCH_PAD_WASTE = 25
+
 
 def round_up(n: int, m: int) -> int:
     return -(-n // m) * m

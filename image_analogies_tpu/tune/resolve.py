@@ -12,7 +12,8 @@ shape-bucket)`` with per-knob precedence:
   :func:`override` so the swept value flows through the SAME call sites
   production uses.
 - **env**: ``IA_TILE_ROWS`` / ``IA_PACKED_TILE`` / ``IA_PACKED_VMEM`` /
-  ``IA_WAVEFRONT_ROWS``, parsed at CALL time (the legacy module-import
+  ``IA_WAVEFRONT_ROWS`` / ``IA_BATCH_PAD_WASTE``, parsed at CALL time
+  (the legacy module-import
   read silently ignored later changes); invalid values warn once and are
   ignored.
 - **store**: :mod:`tune.store` entries — exact key first, then the
@@ -58,6 +59,7 @@ _ENV_VARS = {
     "packed_tile_cap": "IA_PACKED_TILE",
     "packed_vmem_limit": "IA_PACKED_VMEM",
     "wavefront_max_rows": "IA_WAVEFRONT_ROWS",
+    "batch_pad_waste_pct": "IA_BATCH_PAD_WASTE",
 }
 
 _TLS = threading.local()  # .overrides: Dict[str, int] while tuner active
@@ -84,6 +86,9 @@ class TuneConfig:
     # source-map indices into exact f32, so values are clamped to the
     # 2^24 correctness ceiling (tune DOWN only; see tune.geometry).
     wavefront_max_rows: int = _geometry.DEFAULT_WAVEFRONT_MAX_ROWS
+    # Batched engine admission knob, not a kernel shape: max query-row
+    # pad waste (percent of the bucket) before a lane refuses batching.
+    batch_pad_waste_pct: int = _geometry.DEFAULT_BATCH_PAD_WASTE
 
     def origin_of(self, knob: str) -> str:
         return dict(self.origin).get(knob, "default")
@@ -186,6 +191,7 @@ def _record(cfg: TuneConfig, fp: int, bucket: int) -> None:
                 "packed_tile_cap": cfg.packed_tile_cap,
                 "packed_vmem_limit": cfg.packed_vmem_limit,
                 "wavefront_max_rows": cfg.wavefront_max_rows,
+                "batch_pad_waste_pct": cfg.batch_pad_waste_pct,
                 "origin": origins,
             }
     if _metrics._ACTIVE:
@@ -205,6 +211,7 @@ def _record(cfg: TuneConfig, fp: int, bucket: int) -> None:
                            "packed_tile_cap": cfg.packed_tile_cap,
                            "packed_vmem_limit": cfg.packed_vmem_limit,
                            "wavefront_max_rows": cfg.wavefront_max_rows,
+                           "batch_pad_waste_pct": cfg.batch_pad_waste_pct,
                            "origin": origins, "fp": fp, "bucket": bucket},
                           ctx.log_path)
 
@@ -248,6 +255,7 @@ def resolve(*, strategy: str, dtype: str, fp: int, n_rows: int = 0,
         "packed_tile_cap": _geometry.DEFAULT_PACKED_TILE_CAP,
         "packed_vmem_limit": _geometry.DEFAULT_PACKED_VMEM_LIMIT,
         "wavefront_max_rows": _geometry.DEFAULT_WAVEFRONT_MAX_ROWS,
+        "batch_pad_waste_pct": _geometry.DEFAULT_BATCH_PAD_WASTE,
     }
     values: Dict[str, int] = {}
     origin: Dict[str, str] = {}
@@ -330,6 +338,17 @@ def wavefront_max_rows(*, strategy: str = "wavefront", dtype: str = "f32",
     cfg = resolve(strategy=strategy, dtype=_norm_dtype(dtype), fp=fp,
                   n_rows=n_rows, store=store)
     return cfg.wavefront_max_rows
+
+
+def batch_pad_waste_pct(*, strategy: str = "batched", dtype: str = "f32",
+                        fp: int = 128, n_rows: int = 0,
+                        store: Optional[str] = None) -> int:
+    """Batched-engine pad-waste ceiling in percent (``IA_BATCH_PAD_WASTE``):
+    a lane padding its query rows by more than this fraction of the
+    bucket refuses the batched path (dead FLOPs beat program sharing)."""
+    cfg = resolve(strategy=strategy, dtype=_norm_dtype(dtype), fp=fp,
+                  n_rows=n_rows, store=store)
+    return cfg.batch_pad_waste_pct
 
 
 def scan_tile(npad: int, fp: int, cap_rows: int = 0, *,

@@ -35,20 +35,22 @@ TABLES: Dict[str, Dict[str, Dict[str, int]]] = {
     "v4": {
         # Reference class: the legacy defaults WERE the v4 sweep winners.
         "*": {"packed_tile_cap": 16384, "packed_vmem_limit": 110 * _MIB,
-              "wavefront_max_rows": 1 << 24},
+              "wavefront_max_rows": 1 << 24, "batch_pad_waste_pct": 25},
     },
     "v5e": {
         # 128 MiB VMEM (see pallas guide) but a narrower core than v4:
         # leave more compiler headroom and keep scan tiles smaller.
+        # Narrower core also means pad-row FLOPs hurt more, so the
+        # batched engine's waste ceiling is tighter than on v4/v5p.
         "*": {"packed_tile_cap": 8192, "packed_vmem_limit": 96 * _MIB,
-              "wavefront_max_rows": 1 << 24},
+              "wavefront_max_rows": 1 << 24, "batch_pad_waste_pct": 20},
         "wavefront|bf16": {"tile_rows": 2048},
     },
     "v5p": {
         # More VMEM headroom + HBM bandwidth: larger tiles amortize the
         # per-grid-step overhead better.
         "*": {"packed_tile_cap": 32768, "packed_vmem_limit": 120 * _MIB,
-              "wavefront_max_rows": 1 << 24},
+              "wavefront_max_rows": 1 << 24, "batch_pad_waste_pct": 25},
         "wavefront|bf16": {"tile_rows": 8192},
     },
 }

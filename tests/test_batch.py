@@ -1,0 +1,335 @@
+"""Batched B-axis engine (ISSUE 10): batch/engine.py + the vmapped
+lanes runner in backends/tpu.py, and its serve wiring.
+
+Acceptance invariants locked here:
+
+- every batched member is BIT-IDENTICAL to its sequential singleton run
+  — on both lanes-runner strategies, and for same-bucket mixed shapes
+  under query-side bucketing (tune/buckets.py);
+- query padding is honest by construction: adversarially poisoning the
+  padded rows of EVERY query-side leaf cannot change one output bit
+  (the scan's row loop never reads them);
+- incompatible batches refuse with a reasoned
+  ``batch.fallback_sequential.<reason>`` counter, and the serve worker
+  falls back to the sequential per-member loop — nothing is lost, the
+  claimed futures still resolve;
+- members whose degrade plans diverge never reach the engine
+  (serve-side ``degrade_divergence`` refusal);
+- k lanes share ONE compiled lanes program per level: compile records
+  count levels, not k x levels, and a second same-shape launch compiles
+  nothing;
+- the serve selftest engages the engine end-to-end: engine launches <
+  completed requests, under the selftest's own bit-identity gate.
+"""
+
+import dataclasses
+import os
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from image_analogies_tpu.batch import BatchIncompatible, \
+    create_image_analogy_batch
+from image_analogies_tpu.chaos import drills
+from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.models.analogy import create_image_analogy
+from image_analogies_tpu.obs import metrics as obs_metrics
+from image_analogies_tpu.obs import trace as obs_trace
+
+
+def _params(**kw):
+    kw.setdefault("backend", "tpu")
+    kw.setdefault("strategy", "batched")
+    kw.setdefault("levels", 2)
+    kw.setdefault("patch_size", 3)
+    kw.setdefault("coarse_patch_size", 3)
+    kw.setdefault("remap_luminance", False)
+    kw.setdefault("metrics", True)
+    return AnalogyParams(**kw)
+
+
+def _load(k, shapes, seed=7):
+    """One exemplar pair + k targets with the given per-member shapes."""
+    rng = np.random.RandomState(seed)
+    h, w = shapes[0]
+    a = rng.rand(h, w).astype(np.float32)
+    ap = rng.rand(h, w).astype(np.float32)
+    targets = [rng.rand(hh, ww).astype(np.float32)
+               for hh, ww in (shapes * k)[:k]]
+    return a, ap, targets
+
+
+def _counters(params, fn):
+    """Run ``fn`` inside an obs scope; returns (result-or-exc, counters)."""
+    with obs_trace.run_scope(params):
+        try:
+            out = fn()
+        except Exception as exc:  # noqa: BLE001 - returned for inspection
+            out = exc
+        snap = obs_metrics.snapshot() or {}
+    return out, snap.get("counters", {})
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: the non-negotiable invariant
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("strategy", ["batched", "wavefront"])
+def test_batched_bit_identical_to_sequential(strategy):
+    params = _params(strategy=strategy)
+    a, ap, targets = _load(3, [(16, 16)])
+    results = create_image_analogy_batch(a, ap, targets, params)
+    assert len(results) == 3
+    for b, res in zip(targets, results):
+        assert not isinstance(res, Exception)
+        ref = create_image_analogy(a, ap, b, params)
+        assert np.array_equal(np.asarray(res.bp), np.asarray(ref.bp))
+        assert np.array_equal(np.asarray(res.bp_y), np.asarray(ref.bp_y))
+
+
+def test_bucketed_mixed_shapes_bit_identical():
+    """Same query bucket, DIFFERENT real row counts: bucketing is what
+    admits them to one program, and each member must still match its own
+    singleton bit for bit."""
+    params = _params(shape_buckets=True)
+    a, ap, _ = _load(1, [(20, 20)])
+    rng = np.random.RandomState(11)
+    targets = [rng.rand(20, 20).astype(np.float32),
+               rng.rand(22, 20).astype(np.float32),
+               rng.rand(21, 20).astype(np.float32)]
+    results = create_image_analogy_batch(a, ap, targets, params)
+    for b, res in zip(targets, results):
+        assert not isinstance(res, Exception)
+        assert res.bp.shape[:2] == b.shape  # cropped to the REAL shape
+        ref = create_image_analogy(a, ap, b, params)
+        assert np.array_equal(np.asarray(res.bp), np.asarray(ref.bp))
+
+
+# ---------------------------------------------------------------------------
+# padding honesty: adversarial pad contents
+# ---------------------------------------------------------------------------
+
+def test_query_padding_is_honest_under_adversarial_pad():
+    """Poison the padded rows of every query-side leaf with garbage; if
+    the scan ever read a pad row, some bit of the output would move.
+    None may."""
+    import jax.numpy as jnp
+
+    from image_analogies_tpu.backends import get_backend
+    from image_analogies_tpu.backends.base import LevelJob
+    from image_analogies_tpu.ops.features import spec_for_level
+
+    params = _params(levels=1, shape_buckets=True)
+    rng = np.random.RandomState(5)
+    a = rng.rand(12, 12).astype(np.float32)
+    ap = rng.rand(12, 12).astype(np.float32)
+    b = rng.rand(12, 12).astype(np.float32)
+    backend = get_backend(params)
+    job = LevelJob(level=0, spec=spec_for_level(params, 0, 1, 1),
+                   kappa_mult=params.kappa_factor(0) ** 2,
+                   a_src=a, a_filt=ap, b_src=b)
+    db = backend.build_features(job)
+    n = 12 * 12
+    assert db.static_q.shape[0] > n  # bucketed: pad rows exist
+    bp0, s0, _ = backend.synthesize_level(db, job)
+
+    sq = np.asarray(db.static_q).copy()
+    sq[n:] = 1e9  # any read would swing every distance it touches
+    fi = np.asarray(db.flat_idx).copy()
+    fi[n:] = 3  # in-range garbage: a read would gather a REAL pixel
+    vd = np.asarray(db.valid).copy()
+    vd[n:] = 1.0  # pad rows claim every neighbor is valid
+    wr = np.asarray(db.written).copy()
+    wr[n:] = 1.0  # ...and already written
+    poisoned = dataclasses.replace(
+        db, static_q=jnp.asarray(sq), flat_idx=jnp.asarray(fi),
+        valid=jnp.asarray(vd), written=jnp.asarray(wr))
+    bp1, s1, _ = backend.synthesize_level(poisoned, job)
+    assert np.array_equal(np.asarray(bp0), np.asarray(bp1))
+    assert np.array_equal(np.asarray(s0), np.asarray(s1))
+
+
+# ---------------------------------------------------------------------------
+# refusals: reasoned counters, nothing silently wrong
+# ---------------------------------------------------------------------------
+
+def test_mixed_bucket_refuses_with_counter():
+    params = _params(levels=1, shape_buckets=True)
+    rng = np.random.RandomState(3)
+    a = rng.rand(16, 16).astype(np.float32)
+    ap = rng.rand(16, 16).astype(np.float32)
+    targets = [rng.rand(16, 16).astype(np.float32),   # 256 -> bucket 256
+               rng.rand(40, 16).astype(np.float32)]   # 640 -> bucket 768
+    out, counters = _counters(
+        params, lambda: create_image_analogy_batch(a, ap, targets, params))
+    assert isinstance(out, BatchIncompatible)
+    assert out.reason == "mixed_bucket"
+    assert counters.get("batch.fallback_sequential.mixed_bucket", 0) >= 1
+
+
+def test_wavefront_mixed_shapes_refuse():
+    """The wavefront scan's packed carry + diag schedule are program
+    structure — lanes must agree on shape exactly."""
+    params = _params(strategy="wavefront")
+    rng = np.random.RandomState(3)
+    a = rng.rand(16, 16).astype(np.float32)
+    ap = rng.rand(16, 16).astype(np.float32)
+    targets = [rng.rand(16, 16).astype(np.float32),
+               rng.rand(20, 20).astype(np.float32)]
+    out, counters = _counters(
+        params, lambda: create_image_analogy_batch(a, ap, targets, params))
+    assert isinstance(out, BatchIncompatible)
+    assert out.reason == "shape_mismatch"
+    assert counters.get("batch.fallback_sequential.shape_mismatch", 0) >= 1
+
+
+def test_pad_waste_ceiling_refuses_then_env_admits(monkeypatch):
+    """(17, 16) pads 272 -> 512 rows = 47% finest-level waste: past the
+    default 25% ceiling the batch refuses; raising IA_BATCH_PAD_WASTE
+    admits it AND the admitted run stays bit-identical."""
+    params = _params(levels=1, shape_buckets=True)
+    a, ap, targets = _load(2, [(17, 16)], seed=9)
+    out, counters = _counters(
+        params, lambda: create_image_analogy_batch(a, ap, targets, params))
+    assert isinstance(out, BatchIncompatible)
+    assert out.reason == "pad_waste"
+    assert counters.get("batch.fallback_sequential.pad_waste", 0) >= 1
+
+    monkeypatch.setenv("IA_BATCH_PAD_WASTE", "60")
+    results = create_image_analogy_batch(a, ap, targets, params)
+    for b, res in zip(targets, results):
+        assert not isinstance(res, Exception)
+        ref = create_image_analogy(a, ap, b, params)
+        assert np.array_equal(np.asarray(res.bp), np.asarray(ref.bp))
+
+
+# ---------------------------------------------------------------------------
+# serve-layer fallback: refusals and degrade divergence resolve everything
+# ---------------------------------------------------------------------------
+
+def _serve_batch(params, k=3, size=(16, 16), deadline_s=None, seed=21):
+    from image_analogies_tpu.serve import batcher
+    from image_analogies_tpu.serve.types import Request
+
+    rng = np.random.RandomState(seed)
+    h, w = size
+    a = rng.rand(h, w).astype(np.float32)
+    ap = rng.rand(h, w).astype(np.float32)
+    reqs = []
+    for i in range(k):
+        b = rng.rand(h, w).astype(np.float32)
+        reqs.append(Request(
+            request_id=i, a=a, ap=ap, b=b, params=params,
+            key=batcher.batch_key(a, ap, b, params), future=Future(),
+            deadline=(None if deadline_s is None
+                      else time.monotonic() + deadline_s)))
+    return reqs
+
+
+def _pool(params, **cfg_kw):
+    from image_analogies_tpu.serve.queue import AdmissionQueue
+    from image_analogies_tpu.serve.types import ServeConfig
+    from image_analogies_tpu.serve.worker import WorkerPool
+
+    cfg = ServeConfig(params=params, workers=1, **cfg_kw)
+    return WorkerPool(cfg, AdmissionQueue(16))
+
+
+def test_engine_refusal_falls_back_to_sequential_dispatch():
+    """remap_luminance couples the A/A' DB to each member's B stats, so
+    distinct random targets refuse the batch (remap_divergence) — and
+    the worker's sequential fallback must still resolve every claimed
+    future, bit-identically."""
+    params = _params(levels=1, remap_luminance=True)
+    pool = _pool(params)
+    reqs = _serve_batch(params)
+    with obs_trace.run_scope(params):
+        pool._run_batch(reqs)
+        snap = obs_metrics.snapshot() or {}
+    counters = snap.get("counters", {})
+    assert counters.get(
+        "batch.fallback_sequential.remap_divergence", 0) >= 1
+    assert counters.get("batch.launches", 0) == 0
+    for req in reqs:
+        resp = req.future.result(timeout=60)
+        ref = create_image_analogy(req.a, req.ap, req.b, params)
+        assert np.array_equal(np.asarray(resp.bp), np.asarray(ref.bp))
+
+
+def test_degrade_divergence_refuses_before_the_engine():
+    """A poisoned cost model makes every deadlined plan non-"run": the
+    batch must refuse on the serve side (degrade_divergence) without
+    claiming futures or touching the engine."""
+    params = _params()
+    pool = _pool(params)
+    # one observation at a catastrophic rate: any deadline now forces
+    # the degrade/timeout ladder
+    pool._cost.observe(1.0, 50.0)
+    reqs = _serve_batch(params, deadline_s=10.0)
+    with obs_trace.run_scope(params):
+        handled = pool._dispatch_batch(reqs)
+        snap = obs_metrics.snapshot() or {}
+    counters = snap.get("counters", {})
+    assert handled is False
+    assert counters.get(
+        "batch.fallback_sequential.degrade_divergence", 0) >= 1
+    assert counters.get("batch.launches", 0) == 0
+    # refused before the claim: the sequential loop owns these futures
+    for req in reqs:
+        assert not req.future.done()
+        assert req.future.set_running_or_notify_cancel()
+
+
+# ---------------------------------------------------------------------------
+# one compiled program per level, shared by every lane and launch
+# ---------------------------------------------------------------------------
+
+def test_one_lanes_program_per_level(tmp_path):
+    from image_analogies_tpu.obs.report import load_records
+
+    log = str(tmp_path / "run.jsonl")
+    params = _params(log_path=log)
+    a, ap, targets = _load(3, [(18, 18)], seed=13)  # shapes unique to
+    #    this test: the lanes-program cache is process-global
+
+    def lanes_compiles():
+        return [r for r in load_records(log)
+                if r.get("event") == "compile"
+                and r.get("name") == "tpu.run_lanes"]
+
+    results = create_image_analogy_batch(a, ap, targets, params)
+    assert all(not isinstance(r, Exception) for r in results)
+    # 3 lanes, 2 levels: one compile per LEVEL shape, not per lane
+    assert len(lanes_compiles()) == params.levels
+
+    rng = np.random.RandomState(17)
+    again = [rng.rand(18, 18).astype(np.float32) for _ in range(3)]
+    results = create_image_analogy_batch(a, ap, again, params)
+    assert all(not isinstance(r, Exception) for r in results)
+    # a second same-shape launch compiles NOTHING new
+    assert len(lanes_compiles()) == params.levels
+
+
+# ---------------------------------------------------------------------------
+# serve selftest end-to-end
+# ---------------------------------------------------------------------------
+
+def test_serve_selftest_batches_and_stays_bit_identical():
+    from image_analogies_tpu.serve import loadgen
+    from image_analogies_tpu.serve.types import ServeConfig
+
+    cfg = ServeConfig(params=_params(levels=1), queue_depth=64,
+                      batch_window_ms=25.0, max_batch=4, workers=1,
+                      drain_timeout_s=60.0)
+    summary = loadgen.selftest(cfg, 6, seed=0, shapes=((16, 16),))
+    assert summary["errors"] == 0 and summary["rejected"] == 0
+    assert summary["bit_identical"] is True
+    ledger = summary["batch_engine"]
+    # the lane axis compresses launches: strictly fewer engine launches
+    # than completed requests (ISSUE 10 acceptance)
+    assert ledger["launches"] >= 1
+    assert ledger["completed"] == 6
+    assert ledger["completed"] > ledger["launches"]
+    assert ledger["lane_faults"] == 0
