@@ -103,6 +103,11 @@ def extract_headline(doc: dict):
         # the sentry gates it only where both sides have one
         if obj.get("host_gap_ms") is not None:
             out["host_gap_ms"] = float(obj["host_gap_ms"])
+        # obs-overhead trajectory (PR 11): instrumented vs metrics=False
+        # wall-clock at 256^2 — the scoped-observability fast path is a
+        # perf promise, so its cost rides the same archive
+        if obj.get("obs_overhead_pct") is not None:
+            out["obs_overhead_pct"] = float(obj["obs_overhead_pct"])
         return out
 
     parsed = doc.get("parsed")
@@ -156,7 +161,8 @@ def load_trajectory(bench_dir: str = ".") -> dict:
 
 def check_regression(trajectory: dict, fresh_value=None,
                      threshold_pct: float = 20.0,
-                     fresh_gap=None, fresh_key=None) -> dict:
+                     fresh_gap=None, fresh_key=None,
+                     fresh_obs=None) -> dict:
     """Gate a wall-clock number against the trajectory floor.
 
     With ``fresh_value`` (a just-measured number), it is compared against
@@ -182,6 +188,13 @@ def check_regression(trajectory: dict, fresh_value=None,
     sentry even when total wall-clock absorbs it.  ``fresh_gap`` pairs
     with ``fresh_value``; archive points carry theirs from
     ``extract_headline``.
+
+    ``obs_overhead_pct`` (wall-clock cost of live observability at
+    256^2, instrumented vs ``metrics=False`` — PR 11's scoped fast
+    path) rides the same pattern via ``fresh_obs``.  The number is
+    already a percentage, so its gate is ABSOLUTE: more than
+    ``threshold_pct`` percentage POINTS over the floor fails (a
+    relative gate on a near-zero floor would flap on noise).
     """
     points = trajectory.get("points") or []
     problems = list(trajectory.get("problems", []))
@@ -204,6 +217,7 @@ def check_regression(trajectory: dict, fresh_value=None,
                     "points": len(points), "problems": problems}
         candidate, cand_src = float(fresh_value), "fresh"
         cand_gap = fresh_gap
+        cand_obs = fresh_obs
         prior = same
         floor = min(p["value"] for p in same)
     else:
@@ -212,6 +226,7 @@ def check_regression(trajectory: dict, fresh_value=None,
         same = [p for p in points if p["metric_key"] == key]
         candidate, cand_src = latest["value"], latest["file"]
         cand_gap = latest.get("host_gap_ms")
+        cand_obs = latest.get("obs_overhead_pct")
         prior = same[:-1]
         if not prior:
             return {"ok": True, "reason": "single_point",
@@ -248,6 +263,19 @@ def check_regression(trajectory: dict, fresh_value=None,
             problems.append(
                 f"host_gap_ms regressed {gap_reg:.1f}% past the "
                 f"{gap_floor:.1f} ms floor (candidate {cand_gap:.1f} ms)")
+    prior_obs = [p["obs_overhead_pct"] for p in prior
+                 if p.get("obs_overhead_pct") is not None]
+    if cand_obs is not None and prior_obs:
+        obs_floor = min(prior_obs)
+        obs_delta = float(cand_obs) - obs_floor
+        out["obs_overhead_pct"] = float(cand_obs)
+        out["obs_overhead_floor"] = obs_floor
+        out["obs_overhead_delta_pts"] = round(obs_delta, 2)
+        if obs_delta > threshold_pct:
+            out["ok"] = False
+            problems.append(
+                f"obs_overhead_pct grew {obs_delta:.1f} points past the "
+                f"{obs_floor:.1f}% floor (candidate {cand_obs:.1f}%)")
     return out
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -351,6 +379,38 @@ def _obs_fields():
         obs["peak_hbm_bytes"] = dict(sorted(hbm.items()))
     out["obs"] = obs
     return out
+
+
+def _measure_obs_overhead(a, ap, b, p, reps=3):
+    """Wall-clock cost of live observability at one 256^2 synthesis:
+    min-of-``reps`` with a metrics-bearing run scope active (every
+    engine call site resolves + writes through the ambient ObsScope)
+    vs min-of-``reps`` with ``metrics=False`` (the scoped fast path —
+    one module-bool check per call).  Returns the headline
+    ``obs_overhead_pct`` plus both raw floors; gated by ``ia bench
+    --check`` in percentage points (see check_regression)."""
+    from image_analogies_tpu.models.analogy import create_image_analogy
+    from image_analogies_tpu.obs import trace as obs_trace
+
+    p_off = p.replace(metrics=False, log_path=None)
+    p_on = p.replace(metrics=True, log_path=None)
+    create_image_analogy(a, ap, b, p_off)  # shared compile warm-up
+    off = on = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        create_image_analogy(a, ap, b, p_off)
+        off = min(off, time.perf_counter() - t0)
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        with obs_trace.run_scope(p_on):
+            create_image_analogy(a, ap, b, p_on)
+        on = min(on, time.perf_counter() - t0)
+    return {
+        "obs_overhead_pct": round((on - off) / off * 100.0, 2),
+        "instrumented_s": round(on, 3),
+        "disabled_s": round(off, 3),
+        "reps": reps,
+    }
 
 
 def _min_cpu(fn, reps=2):
@@ -527,6 +587,12 @@ def main() -> int:
             "oracle": "live",
             **_obs_fields(),
         }
+
+    # ---- obs overhead (PR 11): scoped-observability cost at 256^2 —
+    # measured on the oil config's inputs (already built above) so the
+    # number tracks a real synthesis, not a microbenchmark
+    obs_overhead = _measure_obs_overhead(a, ap, b, p)
+    configs["obs_overhead_256"] = obs_overhead
 
     # ---- configs 1/3/5 (BASELINE.json:7-12): texture-by-numbers,
     # super-res kappa sweep, batched video — live oracles at native sizes
@@ -747,6 +813,7 @@ def main() -> int:
         "value_median": round(ns_s_med, 3),
         "unit": "s",
         "host_gap_ms": ns_rec["host_gap_ms"],
+        "obs_overhead_pct": obs_overhead["obs_overhead_pct"],
         "vs_baseline": round(oracle_s / ns_s, 1),
         "ssim_vs_oracle": round(ns_ssim, 4),
         "value_match": round(ns_match, 4),

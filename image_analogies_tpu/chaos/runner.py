@@ -538,6 +538,38 @@ def drill_fleet(plan: ChaosPlan, *, n: int = 4, seed: int = 7
             if rec.get("poisoned"):
                 problems.append(
                     f"handoff poisoned {rec.get('poisoned')} entries")
+        # flight recorder: the ProcessDeath must have sealed a blackbox
+        # dump into the DEAD worker's journal dir (the one the handoff
+        # names), its seal must verify, and the render must show the
+        # death the drill injected.
+        blackbox: Dict[str, Any] = {}
+        if handoffs:
+            from image_analogies_tpu.obs import recorder as obs_recorder
+
+            dead_dir = os.path.join(fcfg.journal_root,
+                                    handoffs[0]["worker"])
+            dumps = obs_recorder.list_dumps(dead_dir)
+            if not dumps:
+                problems.append("no flight-recorder dump in dead "
+                                "worker's journal dir")
+            else:
+                try:
+                    doc = obs_recorder.load_dump(dumps[-1])
+                except ValueError as exc:
+                    problems.append(f"blackbox seal broken: {exc}")
+                else:
+                    text = obs_recorder.render_dump(doc)
+                    if "process_death" not in text:
+                        problems.append("blackbox render does not show "
+                                        "the process death")
+                    if not doc.get("records"):
+                        problems.append("blackbox dump has no records")
+                    blackbox = {
+                        "file": os.path.basename(dumps[-1]),
+                        "reason": doc.get("reason"),
+                        "scope": doc.get("scope"),
+                        "records": len(doc.get("records") or []),
+                    }
         identical = all(
             np.array_equal(originals[i].bp, baseline[i])
             for i in originals)
@@ -569,6 +601,7 @@ def drill_fleet(plan: ChaosPlan, *, n: int = 4, seed: int = 7
             "injected": injected,
             "sites": snap,
             "handoffs": handoffs,
+            "blackbox": blackbox,
             "fleet": {"pending": fleet_health.get("pending"),
                       "ring": fleet_health.get("ring")},
             "outcomes": {

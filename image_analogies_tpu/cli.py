@@ -562,6 +562,40 @@ def cmd_journal(args) -> int:
     return 2
 
 
+def cmd_blackbox(args) -> int:
+    """Render the flight-recorder dumps (obs/recorder.py) sealed into a
+    journal directory on a death path — the last N records before a
+    process death, breaker trip, or watchdog timeout.  Default shows the
+    newest dump; ``--all`` walks every dump chronologically.  A dump
+    whose integrity seal fails is reported as damaged, never rendered."""
+    from image_analogies_tpu.obs import recorder as obs_recorder
+
+    if not os.path.isdir(args.dir):
+        print(f"blackbox: no such directory {args.dir}", file=sys.stderr)
+        return 2
+    dumps = obs_recorder.list_dumps(args.dir)
+    if not dumps:
+        print(f"blackbox: no dumps in {args.dir}", file=sys.stderr)
+        return 1
+    if not args.all:
+        dumps = dumps[-1:]
+    docs = []
+    for path in dumps:
+        try:
+            docs.append((path, obs_recorder.load_dump(path)))
+        except ValueError as exc:
+            print(f"blackbox: {exc}", file=sys.stderr)
+            return 2
+    if args.json:
+        print(json.dumps([doc for _path, doc in docs], indent=2,
+                         sort_keys=True))
+        return 0
+    for path, doc in docs:
+        print(f"# {os.path.basename(path)}")
+        sys.stdout.write(obs_recorder.render_dump(doc, last=args.last))
+    return 0
+
+
 def cmd_metrics(args) -> int:
     """Prometheus exposition of a run log's latest metrics snapshot
     (obs/live.py).  Without --port, render once to stdout.  With --port,
@@ -642,6 +676,7 @@ def cmd_bench(args) -> int:
     trajectory = bench.load_trajectory(bench_dir)
     fresh = None
     fresh_gap = None
+    fresh_obs = None
     fresh_key = args.metric_key
     if args.value is not None:
         fresh = args.value
@@ -657,6 +692,8 @@ def cmd_bench(args) -> int:
             fresh = float(doc["value"])
             if doc.get("host_gap_ms") is not None:
                 fresh_gap = float(doc["host_gap_ms"])
+            if doc.get("obs_overhead_pct") is not None:
+                fresh_obs = float(doc["obs_overhead_pct"])
         else:
             head = bench.extract_headline(doc if isinstance(doc, dict)
                                           else {})
@@ -666,12 +703,14 @@ def cmd_bench(args) -> int:
                 return 2
             fresh = head["value"]
             fresh_gap = head.get("host_gap_ms")
+            fresh_obs = head.get("obs_overhead_pct")
             if fresh_key is None:
                 fresh_key = head.get("metric_key")
     verdict = bench.check_regression(trajectory, fresh_value=fresh,
                                      threshold_pct=args.threshold,
                                      fresh_gap=fresh_gap,
-                                     fresh_key=fresh_key)
+                                     fresh_key=fresh_key,
+                                     fresh_obs=fresh_obs)
     print(json.dumps(verdict, sort_keys=True))
     for problem in verdict.get("problems", []):
         print(f"bench: warning: {problem}", file=sys.stderr)
@@ -992,6 +1031,22 @@ def build_parser() -> argparse.ArgumentParser:
     jr.add_argument("--json", action="store_true",
                     help="machine-readable output")
     jr.set_defaults(fn=cmd_journal)
+
+    bb = sub.add_parser("blackbox",
+                        help="render sealed flight-recorder dumps from a "
+                             "journal directory (the last records before "
+                             "a process death / breaker trip / watchdog "
+                             "timeout)")
+    bb.add_argument("dir", help="journal directory holding "
+                                "blackbox-*.json dumps")
+    bb.add_argument("--all", action="store_true",
+                    help="render every dump (default: newest only)")
+    bb.add_argument("--last", type=int, default=0,
+                    help="trim each dump to its N newest records "
+                         "(0 = all)")
+    bb.add_argument("--json", action="store_true",
+                    help="machine-readable output (seal-verified)")
+    bb.set_defaults(fn=cmd_blackbox)
 
     wu = sub.add_parser("warmup",
                         help="AOT-compile jit signatures for a target "

@@ -41,10 +41,34 @@ Live telemetry plane (ISSUE 6 tentpole), jax-free like the core:
 - ``obs.trace.request_context`` — thread-ambient attrs (the serve
   request id) inherited by every span/record emitted inside the scope,
   so one request's records chain end to end in ``ia trace``.
+
+Fleet-scoped plane (ISSUE 11 tentpole), jax-free like the core:
+
+- ``obs.metrics.ObsScope`` — a bundled observability context (metrics
+  registry + flight recorder + SLO slot + dump dir) resolved
+  thread-ambiently by the one-liner helpers, so each fleet worker gets
+  an ISOLATED registry while writes chain to the fleet parent and the
+  call-site API stays unchanged.  ``scope_active`` pins a scope to the
+  current thread; ``run_scope`` installs one process-wide.
+- ``obs.fleet`` — label-only federation: merge N worker snapshots into
+  one fleet view (counters sum, max-gauges max, histograms merge
+  bucketwise) and render ``worker="<wid>"``-labeled Prometheus text;
+  ``snapshot_from_exposition`` recovers a snapshot from a remote
+  worker's scrape, so the merge is transport-agnostic.
+- ``obs.recorder`` — per-scope flight recorder: a bounded ring of
+  recent records, dumped as a SEALED blackbox JSON into the worker's
+  journal dir on process death / breaker trip / watchdog timeout;
+  ``ia blackbox <dir>`` renders the last seconds before a crash.
 """
 
 from image_analogies_tpu.obs import metrics, trace  # noqa: F401
-from image_analogies_tpu.obs.metrics import registry, snapshot  # noqa: F401
+from image_analogies_tpu.obs.metrics import (  # noqa: F401
+    ObsScope,
+    current_scope,
+    registry,
+    scope_active,
+    snapshot,
+)
 from image_analogies_tpu.obs.trace import (  # noqa: F401
     current_run_id,
     run_scope,

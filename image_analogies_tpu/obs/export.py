@@ -114,11 +114,15 @@ def _classify(rec: Dict[str, Any]) -> Tuple[str, int, str, Optional[float]]:
               "watchdog_timeout",
               "retry_exhausted", "serve_worker_crash", "serve_process_death",
               "breaker_open",
-              "breaker_half_open", "breaker_closed"):
+              "breaker_half_open", "breaker_closed", "blackbox_dump"):
         # fault-plane instants on their own track: injections line up
         # visually against the retries/quarantines/crashes they caused
         if ev == "chaos_inject":
             name = f"inject {rec.get('kind', '?')} @{rec.get('site', '?')}"
+        elif ev == "blackbox_dump":
+            # the flight-recorder seal sits NEXT to the fault that
+            # triggered it on the same track
+            name = f"blackbox {rec.get('reason', '?')}"
         else:
             name = str(ev)
         return "i", CHAOS_TID, name, None

@@ -1,12 +1,23 @@
-"""Process-local, thread-safe metrics registry.
+"""Scoped, thread-safe metrics registries.
 
 A :class:`MetricsRegistry` holds counters, gauges, and histograms keyed
-by name.  One registry is installed per run by ``obs.trace.run_scope``;
-instrumentation sites in the engine call the module-level helpers
+by name.  Registries travel inside an :class:`ObsScope` — the unit of
+observability identity (registry + flight recorder + SLO tracker +
+dump dir) — and scopes resolve THREAD-AMBIENTLY, the same pattern as
+``obs.trace.request_context``: a per-thread scope stack first, then the
+process-default scope installed by ``obs.trace.run_scope``, then None.
+Instrumentation sites everywhere call the module-level helpers
 (:func:`inc`, :func:`add_gauge`, :func:`set_gauge`, :func:`observe`),
-which check a single module bool before touching the registry — with no
-active run the cost is one attribute load + branch per call site, so
-bench numbers do not move when observability is off.
+which check a single module bool before resolving — with no scope
+active anywhere the cost is one attribute load + branch per call site,
+so bench numbers do not move when observability is off.
+
+A scope may chain to a ``parent``: writes land in the scope's own
+registry AND every ancestor's.  That is how fleet workers get isolated
+per-worker registries (each worker thread pushes its scope) while the
+enclosing run's registry still sees the whole-fleet totals that drills
+and ``run_end`` snapshots assert on.  Reads (``registry()``,
+``snapshot()``) never chain — they see exactly the resolved scope.
 
 No jax / numpy imports here: the registry must be importable from any
 layer (utils, parallel, backends) without creating cycles or forcing
@@ -15,9 +26,13 @@ device init.
 
 from __future__ import annotations
 
+import contextlib
+import itertools
 import math
 import threading
 from typing import Dict, List, Optional
+
+from image_analogies_tpu.obs import recorder as _recorder
 
 
 class Histogram:
@@ -139,61 +154,204 @@ class MetricsRegistry:
             }
 
 
-# --- module-level fast path -------------------------------------------------
+# --- scoped observability contexts ------------------------------------------
+
+_SCOPE_IDS = itertools.count(1)
+
+
+class ObsScope:
+    """One observability identity: a registry plus the trace sink
+    (flight-recorder ring) and slots for the SLO tracker and black-box
+    dump directory that travel with it.
+
+    ``parent`` chains writes upward (worker scope -> fleet/run scope):
+    metric WRITES through this scope land in every registry on the
+    chain, so isolation (reads see only this worker) and aggregate
+    invariants (the run's registry sums all workers) hold at once.
+    Reads never chain.
+    """
+
+    __slots__ = ("scope_id", "registry", "parent", "recorder", "slo",
+                 "dump_dir")
+
+    def __init__(self, scope_id: Optional[str] = None,
+                 parent: Optional["ObsScope"] = None,
+                 recorder_capacity: int = _recorder.DEFAULT_CAPACITY):
+        self.scope_id = scope_id or f"scope{next(_SCOPE_IDS)}"
+        self.registry = MetricsRegistry()
+        self.parent = parent
+        self.recorder = _recorder.FlightRecorder(recorder_capacity)
+        self.slo = None  # obs.slo.SloTracker, attached by the owner
+        self.dump_dir: Optional[str] = None  # black-box dump target
+
+    def inc(self, name: str, value: float = 1) -> None:
+        s: Optional[ObsScope] = self
+        while s is not None:
+            s.registry.inc(name, value)
+            s = s.parent
+
+    def set_gauge(self, name: str, value: float) -> None:
+        s: Optional[ObsScope] = self
+        while s is not None:
+            s.registry.set_gauge(name, value)
+            s = s.parent
+
+    def add_gauge(self, name: str, value: float) -> None:
+        s: Optional[ObsScope] = self
+        while s is not None:
+            s.registry.add_gauge(name, value)
+            s = s.parent
+
+    def max_gauge(self, name: str, value: float) -> None:
+        s: Optional[ObsScope] = self
+        while s is not None:
+            s.registry.max_gauge(name, value)
+            s = s.parent
+
+    def observe(self, name: str, value: float) -> None:
+        s: Optional[ObsScope] = self
+        while s is not None:
+            s.registry.observe(name, value)
+            s = s.parent
+
+
+# --- module-level fast path + scope resolution ------------------------------
 #
-# _ACTIVE is flipped by obs.trace when a run installs/uninstalls a
-# registry.  Hot-path call sites read one module global and branch; the
-# lock is only ever taken when a run asked for metrics.
+# _ACTIVE is true while ANY scope is installed anywhere (process default
+# or any thread's stack).  Hot-path call sites read one module global
+# and branch; resolution walks thread-local -> process default only when
+# some run asked for metrics.
 
 _ACTIVE = False
-_REGISTRY: Optional[MetricsRegistry] = None
-_STACK: List[MetricsRegistry] = []
+_ACTIVE_COUNT = 0
+_ACTIVE_LOCK = threading.Lock()
+_PROCESS: List[ObsScope] = []  # process-default stack (run_scope installs)
+_TLS = threading.local()  # per-thread scope stack (fleet worker threads)
 
 
-def _install(reg: MetricsRegistry) -> None:
-    global _ACTIVE, _REGISTRY
-    _STACK.append(reg)
-    _REGISTRY = reg
-    _ACTIVE = True
+def _activate() -> None:
+    global _ACTIVE, _ACTIVE_COUNT
+    with _ACTIVE_LOCK:
+        _ACTIVE_COUNT += 1
+        _ACTIVE = True
 
 
-def _uninstall(reg: MetricsRegistry) -> None:
-    global _ACTIVE, _REGISTRY
-    if reg in _STACK:
-        _STACK.remove(reg)
-    _REGISTRY = _STACK[-1] if _STACK else None
-    _ACTIVE = _REGISTRY is not None
+def _deactivate() -> None:
+    global _ACTIVE, _ACTIVE_COUNT
+    with _ACTIVE_LOCK:
+        _ACTIVE_COUNT = max(_ACTIVE_COUNT - 1, 0)
+        _ACTIVE = _ACTIVE_COUNT > 0
+
+
+def current_scope() -> Optional[ObsScope]:
+    """Thread-ambient scope resolution: this thread's innermost pushed
+    scope, else the process-default scope, else None.  The disabled path
+    is one module-global read + branch — no allocation."""
+    if not _ACTIVE:
+        return None
+    stack = getattr(_TLS, "stack", None)
+    if stack:
+        return stack[-1]
+    return _PROCESS[-1] if _PROCESS else None
+
+
+def push_scope(scope: ObsScope) -> None:
+    stack = getattr(_TLS, "stack", None)
+    if stack is None:
+        stack = _TLS.stack = []
+    stack.append(scope)
+    _activate()
+
+
+def pop_scope(scope: ObsScope) -> None:
+    stack = getattr(_TLS, "stack", None)
+    if stack:
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is scope:
+                del stack[i]
+                break
+    _deactivate()
+
+
+@contextlib.contextmanager
+def scope_active(scope: Optional[ObsScope]):
+    """Make ``scope`` the current thread's ambient scope for the block.
+    ``scope_active(None)`` is a transparent no-op, so call sites that
+    may or may not own a scope (standalone Server vs fleet worker)
+    never branch."""
+    if scope is None:
+        yield None
+        return
+    push_scope(scope)
+    try:
+        yield scope
+    finally:
+        pop_scope(scope)
+
+
+def install_process_scope(scope: ObsScope) -> None:
+    """Install the process-default scope (obs.trace.run_scope does this
+    once per top-level run) — the fallback every thread without its own
+    pushed scope resolves to."""
+    _PROCESS.append(scope)
+    _activate()
+
+
+def uninstall_process_scope(scope: ObsScope) -> None:
+    for i in range(len(_PROCESS) - 1, -1, -1):
+        if _PROCESS[i] is scope:
+            del _PROCESS[i]
+            break
+    _deactivate()
 
 
 def registry() -> Optional[MetricsRegistry]:
-    return _REGISTRY
+    # _ACTIVE is re-checked HERE (not just inside current_scope) so the
+    # disabled path never pushes another frame — the zero-alloc contract
+    # (test_obs_live's tracemalloc lock) is depth-sensitive: a nested
+    # call can force a fresh interpreter datastack chunk.
+    if not _ACTIVE:
+        return None
+    s = current_scope()
+    return s.registry if s is not None else None
 
 
 def inc(name: str, value: float = 1) -> None:
     if _ACTIVE:
-        _REGISTRY.inc(name, value)
+        s = current_scope()
+        if s is not None:
+            s.inc(name, value)
 
 
 def set_gauge(name: str, value: float) -> None:
     if _ACTIVE:
-        _REGISTRY.set_gauge(name, value)
+        s = current_scope()
+        if s is not None:
+            s.set_gauge(name, value)
 
 
 def add_gauge(name: str, value: float) -> None:
     if _ACTIVE:
-        _REGISTRY.add_gauge(name, value)
+        s = current_scope()
+        if s is not None:
+            s.add_gauge(name, value)
 
 
 def max_gauge(name: str, value: float) -> None:
     if _ACTIVE:
-        _REGISTRY.max_gauge(name, value)
+        s = current_scope()
+        if s is not None:
+            s.max_gauge(name, value)
 
 
 def observe(name: str, value: float) -> None:
     if _ACTIVE:
-        _REGISTRY.observe(name, value)
+        s = current_scope()
+        if s is not None:
+            s.observe(name, value)
 
 
 def snapshot() -> Dict[str, dict]:
-    return _REGISTRY.snapshot() if _REGISTRY is not None else {
+    s = current_scope() if _ACTIVE else None
+    return s.registry.snapshot() if s is not None else {
         "counters": {}, "gauges": {}, "histograms": {}}

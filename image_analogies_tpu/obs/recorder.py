@@ -1,0 +1,213 @@
+"""Flight recorder: a bounded ring of recent records per ObsScope.
+
+Every record stamped while a run is active (span exits, serve/journal
+events, chaos injections — anything flowing through
+``utils.logging.emit``) is also appended to the CURRENT scope's ring, so
+each fleet worker carries its own last-N-records black box.  On the
+death paths that historically left nothing behind — ``ProcessDeath`` in
+the worker loop, a breaker tripping open, a watchdog timeout — the ring
+is dumped as a SEALED JSON file (same integrity idea as
+utils/checkpoint.py: a sha256 over the payload rides inside the file and
+is verified on load, so a torn write or bit rot reads as damage, never
+as a plausible-but-wrong flight log) into the scope's ``dump_dir``
+(the worker's journal dir).  ``ia blackbox <dir>`` renders the last
+seconds before the death.
+
+Jax-free like the rest of the obs core; imports of obs.metrics stay
+inside functions (metrics imports this module at module scope).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+DEFAULT_CAPACITY = 256
+
+_DUMP_SEQ = itertools.count(1)  # uniquifies same-millisecond dumps
+
+
+class FlightRecorder:
+    """Thread-safe bounded ring of record dicts (newest last).
+
+    ``record`` keeps a reference, not a copy: callers
+    (obs.trace._stamp) hand over the per-emit private dict that
+    utils.logging already copied, so the ring costs one append — the
+    recorder must stay cheap enough to run on every record of a live
+    worker.  Evictions are counted in ``dropped`` so a dump says how
+    much history fell off the back.
+    """
+
+    __slots__ = ("capacity", "_ring", "_lock", "dropped")
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.capacity = int(capacity)
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self.dropped = 0
+
+    def record(self, rec: Dict[str, Any]) -> None:
+        with self._lock:
+            if len(self._ring) == self.capacity:
+                self.dropped += 1
+            self._ring.append(rec)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def snapshot(self) -> Tuple[List[Dict[str, Any]], int]:
+        """(records oldest->newest, dropped count) — records are shallow
+        copies so a dump serializes a stable view."""
+        with self._lock:
+            return [dict(r) for r in self._ring], self.dropped
+
+
+# --- sealed dumps -----------------------------------------------------------
+
+def _payload_checksum(payload: Dict[str, Any]) -> str:
+    """sha256 over the canonical-JSON payload: the integrity seal stored
+    INSIDE the dump, checked on load (checkpoint-style — partial writes
+    and rot fail the seal rather than rendering a wrong flight log)."""
+    blob = json.dumps(payload, sort_keys=True, default=str).encode()
+    return hashlib.sha256(blob).hexdigest()[:32]
+
+
+def dump(recorder: FlightRecorder, dump_dir: str, reason: str, *,
+         scope_id: str = "", extra: Optional[Dict[str, Any]] = None) -> str:
+    """Write the ring as a sealed ``blackbox-*.json`` into ``dump_dir``
+    (atomic tmp+rename, like every other durable artifact here).
+    Returns the dump path."""
+    records, dropped = recorder.snapshot()
+    payload: Dict[str, Any] = {
+        "version": 1,
+        "reason": str(reason),
+        "scope": scope_id,
+        "wall_ts": round(time.time(), 3),
+        "dropped": dropped,
+        "records": records,
+    }
+    if extra:
+        payload["extra"] = extra
+    doc = dict(payload)
+    doc["checksum"] = _payload_checksum(payload)
+    os.makedirs(dump_dir, exist_ok=True)
+    fname = (f"blackbox-{int(time.time() * 1e3):013d}"
+             f"-{next(_DUMP_SEQ):04d}-{_safe(reason)}.json")
+    path = os.path.join(dump_dir, fname)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, sort_keys=True, default=str)
+    os.replace(tmp, path)
+    return path
+
+
+def _safe(name: str) -> str:
+    return "".join(c if c.isalnum() or c in "-_" else "_" for c in name)[:40]
+
+
+def dump_current(reason: str,
+                 extra: Optional[Dict[str, Any]] = None) -> Optional[str]:
+    """Dump the CURRENT scope's ring, resolving thread-ambiently.
+
+    This is the one-liner the death paths call (ProcessDeath handler,
+    breaker trip, watchdog timeout).  It never raises — a failing dump
+    must not turn a contained fault into a new crash — and it is a no-op
+    when no scope is active, the scope has no recorder, or no
+    ``dump_dir`` was assigned (non-journaled runs have nowhere durable
+    to put a black box).  Successful dumps bump ``obs.blackbox.dumps``
+    (+ a per-reason counter); failures bump ``obs.blackbox.dump_errors``.
+    """
+    from image_analogies_tpu.obs import metrics as _metrics
+
+    try:
+        scope = _metrics.current_scope()
+        if scope is None or scope.recorder is None or not scope.dump_dir:
+            return None
+        path = dump(scope.recorder, scope.dump_dir, reason,
+                    scope_id=scope.scope_id, extra=extra)
+        _metrics.inc("obs.blackbox.dumps")
+        _metrics.inc(f"obs.blackbox.dumps.{_safe(reason)}")
+        # the dump itself is a fault-plane event: record it so the run
+        # log (and the Perfetto chaos track) shows where a black box
+        # was sealed
+        from image_analogies_tpu.obs import trace as _trace
+
+        _trace.emit_record({"event": "blackbox_dump", "reason": reason,
+                            "scope": scope.scope_id,
+                            "file": os.path.basename(path)})
+        return path
+    except Exception:
+        try:
+            _metrics.inc("obs.blackbox.dump_errors")
+        except Exception:
+            pass
+        return None
+
+
+# --- load / render (`ia blackbox`) ------------------------------------------
+
+def list_dumps(dump_dir: str) -> List[str]:
+    """Sorted ``blackbox-*.json`` paths under ``dump_dir`` (filename
+    order == chronological: the name leads with the epoch-ms stamp)."""
+    try:
+        names = sorted(n for n in os.listdir(dump_dir)
+                       if n.startswith("blackbox-") and n.endswith(".json"))
+    except OSError:
+        return []
+    return [os.path.join(dump_dir, n) for n in names]
+
+
+def load_dump(path: str) -> Dict[str, Any]:
+    """Parse + seal-verify one dump.  Raises ``ValueError`` on a missing
+    or failed seal — a damaged black box must be reported as damaged,
+    never rendered as if it were the real pre-death history."""
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or "checksum" not in doc:
+        raise ValueError(f"blackbox dump {path}: no integrity seal")
+    want = doc.pop("checksum")
+    got = _payload_checksum(doc)
+    if want != got:
+        raise ValueError(f"blackbox dump {path}: seal mismatch "
+                         f"(want {want}, got {got})")
+    return doc
+
+
+def render_dump(doc: Dict[str, Any], *, last: int = 0) -> str:
+    """Human-readable flight log: one line per record, timestamped
+    relative to the final record (the moment of death).  ``last`` trims
+    to the N newest records (0 = all)."""
+    records = list(doc.get("records") or [])
+    if last > 0:
+        records = records[-last:]
+    end_ts = None
+    for rec in reversed(records):
+        ts = rec.get("ts")
+        if isinstance(ts, (int, float)):
+            end_ts = float(ts)
+            break
+    lines = [
+        f"blackbox: reason={doc.get('reason', '?')} "
+        f"scope={doc.get('scope') or '(unscoped)'} "
+        f"records={len(doc.get('records') or [])} "
+        f"dropped={doc.get('dropped', 0)}"
+    ]
+    for rec in records:
+        ts = rec.get("ts")
+        if end_ts is not None and isinstance(ts, (int, float)):
+            stamp = f"{float(ts) - end_ts:+9.3f}s"
+        else:
+            stamp = " " * 10
+        ev = rec.get("event") or rec.get("name") or "record"
+        detail = {k: v for k, v in sorted(rec.items())
+                  if k not in ("ts", "event") and not isinstance(v, dict)}
+        body = " ".join(f"{k}={v}" for k, v in detail.items())
+        lines.append(f"  {stamp} {ev} {body}".rstrip())
+    return "\n".join(lines) + "\n"

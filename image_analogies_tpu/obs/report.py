@@ -300,6 +300,9 @@ def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             "quarantined": int(counters.get("serve.journal.quarantined", 0)),
             "poison_sheds": int(counters.get("serve.poisoned", 0)),
             "process_deaths": int(counters.get("serve.process_deaths", 0)),
+            # flight-recorder seals (obs/recorder.py): how many black
+            # boxes the death paths dumped during this run
+            "blackbox_dumps": int(counters.get("obs.blackbox.dumps", 0)),
             # each restart's replay summary, in order
             "recoveries": [{k: r[k] for k in
                             ("entries", "replayed", "poisoned", "done",
@@ -624,6 +627,9 @@ def render(an: Dict[str, Any], run_id: Optional[str] = None) -> str:
               f"{len(jn['recoveries'])} restart(s), "
               f"{jn['process_deaths']} process deaths, "
               f"{jn['quarantined']} journal files quarantined")
+        if jn.get("blackbox_dumps"):
+            w(f"    blackbox      {jn['blackbox_dumps']} flight-recorder "
+              f"dump(s) sealed (ia blackbox <journal-dir>)")
         for i, rcv in enumerate(jn["recoveries"]):
             w(f"    restart {i:<5} entries={rcv.get('entries', 0)} "
               f"replayed={rcv.get('replayed', 0)} "

@@ -15,7 +15,13 @@ Everything is exported as gauges through the ordinary obs metrics
 helpers (``slo.target``, ``slo.attainment``, ``slo.burn_rate.fast``,
 ``slo.burn_rate.slow``) plus counters ``slo.deadlined`` /
 ``slo.violations``, so the live /metrics exposition, ``ia report``'s
-``slo`` section, and /healthz all read the same numbers.
+``slo`` section, and /healthz all read the same numbers.  The helpers
+resolve thread-ambiently (obs/metrics.py): a fleet worker's tracker
+writes into that worker's own :class:`~.metrics.ObsScope` (which also
+carries the tracker as ``scope.slo``), so per-worker ``/metrics`` show
+per-worker burn while the fleet roll-up takes the MAX across workers
+(``slo.`` is a max-gauge family in obs/fleet.py — averaging away one
+worker's page-worthy burn rate would defeat the signal).
 
 Contract (shared with the rest of obs/): **no module-scope jax import**
 (grep-locked) and near-zero cost when observability is disabled — the

@@ -1,7 +1,9 @@
 """Run-scoped span tracing.
 
 ``run_scope(params, ...)`` opens a run: it mints a ``run_id``, installs
-a per-run :class:`~image_analogies_tpu.obs.metrics.MetricsRegistry`,
+a per-run :class:`~image_analogies_tpu.obs.metrics.ObsScope` (registry +
+flight recorder) as the PROCESS-DEFAULT scope — threads with their own
+pushed scope (fleet workers) keep theirs; everyone else resolves here —
 registers a record stamper with ``utils.logging`` (every JSONL record
 written while the run is active gains ``run_id`` + a monotonically
 increasing ``seq``), and emits a ``run_manifest`` record (config hash,
@@ -40,14 +42,15 @@ from image_analogies_tpu.utils import logging as _logging
 class RunContext:
     """State of one observed run (one engine invocation or one clip)."""
 
-    __slots__ = ("run_id", "log_path", "registry", "seq", "_seq_lock",
-                 "depth", "owner_thread", "_joined_threads")
+    __slots__ = ("run_id", "log_path", "scope", "registry", "seq",
+                 "_seq_lock", "depth", "owner_thread", "_joined_threads")
 
     def __init__(self, run_id: str, log_path: Optional[str],
-                 registry: _metrics.MetricsRegistry):
+                 scope: _metrics.ObsScope):
         self.run_id = run_id
         self.log_path = log_path
-        self.registry = registry
+        self.scope = scope
+        self.registry = scope.registry
         self.seq = 0
         self._seq_lock = threading.Lock()
         self.depth = 0  # run_scope reentrancy count
@@ -75,6 +78,14 @@ def _stamp(record: Dict[str, Any]) -> None:
     if ctx is not None:
         record.setdefault("run_id", ctx.run_id)
         record.setdefault("seq", ctx.next_seq())
+        # Feed the CURRENT scope's flight recorder (thread-ambient: a
+        # fleet worker's records land in ITS ring, not the run's), so
+        # every scope carries its own last-seconds black box.  The
+        # record is emit()'s private copy — a reference is safe.
+        scope = _metrics.current_scope() or ctx.scope
+        rec = scope.recorder
+        if rec is not None:
+            rec.record(record)
 
 
 # Registered once at import: utils.logging calls it on every emit; it is
@@ -221,10 +232,14 @@ def run_scope(params: Any = None, log_path: Optional[str] = None,
         yield None
         return
 
-    ctx = RunContext(uuid.uuid4().hex[:16], log_path,
-                     _metrics.MetricsRegistry())
+    run_id = uuid.uuid4().hex[:16]
+    scope = _metrics.ObsScope(scope_id=f"run:{run_id}")
+    ctx = RunContext(run_id, log_path, scope)
     _CURRENT = ctx
-    _metrics._install(ctx.registry)
+    # The run's scope is the PROCESS default: every thread without its
+    # own pushed scope (engine, tests, HTTP handlers) resolves to it —
+    # the historic single-registry behavior, now one scope among many.
+    _metrics.install_process_scope(scope)
     # One append handle per log path for the whole run (the hot level
     # loop streams a record per level/frame); flushed + closed with the
     # run so `run_end` is durable the moment the scope exits.
@@ -238,7 +253,7 @@ def run_scope(params: Any = None, log_path: Optional[str] = None,
         snap = ctx.registry.snapshot()
         _logging.emit({"event": "run_end", "metrics": snap}, log_path)
         _logging.end_handle_cache()
-        _metrics._uninstall(ctx.registry)
+        _metrics.uninstall_process_scope(scope)
         _CURRENT = None
 
 

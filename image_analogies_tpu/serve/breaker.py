@@ -32,6 +32,7 @@ import time
 from typing import Callable
 
 from image_analogies_tpu.obs import metrics as obs_metrics
+from image_analogies_tpu.obs import recorder as obs_recorder
 from image_analogies_tpu.obs import trace as obs_trace
 
 
@@ -137,3 +138,9 @@ class CircuitBreaker:
         obs_metrics.inc("serve.breaker.trips")
         obs_trace.emit_record({"event": "breaker_open",
                                "cooldown_s": self._cooldown_s})
+        # A trip means the last `threshold` dispatches all failed — dump
+        # the flight ring while the evidence is still in it (no-op when
+        # the current scope has no dump dir; never raises).
+        obs_recorder.dump_current("breaker_open",
+                                  extra={"backend": self.backend,
+                                         "cooldown_s": self._cooldown_s})

@@ -35,15 +35,19 @@ file).  Device work happens inside each worker's engine.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json as _json
 import os
 import threading
+import time
 from concurrent.futures import Future
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from image_analogies_tpu.obs import fleet as obs_fleet
+from image_analogies_tpu.obs import live as obs_live
 from image_analogies_tpu.obs import metrics as obs_metrics
 from image_analogies_tpu.obs import trace as obs_trace
 from image_analogies_tpu.serve import wire
@@ -74,11 +78,13 @@ class WorkerHandle:
     wire_formats = ("iaf2", "json")
 
     def __init__(self, wid: str, server: Server, generation: int,
-                 codec: str):
+                 codec: str,
+                 scope: Optional[obs_metrics.ObsScope] = None):
         self.wid = wid
         self.server = server
         self.generation = generation
         self.codec = codec
+        self.scope = scope
 
     def recovery_future(self, idem: str) -> Optional["Future[Response]"]:
         """The replay future recover() registered for ``idem`` (already
@@ -136,6 +142,11 @@ class Fleet:
         self._stop = threading.Event()
         self._health_thread: Optional[threading.Thread] = None
         self._started = False
+        # Fleet-level obs scope (parent of every worker scope) + the
+        # health loop's scrape cache: wid -> {scope, t, snapshot}.
+        self._scope: Optional[obs_metrics.ObsScope] = None
+        self._scope_exit = contextlib.ExitStack()
+        self._scrapes: Dict[str, Dict[str, Any]] = {}
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -153,12 +164,18 @@ class Fleet:
         return "json"
 
     def _spawn(self, wid: str, generation: int) -> WorkerHandle:
-        server = Server(self._worker_cfg(wid)).start()
+        # Per-worker obs scope: the worker's counters/spans land in its
+        # OWN registry (isolated view for /metrics?worker=) and chain to
+        # the fleet scope, so fleet-wide snapshots keep summing.
+        scope = obs_metrics.ObsScope(
+            scope_id="{}.g{}".format(wid, generation), parent=self._scope)
+        server = Server(self._worker_cfg(wid), obs_scope=scope).start()
         codec = self._negotiate(WorkerHandle.wire_formats)
-        handle = WorkerHandle(wid, server, generation, codec)
+        handle = WorkerHandle(wid, server, generation, codec, scope=scope)
         with self._lock:
             self.workers[wid] = handle
             self._misses[wid] = 0
+            self._scrape_locked(wid, handle)
         obs_metrics.inc("router.wire.{}".format(codec), 0)
         return handle
 
@@ -166,6 +183,15 @@ class Fleet:
         if self._started:
             return self
         self._started = True
+        # The fleet's own run scope (joins an ambient drill/test run
+        # reentrantly): router counters written from caller threads
+        # resolve here, and every worker scope chains into it.
+        self._scope_exit.enter_context(obs_trace.run_scope(
+            self.cfg.serve.params.replace(metrics=True),
+            manifest_extra={"fleet": {"size": self.cfg.size,
+                                      "wire": self.cfg.wire,
+                                      "vnodes": self.cfg.vnodes}}))
+        self._scope = obs_metrics.current_scope()
         for i in range(self.cfg.size):
             wid = "w{}".format(i)
             self._spawn(wid, generation=0)
@@ -185,6 +211,7 @@ class Fleet:
             self._health_thread.join(5.0)
         for handle in list(self.workers.values()):
             handle.server.shutdown()
+        self._scope_exit.close()
         self._started = False
 
     def __enter__(self) -> "Fleet":
@@ -258,6 +285,22 @@ class Fleet:
             return "saturated"
         return None
 
+    def _scrape_locked(self, wid: str, handle: WorkerHandle) -> None:
+        """Cache a metrics snapshot of the worker's obs scope (lock held).
+
+        The health loop is the fleet's scrape cadence: each pass stores
+        the worker's isolated registry snapshot plus when it was taken,
+        so /healthz can report scrape freshness per worker and a merged
+        view is available even for a worker that dies mid-interval.
+        """
+        if handle.scope is None:
+            return
+        self._scrapes[wid] = {
+            "scope": handle.scope.scope_id,
+            "t": time.monotonic(),
+            "snapshot": handle.scope.registry.snapshot(),
+        }
+
     def _health_loop(self) -> None:
         while not self._stop.wait(self.cfg.health_interval_s):
             for wid in list(self.workers):
@@ -266,6 +309,8 @@ class Fleet:
                 handle = self.workers.get(wid)
                 if handle is None:
                     continue
+                with self._lock:
+                    self._scrape_locked(wid, handle)
                 verdict = self._judge(handle)
                 if verdict == "dead":
                     with self._lock:
@@ -317,6 +362,47 @@ class Fleet:
     # ------------------------------------------------------------------
     # observability
 
+    def _worker_obs(self, wid: str, handle: WorkerHandle) -> Dict[str, Any]:
+        """Obs identity for /healthz: which scope serves this wid and how
+        stale the health loop's last scrape of it is."""
+        with self._lock:
+            scrape = self._scrapes.get(wid)
+        obs: Dict[str, Any] = {
+            "scope": handle.scope.scope_id if handle.scope else None,
+        }
+        if scrape is not None:
+            obs["last_scrape_age_s"] = round(
+                time.monotonic() - scrape["t"], 3)
+            if scrape["scope"] != obs["scope"]:
+                obs["stale_scope"] = scrape["scope"]
+        return obs
+
+    def metrics_snapshots(self) -> Dict[str, Dict[str, Any]]:
+        """Fresh per-worker registry snapshots keyed by wid (the
+        federation input: each is the worker's ISOLATED view)."""
+        return {wid: handle.scope.registry.snapshot()
+                for wid, handle in sorted(self.workers.items())
+                if handle.scope is not None}
+
+    def metrics_text(self, worker: Optional[str] = None) -> Optional[str]:
+        """Prometheus exposition: merged fleet view with ``worker=<wid>``
+        labeled series, or one worker's isolated view (``worker=``
+        selector).  Returns None for an unknown wid."""
+        if worker is not None:
+            handle = self.workers.get(worker)
+            if handle is None or handle.scope is None:
+                return None
+            return obs_live.render_prometheus(
+                handle.scope.registry.snapshot())
+        extra = None
+        if self._scope is not None:
+            # Fleet-scope families the workers do not chain into
+            # (router.*) ride along labeled worker="fleet"; worker-
+            # chained families are filtered inside render_fleet so
+            # nothing is double counted.
+            extra = ("fleet", self._scope.registry.snapshot())
+        return obs_fleet.render_fleet(self.metrics_snapshots(), extra=extra)
+
     def health(self) -> Dict[str, Any]:
         """Fleet /healthz view: per-worker liveness + ring membership."""
         workers: Dict[str, Any] = {}
@@ -331,11 +417,13 @@ class Fleet:
                     "breakers": h.get("breakers", {}),
                     "journal": h.get("journal"),
                     "gate": self._gates.get(wid),
+                    "obs": self._worker_obs(wid, handle),
                 }
             except Exception as exc:  # noqa: BLE001 - report, not raise
                 workers[wid] = {"ok": False, "error": str(exc),
                                 "generation": handle.generation,
-                                "gate": self._gates.get(wid)}
+                                "gate": self._gates.get(wid),
+                                "obs": self._worker_obs(wid, handle)}
         return {
             "ok": all(w.get("ok") for w in workers.values()),
             "size": self.cfg.size,

@@ -33,6 +33,7 @@ out and vice versa both work); errors are always JSON.
 from __future__ import annotations
 
 import json
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict
 
@@ -50,7 +51,11 @@ def _make_handler(server: Server):
                               server.refresh_gauges)
 
 
-def _make_handler_from(health_fn, submit_fn, refresh_fn):
+def _make_handler_from(health_fn, submit_fn, refresh_fn, metrics_fn=None):
+    # metrics_fn(worker: Optional[str]) -> Optional[str]: override for
+    # the /metrics exposition (the fleet's federated view, with
+    # ?worker=<wid> selecting one worker's isolated registry).  None
+    # keeps the default ambient-scope exposition.
     class Handler(BaseHTTPRequestHandler):
         # Silence per-request stderr chatter; obs records cover it.
         def log_message(self, fmt, *args):  # noqa: A003
@@ -73,10 +78,21 @@ def _make_handler_from(health_fn, submit_fn, refresh_fn):
             self.wfile.write(body)
 
         def do_GET(self):  # noqa: N802 - stdlib API
-            if self.path == "/healthz":
+            parts = urllib.parse.urlsplit(self.path)
+            if parts.path == "/healthz":
                 self._reply(200, health_fn())
-            elif self.path == "/metrics":
+            elif parts.path == "/metrics":
                 refresh_fn()
+                if metrics_fn is not None:
+                    query = urllib.parse.parse_qs(parts.query)
+                    worker = (query.get("worker") or [None])[0]
+                    text = metrics_fn(worker)
+                    if text is None:
+                        self._reply(404, {"error": "unknown_worker",
+                                          "worker": worker})
+                        return
+                    self._reply_text(200, text, obs_live.CONTENT_TYPE)
+                    return
                 self._reply_text(
                     200,
                     obs_live.render_prometheus(obs_live.snapshot_or_none()),
@@ -176,8 +192,12 @@ def serve_http(server: Server, port: int) -> ThreadingHTTPServer:
 
 def serve_fleet_http(fleet, port: int) -> ThreadingHTTPServer:
     """Fleet front end: same transport, but /healthz is the FLEET view
-    (per-worker liveness, ring membership, gates, journal ownership) and
-    POST /v1/analogy routes through the consistent-hash Router."""
+    (per-worker liveness, ring membership, gates, journal ownership,
+    per-worker obs scope identity), POST /v1/analogy routes through the
+    consistent-hash Router, and GET /metrics is the FEDERATED exposition
+    (obs/fleet.py): merged samples plus ``worker="<wid>"`` labeled
+    series, with ``?worker=<wid>`` selecting one worker's isolated
+    registry (unknown wid -> 404)."""
 
     def _refresh():
         for handle in list(fleet.workers.values()):
@@ -188,4 +208,5 @@ def serve_fleet_http(fleet, port: int) -> ThreadingHTTPServer:
 
     return ThreadingHTTPServer(
         ("127.0.0.1", port),
-        _make_handler_from(fleet.health, fleet.submit, _refresh))
+        _make_handler_from(fleet.health, fleet.submit, _refresh,
+                           metrics_fn=fleet.metrics_text))

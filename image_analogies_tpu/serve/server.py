@@ -29,6 +29,7 @@ use to model process death.
 from __future__ import annotations
 
 import contextlib
+import functools
 import threading
 import time
 from concurrent.futures import Future
@@ -55,9 +56,29 @@ from image_analogies_tpu.serve.worker import WorkerPool
 from image_analogies_tpu.tune import warmup as tune_warmup
 
 
+def _scoped(fn):
+    """Bracket a Server entry point in the server's obs scope, so a
+    fleet worker's counters land in its own registry no matter which
+    thread (router, HTTP handler, health loop) called in.  Transparent
+    when ``obs_scope`` is None (standalone server)."""
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with obs_metrics.scope_active(self.obs_scope):
+            return fn(self, *args, **kwargs)
+    return wrapper
+
+
 class Server:
-    def __init__(self, cfg: ServeConfig):
+    def __init__(self, cfg: ServeConfig,
+                 obs_scope: Optional[obs_metrics.ObsScope] = None):
         self.cfg = cfg
+        # Fleet workers get their OWN observability scope (isolated
+        # registry + flight recorder, writes chained to the fleet's run
+        # scope); a standalone server leaves this None and the module
+        # helpers resolve to the run scope exactly as before.  Every
+        # entry point below brackets itself in scope_active(), which is
+        # a transparent no-op for None.
+        self.obs_scope = obs_scope
         self._queue = AdmissionQueue(
             cfg.queue_depth,
             deadline_ordering=cfg.deadline_ordering,
@@ -70,6 +91,12 @@ class Server:
         self.slo = SloTracker(cfg.slo_target,
                               fast_window_s=cfg.slo_fast_window_s,
                               slow_window_s=cfg.slo_slow_window_s)
+        if obs_scope is not None:
+            obs_scope.slo = self.slo
+            if cfg.journal_dir:
+                # black-box dumps land next to the worker's journal —
+                # the one directory that survives this worker's death
+                obs_scope.dump_dir = cfg.journal_dir
         # Write-ahead journal: None unless configured — the disabled
         # request path must never touch the journal module (zero-cost
         # contract, locked by tests).
@@ -81,7 +108,8 @@ class Server:
         self.recovery: Dict[str, "Future[Response]"] = {}
         self.recovery_stats: Optional[Dict[str, int]] = None
         self._pool = WorkerPool(cfg, self._queue, self.cost_model,
-                                slo=self.slo, journal=self._journal)
+                                slo=self.slo, journal=self._journal,
+                                obs_scope=obs_scope)
         self._exit = contextlib.ExitStack()
         self._accepting = False
         self._started = False
@@ -92,6 +120,7 @@ class Server:
 
     # -- lifecycle ---------------------------------------------------------
 
+    @_scoped
     def start(self) -> "Server":
         if self._started:
             return self
@@ -114,6 +143,12 @@ class Server:
                 "slo_target": self.cfg.slo_target,
                 "journal": self.cfg.journal_dir,
             }}))
+        if self.obs_scope is None and self.cfg.journal_dir:
+            # standalone journaled server: the run scope's flight
+            # recorder dumps into this journal dir on a death path
+            scope = obs_metrics.current_scope()
+            if scope is not None and scope.dump_dir is None:
+                scope.dump_dir = self.cfg.journal_dir
         obs_metrics.inc(f"serve.cost_prior.{self.cost_prior_source}")
         obs_metrics.set_gauge("serve.queue_depth", 0)
         if self.cfg.warmup_sizes:
@@ -132,6 +167,7 @@ class Server:
         self._accepting = True
         return self
 
+    @_scoped
     def shutdown(self, drain: bool = True) -> None:
         if not self._started:
             return
@@ -151,6 +187,7 @@ class Server:
         self._started = False
         self._exit.close()
 
+    @_scoped
     def kill(self) -> None:
         """Non-graceful teardown — the drill-facing stand-in for process
         death.  Nothing is drained and no future is resolved: queued and
@@ -171,6 +208,7 @@ class Server:
 
     # -- recovery ----------------------------------------------------------
 
+    @_scoped
     def recover(self) -> Dict[str, int]:
         """Replay the journal: arm done-dedupe and the poison set, then
         re-enqueue every incomplete entry in original admit order.
@@ -251,6 +289,7 @@ class Server:
 
     # -- request path ------------------------------------------------------
 
+    @_scoped
     def submit(self, a: np.ndarray, ap: np.ndarray, b: np.ndarray,
                params: Optional[AnalogyParams] = None,
                deadline_s: Optional[float] = None,
@@ -353,6 +392,7 @@ class Server:
 
     # -- live telemetry ------------------------------------------------------
 
+    @_scoped
     def refresh_gauges(self) -> None:
         """Bring point-in-time gauges current before a /metrics scrape
         (event-driven gauges update themselves; these are sampled)."""
@@ -362,6 +402,7 @@ class Server:
         obs_metrics.set_gauge("serve.queue_depth", len(self._queue))
         self._pool.breaker.export_state()
 
+    @_scoped
     def health(self) -> Dict[str, Any]:
         """JSON-ready /healthz payload: liveness + the state an operator
         (or the future multi-host router) needs to route around trouble."""

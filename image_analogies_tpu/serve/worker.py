@@ -36,6 +36,7 @@ from typing import List, Optional
 
 from image_analogies_tpu import chaos
 from image_analogies_tpu.obs import metrics as obs_metrics
+from image_analogies_tpu.obs import recorder as obs_recorder
 from image_analogies_tpu.obs import trace as obs_trace
 from image_analogies_tpu.obs.slo import SloTracker
 from image_analogies_tpu.serve import batcher
@@ -55,9 +56,11 @@ from image_analogies_tpu.utils import failure
 class WorkerPool:
     def __init__(self, cfg: ServeConfig, queue: AdmissionQueue,
                  cost_model: Optional[serve_degrade.CostModel] = None,
-                 slo: Optional[SloTracker] = None, journal=None):
+                 slo: Optional[SloTracker] = None, journal=None,
+                 obs_scope=None):
         self._cfg = cfg
         self._queue = queue
+        self._obs_scope = obs_scope  # fleet worker's scope (None standalone)
         self._journal = journal  # write-ahead journal (None = disabled)
         self._cost = cost_model or serve_degrade.CostModel()
         self.breaker = CircuitBreaker(cfg.breaker_threshold,
@@ -93,6 +96,14 @@ class WorkerPool:
             t.join(None if end is None else max(0.0, end - time.monotonic()))
 
     def _loop(self) -> None:
+        # The whole loop runs under the pool's obs scope (no-op when
+        # standalone): every dispatch counter, span, and record this
+        # thread produces lands in the fleet worker's own registry and
+        # flight-recorder ring, chained up to the run's registry.
+        with obs_metrics.scope_active(self._obs_scope):
+            self._loop_scoped()
+
+    def _loop_scoped(self) -> None:
         while True:
             batch = self._queue.pop_batch(self._cfg.max_batch,
                                           self._cfg.batch_window_ms / 1e3)
@@ -109,6 +120,11 @@ class WorkerPool:
                 obs_metrics.inc("serve.process_deaths")
                 obs_trace.emit_record({"event": "serve_process_death",
                                        "batch_size": len(batch)})
+                # Black box out the door LAST, so the ring contains the
+                # death record itself; the sealed dump in the journal
+                # dir is what `ia blackbox` renders post-mortem.
+                obs_recorder.dump_current("process_death",
+                                          extra={"batch_size": len(batch)})
                 return
             except BaseException as exc:  # noqa: BLE001 - crash containment
                 self._contain_crash(batch, exc)

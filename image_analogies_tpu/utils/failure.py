@@ -28,6 +28,7 @@ import time
 from typing import Any, Callable, Optional
 
 from image_analogies_tpu.obs import metrics as obs_metrics
+from image_analogies_tpu.obs import recorder as obs_recorder
 from image_analogies_tpu.utils import logging as ialog
 
 # Synthetic-fault state (fault injection for tests/drills).
@@ -219,6 +220,12 @@ def run_with_watchdog(
             "timeout_s": timeout_s,
             **(context or {}),
         }, log_path)
+        # A wedge is exactly when post-mortem context matters: dump the
+        # current scope's flight ring (records the watchdog_timeout
+        # record just emitted) before surfacing the transient.
+        obs_recorder.dump_current("watchdog_timeout",
+                                  extra={"timeout_s": timeout_s,
+                                         **(context or {})})
         raise WatchdogTimeout(
             f"dispatch exceeded watchdog timeout {timeout_s:g}s "
             "(op presumed wedged; surfacing as transient)")

@@ -203,6 +203,78 @@ def test_kill_triggers_handoff_and_dedupe(tmp_path):
     assert np.array_equal(np.asarray(again.bp), np.asarray(resp[0].bp))
 
 
+# ------------------------------------------- fleet observability (PR 11)
+
+
+def test_fleet_healthz_obs_identity_and_federated_metrics(tmp_path):
+    """PR 11: each worker's /healthz entry names its ObsScope id and the
+    last health-loop scrape age; fleet /metrics is the federated view
+    (merged + worker-labeled, byte-consistent sums) and ``?worker=``
+    selects one worker's ISOLATED exposition."""
+    import re
+    import urllib.error
+    import urllib.request
+
+    from image_analogies_tpu.serve.http import serve_fleet_http
+
+    fcfg = _fleet_cfg(tmp_path)
+    load = drills.make_serve_load(3)
+    with Fleet(fcfg) as fl:
+        futs = [fl.submit(it["a"], it["ap"], it["b"]) for it in load]
+        for f in futs:
+            f.result(timeout=120)
+        time.sleep(4 * fcfg.health_interval_s)  # let the scrape loop run
+
+        health = fl.health()
+        for wid, wh in health["workers"].items():
+            obs = wh["obs"]
+            assert obs["scope"] == f"{wid}.g0"
+            assert obs["last_scrape_age_s"] >= 0.0
+            assert "stale_scope" not in obs
+
+        merged = fl.metrics_text()
+        solo = fl.metrics_text("w0")
+        assert fl.metrics_text("w9") is None
+        # isolated view: no worker labels, just w0's own registry
+        assert 'worker=' not in solo
+        # federated view: merged sample + one labeled sample per worker
+        # that admitted anything, summing exactly to the merged value
+        sample = re.compile(
+            r'^ia_serve_accepted_total(?:\{worker="(w\d)"\})? (\S+)$',
+            re.MULTILINE)
+        pairs = sample.findall(merged)
+        total = sum(float(v) for wid, v in pairs if not wid)
+        labeled = {wid: float(v) for wid, v in pairs if wid}
+        assert total == 3.0 and sum(labeled.values()) == total
+        # the fleet scope's own families ride along unlabeled
+        assert "ia_router_routed" in merged
+
+        # same bytes over HTTP, plus the 404 contract for unknown wids
+        httpd = serve_fleet_http(fl, port=0)
+        import threading
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            base = "http://127.0.0.1:{}".format(httpd.server_address[1])
+            with urllib.request.urlopen(base + "/metrics") as r:
+                assert 'worker="w0"' in r.read().decode()
+            with urllib.request.urlopen(base + "/metrics?worker=w0") as r:
+                body = r.read().decode()
+            assert "ia_serve_accepted_total" in body and "worker=" not in body
+            try:
+                urllib.request.urlopen(base + "/metrics?worker=nope")
+                raise AssertionError("unknown worker did not 404")
+            except urllib.error.HTTPError as exc:
+                assert exc.code == 404
+                assert json.loads(exc.read())["error"] == "unknown_worker"
+            with urllib.request.urlopen(base + "/healthz") as r:
+                hz = json.loads(r.read())
+            assert hz["workers"]["w0"]["obs"]["scope"] == "w0.g0"
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+
 # --------------------------------------------------------- CLI smoke
 
 

@@ -561,9 +561,181 @@ def test_live_and_slo_modules_are_jax_free():
     root = os.path.dirname(obs_pkg.__file__)
     forbidden = re.compile(r"\bjax\.jit\s*\(|\bpjit\s*\(|\bjax\.pmap\s*\(")
     toplevel_jax = re.compile(r"^(import jax|from jax)", re.MULTILINE)
-    for name in ("live.py", "slo.py", "metrics.py"):
+    for name in ("live.py", "slo.py", "metrics.py", "fleet.py",
+                 "recorder.py"):
         with open(os.path.join(root, name)) as f:
             src = f.read()
         assert not forbidden.findall(src), f"obs/{name} calls jit/pjit"
         assert not toplevel_jax.findall(src), (
             f"obs/{name} imports jax at module scope")
+
+
+def test_registry_resolution_is_scoped_only():
+    """Grep lock (PR 11 satellite): the legacy global-install surface is
+    gone — no module outside obs/metrics.py may reference a module-level
+    ``_REGISTRY`` or call a ``_install``-style hook.  Every call site
+    resolves metrics through the ambient ObsScope, so per-worker
+    isolation cannot be silently bypassed by a new global."""
+    import image_analogies_tpu as pkg
+
+    root = os.path.dirname(pkg.__file__)
+    forbidden = re.compile(r"_REGISTRY\b|\b_install\s*\(|\b_uninstall\s*\(")
+    scanned = set()
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            rel = os.path.relpath(os.path.join(dirpath, name), root)
+            if rel == os.path.join("obs", "metrics.py"):
+                continue
+            scanned.add(rel)
+            with open(os.path.join(dirpath, name)) as f:
+                src = f.read()
+            assert not forbidden.findall(src), (
+                f"{rel} references the deleted global-registry install "
+                "path; resolve through obs.metrics scopes instead")
+    # the scan must actually have covered the obs + serve planes — a
+    # package move must carry this lock with it
+    assert {os.path.join("obs", "trace.py"),
+            os.path.join("obs", "live.py"),
+            os.path.join("obs", "recorder.py"),
+            os.path.join("obs", "fleet.py"),
+            os.path.join("serve", "fleet.py"),
+            os.path.join("serve", "worker.py"),
+            "cli.py"} <= scanned
+
+
+# ------------------------------------------------ fleet federation (PR 11)
+
+
+def _two_worker_snapshots():
+    r0, r1 = obs_metrics.MetricsRegistry(), obs_metrics.MetricsRegistry()
+    r0.inc("serve.admitted", 3)
+    r1.inc("serve.admitted", 5)
+    r0.inc("only.w0", 2)
+    r0.set_gauge("serve.queue_depth", 1)
+    r1.set_gauge("serve.queue_depth", 4)
+    r0.set_gauge("hbm.peak_bytes.d0", 100)
+    r1.set_gauge("hbm.peak_bytes.d0", 700)
+    for v in (0.5, 3.0):
+        r0.observe("serve.latency_ms", v)
+    for v in (3.5, 9.0):
+        r1.observe("serve.latency_ms", v)
+    return {"w0": r0.snapshot(), "w1": r1.snapshot()}
+
+
+def test_render_fleet_labeled_series_sum_byte_consistent():
+    """Acceptance: every per-worker-labeled sample is byte-identical to
+    the worker's own isolated exposition, and labeled counter samples
+    sum exactly to the merged unlabeled sample."""
+    from image_analogies_tpu.obs import fleet as obs_fleet
+
+    by_worker = _two_worker_snapshots()
+    text = obs_fleet.render_fleet(by_worker)
+
+    # merged roll-up values
+    assert "ia_serve_admitted_total 8" in text
+    assert 'ia_serve_admitted_total{worker="w0"} 3' in text
+    assert 'ia_serve_admitted_total{worker="w1"} 5' in text
+    # a family only one worker has still merges (missing worker omitted)
+    assert "ia_only_w0_total 2" in text
+    assert 'ia_only_w0_total{worker="w1"}' not in text
+    # plain gauges sum; peak watermarks take the max
+    assert "ia_serve_queue_depth 5" in text
+    assert "ia_hbm_peak_bytes_d0 700" in text
+    # histograms merge bucketwise: counts add, cumulative stays monotone
+    assert "ia_serve_latency_ms_count 4" in text
+    assert 'ia_serve_latency_ms_bucket{le="4",worker="w0"} 2' in text
+
+    # byte-consistency: each labeled sample equals the worker's own
+    # render of the same family (same formatter, same value bytes)
+    sample = re.compile(r'^(\S+)\{worker="(w\d)"\} (\S+)$', re.MULTILINE)
+    solo = {wid: obs_live.render_prometheus(snap)
+            for wid, snap in by_worker.items()}
+    labeled = sample.findall(text)
+    assert labeled, "no worker-labeled samples rendered"
+    for pn, wid, value in labeled:
+        assert f"{pn} {value}\n" in solo[wid], (
+            f"{pn}{{worker={wid}}}={value} differs from {wid}'s own "
+            "exposition")
+    # and labeled counters sum to the merged sample exactly
+    merged_admitted = re.search(r"^ia_serve_admitted_total (\S+)$", text,
+                                re.MULTILINE).group(1)
+    parts = [float(v) for pn, _w, v in labeled
+             if pn == "ia_serve_admitted_total"]
+    assert float(merged_admitted) == sum(parts) == 8.0
+
+
+def test_snapshot_from_exposition_roundtrip():
+    """Transport-agnostic federation: a worker's /metrics text recovers
+    into a snapshot whose counters/gauges are lossless and whose
+    histograms rebuild the base-2 buckets from the cumulative samples."""
+    from image_analogies_tpu.obs import fleet as obs_fleet
+
+    reg = obs_metrics.MetricsRegistry()
+    reg.inc("serve.admitted", 7)
+    reg.inc("router.wire_bytes", 4096)
+    reg.set_gauge("serve.queue_depth", 3)
+    reg.set_gauge("slo.burn_rate.fast", 2.5)
+    for v in (0.5, 3.0, 3.5, 100.0):
+        reg.observe("serve.latency_ms", v)
+    snap = reg.snapshot()
+    text = obs_live.render_prometheus(snap)
+
+    back = obs_fleet.snapshot_from_exposition(text)
+    assert back["counters"] == {"serve.admitted": 7,
+                                "router.wire_bytes": 4096}
+    assert back["gauges"] == {"serve.queue_depth": 3,
+                              "slo.burn_rate.fast": 2.5}
+    h = back["histograms"]["serve.latency_ms"]
+    assert h["count"] == 4
+    assert h["sum"] == pytest.approx(107.0)
+    assert h["buckets"] == snap["histograms"]["serve.latency_ms"]["buckets"]
+    # merging a scraped snapshot == merging the in-process snapshot
+    merged = obs_fleet.merge_snapshots({"w0": snap, "w1": back})
+    assert merged["counters"]["serve.admitted"] == 14
+    assert merged["histograms"]["serve.latency_ms"]["count"] == 8
+    # worker-labeled lines in an already-federated view are skipped
+    fed = obs_fleet.render_fleet({"w0": snap})
+    refed = obs_fleet.snapshot_from_exposition(fed)
+    assert refed["counters"]["serve.admitted"] == 7
+
+
+def test_bench_check_gates_obs_overhead(tmp_path, capsys):
+    """PR 11 satellite: obs_overhead_pct rides the bench trajectory —
+    extract_headline propagates it and check_regression gates it in
+    absolute percentage points."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "ia_bench_obs_test", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    doc = {"parsed": {"value": 7.5, "metric": "1024x1024 north star",
+                      "obs_overhead_pct": 3.2, "host_gap_ms": 1.0}}
+    head = bench.extract_headline(doc)
+    assert head["obs_overhead_pct"] == 3.2
+
+    trajectory = {"points": [
+        {"value": 7.0, "metric_key": "1024x1024", "round": 1,
+         "file": "BENCH_r01.json", "obs_overhead_pct": 2.0},
+        {"value": 7.2, "metric_key": "1024x1024", "round": 2,
+         "file": "BENCH_r02.json", "obs_overhead_pct": 4.0},
+    ], "problems": []}
+    ok = bench.check_regression(trajectory, fresh_value=7.1,
+                                fresh_obs=5.0, threshold_pct=20.0)
+    assert ok["ok"] and ok["obs_overhead_pct"] == 5.0
+    assert ok["obs_overhead_floor"] == 2.0
+    assert ok["obs_overhead_delta_pts"] == 3.0
+    bad = bench.check_regression(trajectory, fresh_value=7.1,
+                                 fresh_obs=30.0, threshold_pct=20.0)
+    assert not bad["ok"]
+    assert any("obs_overhead_pct" in p for p in bad["problems"])
+    # archive self-check path reads the latest point's own overhead
+    latest = bench.check_regression(trajectory, threshold_pct=20.0)
+    assert latest["obs_overhead_pct"] == 4.0
+    assert latest["obs_overhead_floor"] == 2.0
