@@ -43,6 +43,15 @@ class LevelJob:
     # Video mode: previous frame's planes at this level (temporal term).
     a_temporal: Optional[np.ndarray] = None
     b_temporal: Optional[np.ndarray] = None
+    # Catalog resolution (catalog/tiers.CatalogRef), attached by the
+    # driver when the exemplar catalog is active.  `a_features.entry`
+    # holds this level's precomputed A-side features (a stored
+    # build_features_np output — bit-identical to a cold build by
+    # construction); entry=None asks the backend to build cold and
+    # record the result back through `a_features.record(...)`.  The CPU
+    # backend consumes it; the TPU backend ignores it (its A-side is
+    # fused on device and its HBM warmth is the devcache).
+    a_features: Optional[Any] = None
     # Buffer-donation consent, set by the DRIVER (it alone knows whether
     # anything else still reads this level's chained planes — retries,
     # keep_levels, checkpoints).  True lets the backend route this level

@@ -81,18 +81,42 @@ class CpuMatcher(Matcher):
 
     def _a_side(self, spec, job: LevelJob):
         use_ann = bool(self.params.use_ann and cKDTree is not None)
+        # Catalog tier hit: the driver already resolved this level's
+        # A-side (catalog/tiers.py — the stored bytes ARE a
+        # build_features_np output, so this is the same db a cold build
+        # would produce).  The KD-tree is consumer scratch parked on the
+        # entry, so a resident hit skips index construction too.
+        ref = job.a_features
+        if ref is not None and ref.entry is not None:
+            ent = ref.entry
+            tree = None
+            if use_ann:
+                tree = ent.state.get("tree")
+                if tree is None:
+                    tree = cKDTree(ent.db)
+                    ent.state["tree"] = tree
+            return ent.db, tree, ent.a_filt_flat
         key = _a_side_key(spec, job, use_ann)
         with self._a_memo_lock:
             hit = self._a_memo.get(key)
             if hit is not None:
                 self._a_memo.move_to_end(key)
                 return hit
+        t0 = time.perf_counter()
         db = build_features_np(
             spec, job.a_src, job.a_filt, job.a_src_coarse, job.a_filt_coarse,
             temporal_fine=job.a_temporal,
         )
         tree = cKDTree(db) if use_ann else None
         a_filt_flat = np.asarray(job.a_filt, np.float32).reshape(-1)
+        if ref is not None:
+            # cold build under an active catalog: fill every tier (and
+            # the sealed disk artifact) so the NEXT request for this
+            # style skips the build, then park the tree on the entry
+            ent = ref.record(db, a_filt_flat,
+                             build_ms=(time.perf_counter() - t0) * 1e3)
+            if tree is not None:
+                ent.state["tree"] = tree
         entry = (db, tree, a_filt_flat)
         with self._a_memo_lock:
             self._a_memo[key] = entry

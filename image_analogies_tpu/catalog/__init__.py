@@ -1,0 +1,30 @@
+"""catalog/ — content-addressed multi-tenant exemplar catalog (ROADMAP item 4).
+
+The Image Analogies engine treats the A/A' exemplar as a fixed ambient
+input, but at catalog scale ("millions of users", thousands of styles) a
+cold style pays the full per-level feature-pyramid build inside the
+request path.  This package makes any style warm-by-construction:
+
+- ``store``  — disk tier: per-style directories of sha256-sealed ``.npz``
+  feature artifacts (checkpoint-style seal/quarantine: damaged entries go
+  ``.corrupt``, never poison a load);
+- ``tiers``  — the memory tiers and the tier-by-tier resolution a request
+  walks: resident ("HBM") hit → host-RAM hit → disk load → full build,
+  every path returning bit-identical features to a cold build (an entry
+  IS a stored ``build_features_np`` output);
+- ``build``  — ahead-of-time ``ia catalog build``: precompute and persist
+  a style's per-level feature pyramid before traffic arrives.
+
+Keying: a style is the SAME exemplar sha1 the serve batcher/router
+already use (``serve.batcher.exemplar_digest``); one entry below it is a
+content digest over (per-level FeatureSpec, post-prep A-side planes) —
+with luminance remap on, the A planes depend on the target's stats, so
+the sub-key captures exactly what the features were built from.
+
+Like serve/ and chaos/, this package never imports jax at module scope
+and never compiles device programs (grep-locked): device work stays behind the
+backend boundary; the TPU backend's HBM residency is the devcache, which
+the resident tier fronts.
+"""
+
+from image_analogies_tpu.catalog import build, store, tiers  # noqa: F401

@@ -39,6 +39,17 @@ def image_params(*, levels: int = 2, retries: int = 3,
                          metrics=True)
 
 
+def catalog_params(catalog_dir: str, *, levels: int = 2):
+    """Catalog-tier drill config: tiny CPU engine with the exemplar
+    catalog rooted at ``catalog_dir``.  No retries — the devcache.tier
+    directive never raises; recovery is the tier fall-through itself."""
+    from image_analogies_tpu.config import AnalogyParams
+
+    return AnalogyParams(backend="cpu", levels=levels, patch_size=3,
+                         coarse_patch_size=3, level_retries=0,
+                         catalog_dir=catalog_dir, metrics=True)
+
+
 def run_image(a: np.ndarray, ap: np.ndarray, b: np.ndarray, params
               ) -> np.ndarray:
     """One engine synthesis; returns the host bp plane."""

@@ -4,6 +4,9 @@ Engine layers call ``site("name", **ctx)`` at their boundaries:
 
     level.dispatch    models/analogy.py   — per-level device dispatch
     devcache.upload   utils/devcache.py   — host→device upload (miss path)
+    devcache.tier     catalog/tiers.py    — per-level catalog tier
+                                            resolution ("corrupt" =
+                                            evict the key mid-request)
     ckpt.save         utils/checkpoint.py — checkpoint write
     ckpt.load         utils/checkpoint.py — checkpoint read
     serve.admit       serve/queue.py      — request admission

@@ -46,6 +46,8 @@ _KIND_NOTES = {
                    "replacement; spillover + dedupe answer exactly once",
     "batch_partial": "one lane faults mid-batch; the other lanes resolve "
                      "bit-identically",
+    "devcache_tier": "mid-request catalog tier eviction falls through to "
+                     "disk/rebuild bit-identically",
 }
 
 # What `selftest` (and the tier-1 parametrization) iterates: every raw
@@ -55,7 +57,8 @@ _KIND_NOTES = {
 # are drill names rather than members of FAULT_KINDS.
 def _drill_kinds():
     from image_analogies_tpu.chaos import FAULT_KINDS
-    return tuple(FAULT_KINDS) + ("fleet_death", "batch_partial")
+    return tuple(FAULT_KINDS) + ("fleet_death", "batch_partial",
+                                 "devcache_tier")
 
 
 DRILL_KINDS = _drill_kinds()
@@ -104,6 +107,16 @@ def plan_for_kind(kind: str, seed: int = 0) -> ChaosPlan:
                                             schedule=(7,))),
                  ("router.forward", SiteRule(kind="transient",
                                              schedule=(4,))))
+    elif kind == "devcache_tier":
+        # Catalog-tier drill geometry (2 levels, warmed catalog): the
+        # devcache.tier site is visited once per level's tier
+        # resolution, coarsest level first — firing at BOTH visits
+        # evicts each level's warmed entry from the memory tiers the
+        # instant the request asks for it, so every level of the armed
+        # run must recover through the sealed disk artifact (or a full
+        # rebuild) and still produce the clean run's exact bytes.
+        sites = (("devcache.tier", SiteRule(kind="corrupt",
+                                            schedule=(0, 1))),)
     elif kind == "batch_partial":
         # Batched-engine drill geometry (k=3 lanes, 2 levels): the
         # engine.batch site is visited once per (level, lane), coarsest
@@ -152,7 +165,7 @@ def _reconcile(plan: ChaosPlan, counters: Dict[str, float]) -> List[str]:
     # raising kind at a serve batch boundary is contained as a crash
     # regardless of its class — the containment layer can't tell.
     retries = watchdogs = quarantines = crashes = deaths = 0.0
-    hop_faults = lane_faults = 0.0
+    hop_faults = lane_faults = tier_evictions = 0.0
     for name, rule in plan.sites:
         n = counters.get(f"chaos.site.{name}", 0)
         if not n:
@@ -164,6 +177,13 @@ def _reconcile(plan: ChaosPlan, counters: Dict[str, float]) -> List[str]:
             # marks the member failed and finishes the other lanes; the
             # only matching evidence is its lane-fault counter
             lane_faults += n
+        elif name == "devcache.tier":
+            # the "corrupt" directive here is applied as a mid-request
+            # memory-tier eviction (NOT file damage): recovery is the
+            # tier fall-through, evidenced by the catalog's eviction
+            # counter — must be matched before the generic corrupt →
+            # ckpt.quarantined accounting below
+            tier_evictions += n
         elif rule.kind == "process_death":
             # not contained: the worker thread dies; the only matching
             # evidence is the death counter (recovery is the journal's)
@@ -199,6 +219,8 @@ def _reconcile(plan: ChaosPlan, counters: Dict[str, float]) -> List[str]:
         want("router.hop_faults", hop_faults)
     if lane_faults:
         want("batch.lane_faults", lane_faults)
+    if tier_evictions:
+        want("catalog.chaos_evictions", tier_evictions)
     return problems
 
 
@@ -252,6 +274,65 @@ def drill_image(plan: ChaosPlan, *, seed: int = 7,
         "counters": {k: v for k, v in counters.items()
                      if k.startswith(("chaos.", "level_retry", "retry.",
                                       "watchdog.", "ckpt."))},
+        "identical": identical,
+        "ok": not problems,
+        "problems": problems,
+    }
+
+
+def drill_catalog_tier(plan: ChaosPlan, *, seed: int = 7,
+                       size=(20, 20), workdir: Optional[str] = None
+                       ) -> Dict[str, Any]:
+    """Catalog-tier eviction drill: clean run (no catalog) → warm run
+    (disarmed, populates every tier + the sealed disk artifacts) →
+    armed run whose ``devcache.tier`` directives evict the warmed
+    entries MID-REQUEST.  Invariants: the armed run falls through the
+    remaining tiers (disk hit or full rebuild) and produces the clean
+    run's exact bytes, and every injection reconciles against
+    ``catalog.chaos_evictions``."""
+    from image_analogies_tpu.catalog import tiers as catalog_tiers
+    from image_analogies_tpu.obs import trace as obs_trace
+
+    a, ap, b = drills.make_inputs(size, seed)
+    clean = drills.run_image(a, ap, b, drills.image_params(retries=0))
+
+    catalog_tiers.clear()
+    try:
+        with tempfile.TemporaryDirectory(dir=workdir) as tmp:
+            params = drills.catalog_params(os.path.join(tmp, "catalog"))
+            with obs_trace.run_scope(params) as ctx:
+                warm_bp = drills.run_image(a, ap, b, params)
+                with inject.plan_scope(plan):
+                    chaos_bp = drills.run_image(a, ap, b, params)
+                    snap = inject.snapshot()
+                counters = _counters(ctx)
+    finally:
+        catalog_tiers.clear()
+        catalog_tiers.configure(None)
+
+    identical = bool(np.array_equal(clean, warm_bp)
+                     and np.array_equal(clean, chaos_bp))
+    problems = [] if identical else ["output differs from clean run"]
+    problems += _reconcile(plan, counters)
+    if not counters.get("catalog.builds", 0):
+        problems.append("warm run recorded no catalog builds")
+    evicted = counters.get("catalog.chaos_evictions", 0)
+    recovered = (counters.get("catalog.disk.hits", 0)
+                 + counters.get("catalog.builds", 0))
+    if evicted and recovered < evicted:
+        problems.append(
+            f"{evicted} evictions but only {recovered} disk-hit/rebuild "
+            "recoveries (a hit survived the eviction it should not have)")
+    injected = sum(st["injected"] for st in snap.values())
+    if injected == 0:
+        problems.append("plan injected nothing (dead drill)")
+    return {
+        "workload": "catalog_tier",
+        "plan": plan.to_dict(),
+        "injected": injected,
+        "sites": snap,
+        "counters": {k: v for k, v in counters.items()
+                     if k.startswith(("chaos.", "catalog."))},
         "identical": identical,
         "ok": not problems,
         "problems": problems,
@@ -678,6 +759,8 @@ def drill_batch_partial(plan: ChaosPlan, *, k: int = 3, seed: int = 7
 
 def run_drill(plan: ChaosPlan, **kw) -> Dict[str, Any]:
     """Dispatch a plan to the workload its sites target."""
+    if any(name == "devcache.tier" for name, _ in plan.sites):
+        return drill_catalog_tier(plan, **kw)
     if any(name == "engine.batch" for name, _ in plan.sites):
         return drill_batch_partial(plan, **kw)
     if any(name == "router.forward" for name, _ in plan.sites):

@@ -131,6 +131,14 @@ def _add_engine_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--devcache-bytes", type=int, default=None,
                    help="device-upload cache byte budget "
                         "(utils/devcache.py; IA_DEVCACHE_BYTES overrides)")
+    p.add_argument("--catalog-dir", default=None, metavar="DIR",
+                   help="exemplar catalog root (catalog/): precomputed "
+                        "per-level feature pyramids resolve tier-by-tier "
+                        "(HBM -> host RAM -> disk) before any cold build; "
+                        "IA_CATALOG_DIR overrides")
+    p.add_argument("--catalog-host-bytes", type=int, default=None,
+                   help="host-RAM catalog tier byte budget "
+                        "(IA_CATALOG_HOST_BYTES overrides; default 256 MiB)")
     p.add_argument("--coordinator", default=None,
                    help="multi-host: coordinator address host:port "
                         "(jax.distributed); see parallel/distributed.py")
@@ -153,6 +161,10 @@ def _params_from_args(args, base: AnalogyParams) -> AnalogyParams:
         kw["shape_buckets"] = True
     if args.devcache_bytes is not None:
         kw["devcache_max_bytes"] = args.devcache_bytes
+    if getattr(args, "catalog_dir", None) is not None:
+        kw["catalog_dir"] = args.catalog_dir
+    if getattr(args, "catalog_host_bytes", None) is not None:
+        kw["catalog_host_bytes"] = args.catalog_host_bytes
     if args.patch_size is not None:
         kw["patch_size"] = args.patch_size
     if args.coarse_patch_size is not None:
@@ -596,6 +608,79 @@ def cmd_blackbox(args) -> int:
     return 0
 
 
+def cmd_catalog(args) -> int:
+    """Exemplar catalog tooling (catalog/).  ``build`` precomputes one
+    style's per-level feature pyramids and seals them under the catalog
+    root; ``inspect`` is a read-only summary of the on-disk store;
+    ``warm`` pre-stages entries into this process's host-RAM tier (the
+    fleet-join prefetch, runnable by hand); ``gc`` prunes tmp litter,
+    quarantined entries, and over-budget bytes."""
+    from image_analogies_tpu.catalog import build as catalog_build
+    from image_analogies_tpu.catalog import store as catalog_store
+    from image_analogies_tpu.catalog import tiers as catalog_tiers
+
+    if args.action == "build":
+        a = load_image(args.a)
+        ap = load_image(args.ap)
+        target = load_image(args.b) if args.b else None
+        base = PRESETS["oil_filter"].replace(backend="cpu")
+        kw = {}
+        for name in ("levels", "kappa", "patch_size", "coarse_patch_size"):
+            v = getattr(args, name)
+            if v is not None:
+                kw[name] = v
+        if args.no_remap:
+            kw["remap_luminance"] = False
+        rep = catalog_build.build_style(a, ap, base.replace(**kw),
+                                        root_dir=args.dir, target=target)
+        print(json.dumps(rep, sort_keys=True))
+        return 0
+
+    if not os.path.isdir(args.dir):
+        print(f"catalog: no such directory {args.dir}", file=sys.stderr)
+        return 2
+
+    if args.action == "inspect":
+        info = catalog_store.stats(args.dir)
+        if args.json:
+            print(json.dumps(info, indent=2, sort_keys=True))
+        else:
+            print(f"catalog {args.dir}: {len(info['styles'])} style(s), "
+                  f"{info['entries']} entries, "
+                  f"{info['bytes']} bytes"
+                  + (f", {info['corrupt']} quarantined"
+                     if info["corrupt"] else ""))
+            for style in catalog_store.list_styles(args.dir):
+                ents = catalog_store.list_entries(args.dir, style)
+                print(f"  {style}  {len(ents)} entries / "
+                      f"{sum(n for _, n in ents)} bytes")
+        return 0
+
+    if args.action == "warm":
+        styles = ([args.style] if args.style
+                  else catalog_store.list_styles(args.dir))
+        total = {"styles": 0, "entries": 0, "bytes": 0}
+        for style in styles:
+            rep = catalog_tiers.warm(style, root_dir=args.dir)
+            if rep["entries"]:
+                total["styles"] += 1
+                total["entries"] += rep["entries"]
+                total["bytes"] += rep["bytes"]
+        print(json.dumps(total, sort_keys=True))
+        return 0
+
+    if args.action == "gc":
+        keep = set(args.keep.split(",")) if args.keep else None
+        rep = catalog_store.gc(args.dir, keep=keep,
+                               max_bytes=args.max_bytes,
+                               purge_corrupt=args.purge_corrupt)
+        print(json.dumps(rep, sort_keys=True))
+        return 0
+
+    print(f"catalog: unknown action {args.action}", file=sys.stderr)
+    return 2
+
+
 def cmd_metrics(args) -> int:
     """Prometheus exposition of a run log's latest metrics snapshot
     (obs/live.py).  Without --port, render once to stdout.  With --port,
@@ -677,6 +762,7 @@ def cmd_bench(args) -> int:
     fresh = None
     fresh_gap = None
     fresh_obs = None
+    fresh_cold = None
     fresh_key = args.metric_key
     if args.value is not None:
         fresh = args.value
@@ -694,6 +780,8 @@ def cmd_bench(args) -> int:
                 fresh_gap = float(doc["host_gap_ms"])
             if doc.get("obs_overhead_pct") is not None:
                 fresh_obs = float(doc["obs_overhead_pct"])
+            if doc.get("cold_start_ms") is not None:
+                fresh_cold = float(doc["cold_start_ms"])
         else:
             head = bench.extract_headline(doc if isinstance(doc, dict)
                                           else {})
@@ -704,13 +792,15 @@ def cmd_bench(args) -> int:
             fresh = head["value"]
             fresh_gap = head.get("host_gap_ms")
             fresh_obs = head.get("obs_overhead_pct")
+            fresh_cold = head.get("cold_start_ms")
             if fresh_key is None:
                 fresh_key = head.get("metric_key")
     verdict = bench.check_regression(trajectory, fresh_value=fresh,
                                      threshold_pct=args.threshold,
                                      fresh_gap=fresh_gap,
                                      fresh_key=fresh_key,
-                                     fresh_obs=fresh_obs)
+                                     fresh_obs=fresh_obs,
+                                     fresh_cold=fresh_cold)
     print(json.dumps(verdict, sort_keys=True))
     for problem in verdict.get("problems", []):
         print(f"bench: warning: {problem}", file=sys.stderr)
@@ -1005,7 +1095,8 @@ def build_parser() -> argparse.ArgumentParser:
     ch.add_argument("--selftest", action="store_true",
                     help="one canonical drill per kind "
                          "(transient, oom, latency, corrupt, crash, "
-                         "process_death, fleet_death) plus the "
+                         "process_death, fleet_death, batch_partial, "
+                         "devcache_tier) plus the "
                          "same-seed schedule-determinism check")
     ch.add_argument("--kinds", default=None,
                     help="comma-separated fault-kind subset for "
@@ -1016,6 +1107,63 @@ def build_parser() -> argparse.ArgumentParser:
                     help="also print the full machine-readable report "
                          "to stderr")
     ch.set_defaults(fn=cmd_chaos)
+
+    # catalog takes NO engine flags (so it skips the distributed-init
+    # gate): build runs the CPU feature path, the rest is pure file io.
+    ct = sub.add_parser("catalog",
+                        help="exemplar catalog tooling: precompute a "
+                             "style's sealed per-level feature pyramids "
+                             "(build), summarize the store (inspect), "
+                             "pre-stage entries into host RAM (warm), or "
+                             "prune it (gc)")
+    ct_sub = ct.add_subparsers(dest="action", required=True)
+    cb = ct_sub.add_parser("build",
+                           help="precompute + seal one style's per-level "
+                                "features under the catalog root")
+    cb.add_argument("--a", required=True, help="unfiltered source A")
+    cb.add_argument("--ap", required=True, help="filtered source A'")
+    cb.add_argument("--b", default=None,
+                    help="remap anchor target: with luminance remap on, "
+                         "A's planes depend on the target's luminance "
+                         "stats — pass the (first) target so the sealed "
+                         "entries match its requests (omit to anchor on "
+                         "A itself)")
+    cb.add_argument("--dir", required=True, help="catalog root directory")
+    cb.add_argument("--levels", type=int, default=None)
+    cb.add_argument("--kappa", type=float, default=None)
+    cb.add_argument("--patch-size", type=int, default=None)
+    cb.add_argument("--coarse-patch-size", type=int, default=None)
+    cb.add_argument("--no-remap", action="store_true",
+                    help="disable luminance remapping")
+    cb.set_defaults(fn=cmd_catalog)
+    ci = ct_sub.add_parser("inspect",
+                           help="read-only store summary: styles, "
+                                "entries, bytes, quarantined files")
+    ci.add_argument("dir", help="catalog root directory")
+    ci.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ci.set_defaults(fn=cmd_catalog)
+    cw = ct_sub.add_parser("warm",
+                           help="pre-stage sealed entries into this "
+                                "process's host-RAM tier (the fleet-join "
+                                "prefetch, runnable by hand)")
+    cw.add_argument("dir", help="catalog root directory")
+    cw.add_argument("--style", default=None,
+                    help="warm one style (default: every style on disk)")
+    cw.set_defaults(fn=cmd_catalog)
+    cg = ct_sub.add_parser("gc",
+                           help="prune the disk tier: tmp litter always, "
+                                "quarantined files with --purge-corrupt, "
+                                "oldest entries past --max-bytes")
+    cg.add_argument("dir", help="catalog root directory")
+    cg.add_argument("--max-bytes", type=int, default=None,
+                    help="prune oldest-first until the store fits")
+    cg.add_argument("--keep", default=None,
+                    help="comma-separated styles exempt from pruning")
+    cg.add_argument("--purge-corrupt", action="store_true",
+                    help="also remove quarantined .corrupt files "
+                         "(they are evidence; default keeps them)")
+    cg.set_defaults(fn=cmd_catalog)
 
     jr = sub.add_parser("journal",
                         help="write-ahead request journal tooling: "

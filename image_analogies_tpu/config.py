@@ -216,6 +216,17 @@ class AnalogyParams:
     # 1 GiB default, env IA_DEVCACHE_BYTES overrides.
     devcache_max_bytes: Optional[int] = None
 
+    # catalog/ subsystem (ROADMAP item 4): content-addressed exemplar
+    # catalog root.  When set (or env IA_CATALOG_DIR), the driver
+    # resolves each level's A-side features tier-by-tier (resident →
+    # host RAM → sealed disk artifact → cold build) instead of always
+    # building in the request path; every tier serves bit-identical
+    # bytes to a cold build.  None disables catalog consultation.
+    catalog_dir: Optional[str] = None
+    # Host-RAM tier byte budget; None keeps the 256 MiB default, env
+    # IA_CATALOG_HOST_BYTES overrides.
+    catalog_host_bytes: Optional[int] = None
+
     # Async pipelined engine (perf PR 8).
     # Host/device overlap: while level d's program is in flight, a helper
     # thread warms level d-1's host-side inputs (devcache uploads, the
@@ -297,6 +308,11 @@ class AnalogyParams:
             raise ValueError(
                 "devcache_max_bytes must be positive when set, got "
                 f"{self.devcache_max_bytes}")
+        if (self.catalog_host_bytes is not None
+                and self.catalog_host_bytes < 1):
+            raise ValueError(
+                "catalog_host_bytes must be positive when set, got "
+                f"{self.catalog_host_bytes}")
         if self.bf16_scoring and self.backend != "tpu":
             raise ValueError(
                 "bf16_scoring applies to the TPU wavefront scan; "

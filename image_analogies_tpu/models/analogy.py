@@ -18,6 +18,7 @@ import numpy as np
 from image_analogies_tpu import chaos
 from image_analogies_tpu.backends import get_backend
 from image_analogies_tpu.backends.base import LevelJob
+from image_analogies_tpu.catalog import tiers as catalog_tiers
 from image_analogies_tpu.config import AnalogyParams
 from image_analogies_tpu.obs import device as obs_device
 from image_analogies_tpu.obs import metrics as obs_metrics
@@ -183,6 +184,14 @@ def _create_image_analogy(a, ap, b, params, backend, temporal_prev,
             "and exists only for strategy='wavefront'/'auto'; for video "
             "frame sharding use models.video.video_analogy")
     backend = backend or get_backend(params)
+    # Exemplar catalog (catalog/): consulted per level BEFORE
+    # build_features.  The style key is the raw exemplar bytes — the
+    # same sha1 the serve batcher/router use — computed once per run.
+    # CPU/oracle path only: the TPU backend's A-side is fused on device
+    # and its HBM warmth is the devcache, so it ignores a_features.
+    catalog_style = None
+    if params.backend == "cpu" and catalog_tiers.active():
+        catalog_style = catalog_tiers.style_key(a, ap)
     a_src, b_src, a_filt, ap_rgb, b_yiq = _prep_planes(
         a, ap, b, params, remap_anchor=remap_anchor)
 
@@ -304,6 +313,13 @@ def _create_image_analogy(a, ap, b, params, backend, temporal_prev,
                                     if temporal else None),
                         donate=donate_levels,
                     )
+                    if catalog_style is not None:
+                        # tier-by-tier A-side resolution (resident →
+                        # host → disk); a full miss leaves entry=None
+                        # and the backend builds cold, recording back
+                        # through the ref so every tier above fills
+                        job.a_features = catalog_tiers.lookup(
+                            catalog_style, job)
                     t0 = time.perf_counter()
                     if gap_t0 is not None:
                         timing["host_gap_ms"] += (t0 - gap_t0) * 1e3

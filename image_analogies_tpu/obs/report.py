@@ -221,6 +221,56 @@ def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                                 for k, v in sorted(batch_hist.items())},
         }
 
+    # --- catalog section (catalog.* counters + prefetch records) ----------
+    # The exemplar catalog's tier ledger: per-tier hit/miss funnel
+    # (HBM -> host -> disk -> cold build), quarantine + chaos-eviction
+    # accounting, and the ring-placement prefetch summary.
+    prefetch_recs = [r for r in records
+                     if r.get("event") == "catalog_prefetch"]
+    hists: Dict[str, Any] = {}
+    if run_end:
+        hists.update((run_end.get("metrics") or {}).get("histograms", {}))
+    catalog_info: Optional[Dict[str, Any]] = None
+    if prefetch_recs or any(k.startswith("catalog.") for k in counters):
+        def _tier(name):
+            h = int(counters.get(f"catalog.{name}.hits", 0))
+            m = int(counters.get(f"catalog.{name}.misses", 0))
+            return {"hits": h, "misses": m,
+                    "hit_rate": (h / (h + m)) if (h + m) else None}
+
+        cold = hists.get("catalog.cold_start_ms") or {}
+        catalog_info = {
+            "hbm": _tier("hbm"),
+            "host": _tier("host"),
+            "disk": _tier("disk"),
+            "builds": int(counters.get("catalog.builds", 0)),
+            "build_ms": {k: cold[k] for k in
+                         ("count", "min", "max", "mean") if k in cold},
+            "quarantined": int(counters.get("catalog.quarantined", 0)),
+            "chaos_evictions": int(counters.get("catalog.chaos_evictions",
+                                                0)),
+            "host_evictions": int(counters.get("catalog.host.evictions",
+                                               0)),
+            "host_evicted_bytes": int(counters.get(
+                "catalog.host.evicted_bytes", 0)),
+            "disk_read_bytes": int(counters.get("catalog.disk.read_bytes",
+                                                0)),
+            "disk_write_bytes": int(counters.get("catalog.disk.write_bytes",
+                                                 0)),
+            "warmed": int(counters.get("catalog.warmed", 0)),
+            "prefetch_styles": int(counters.get("catalog.prefetch.styles",
+                                                0)),
+            "prefetch_bytes": int(counters.get("catalog.prefetch.bytes",
+                                               0)),
+            "host_bytes": float(((run_end or {}).get("metrics") or {})
+                                .get("gauges", {})
+                                .get("catalog.host.bytes", 0.0)),
+            # each fleet-join prefetch placement, in order
+            "prefetch_events": [
+                {k: r[k] for k in ("style", "worker", "entries", "bytes")
+                 if k in r} for r in prefetch_recs],
+        }
+
     # --- fleet section (router.* counters + router_* records) -------------
     handoff_recs = [r for r in records
                     if r.get("event") == "router_handoff"]
@@ -397,6 +447,7 @@ def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "pipeline": pipeline_info,
         "serve": serve_info,
         "batch": batch_info,
+        "catalog": catalog_info,
         "router": router_info,
         "slo": slo_info,
         "journal": journal_info,
@@ -467,7 +518,7 @@ def render(an: Dict[str, Any], run_id: Optional[str] = None) -> str:
             if k not in shown and v
             and not k.startswith(("serve.", "chaos.", "watchdog.",
                                   "ckpt.", "retry.", "pipeline.",
-                                  "router.", "batch."))}
+                                  "router.", "batch.", "catalog."))}
     for k in sorted(rest):
         w(f"    {k:<13} {rest[k]:g}")
 
@@ -566,6 +617,42 @@ def render(an: Dict[str, Any], run_id: Optional[str] = None) -> str:
             fb = ", ".join(f"{k}x{v}" for k, v in
                            sorted(be["fallbacks"].items()))
             w(f"    fallbacks     {fb}  (reason x count)")
+
+    cat = an.get("catalog")
+    if cat:
+        w("  catalog:")
+
+        def _tier_line(label, t):
+            rate = (f" (hit rate {100 * t['hit_rate']:.1f}%)"
+                    if t["hit_rate"] is not None else "")
+            w(f"    {label:<13} {t['hits']} hits / {t['misses']} misses"
+              + rate)
+
+        _tier_line("hbm tier", cat["hbm"])
+        _tier_line("host tier", cat["host"])
+        _tier_line("disk tier", cat["disk"])
+        bm = cat["build_ms"]
+        w(f"    cold builds   {cat['builds']}"
+          + (f" ({bm['mean']:.1f} ms mean / {bm['max']:.1f} ms max)"
+             if bm.get("count") else ""))
+        if cat["host_bytes"] or cat["host_evictions"]:
+            w(f"    host tier     {_fmt_bytes(cat['host_bytes'])} resident, "
+              f"{cat['host_evictions']} evictions "
+              f"({_fmt_bytes(cat['host_evicted_bytes'])})")
+        if cat["disk_read_bytes"] or cat["disk_write_bytes"]:
+            w(f"    disk io       {_fmt_bytes(cat['disk_read_bytes'])} "
+              f"read / {_fmt_bytes(cat['disk_write_bytes'])} written")
+        if cat["quarantined"] or cat["chaos_evictions"]:
+            w(f"    integrity     {cat['quarantined']} entries quarantined, "
+              f"{cat['chaos_evictions']} chaos tier evictions")
+        if cat["warmed"] or cat["prefetch_styles"]:
+            w(f"    prefetch      {cat['warmed']} entries warmed, "
+              f"{cat['prefetch_styles']} styles placed "
+              f"({_fmt_bytes(cat['prefetch_bytes'])})")
+        for pf in cat["prefetch_events"]:
+            w(f"    placed        {pf.get('style', '?')} -> "
+              f"{pf.get('worker', '?')} ({pf.get('entries', 0)} entries, "
+              f"{_fmt_bytes(pf.get('bytes', 0))})")
 
     rt = an.get("router")
     if rt:

@@ -196,6 +196,16 @@ class Fleet:
             wid = "w{}".format(i)
             self._spawn(wid, generation=0)
             self.router.ring.add(wid)
+        # Catalog prefetch (ROADMAP item 4): with a catalog root
+        # configured (env IA_CATALOG_DIR — the fleet-operator path),
+        # pre-stage each style's sealed entries into host RAM now that
+        # the ring knows every style's home worker, so the first request
+        # for a cataloged style finds warm tiers instead of paying the
+        # disk load (or the full build) inside the request path.
+        from image_analogies_tpu.catalog import tiers as catalog_tiers
+
+        if catalog_tiers.active():
+            catalog_tiers.warm_for_fleet(self.router)
         self._health_thread = threading.Thread(
             target=self._health_loop, name="fleet-health", daemon=True)
         self._health_thread.start()

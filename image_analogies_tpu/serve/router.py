@@ -170,6 +170,21 @@ class Router:
         self._chain(src, ent)
         return fut
 
+    def home_for_style(self, exemplar_hash: str) -> Optional[str]:
+        """Home worker for a STYLE (exemplar sha1), without a batch key.
+
+        Catalog prefetch and operators ask "which worker owns this
+        style" before any traffic exists — there is no params digest or
+        target shape yet, so this keys the ring on the exemplar hash
+        alone (style-grain placement).  Request routing stays at
+        batch-key grain (`submit`), but both walk the SAME ring, so
+        membership changes move prefetch placement and traffic
+        consistently.  Health gates are ignored on purpose: placement
+        answers ownership, not this-instant dispatchability.  None when
+        the ring is empty."""
+        order = self.ring.successors(exemplar_hash)
+        return order[0] if order else None
+
     def _route(self, kstr: str, idem: str, payload: Tuple[Any, ...],
                deadline_s: Optional[float]
                ) -> Tuple[str, "Future[Response]"]:

@@ -49,13 +49,23 @@ def maybe_enable_compile_cache(params: Any = None) -> Optional[str]:
 
 
 def apply_runtime_config(params: Any = None) -> None:
-    """Per-run runtime wiring: compile cache + devcache byte budget."""
+    """Per-run runtime wiring: compile cache + devcache byte budget +
+    exemplar-catalog root/budget."""
     maybe_enable_compile_cache(params)
     from image_analogies_tpu.utils import devcache
 
     mb = getattr(params, "devcache_max_bytes", None)
     if mb:
         devcache.set_max_bytes(int(mb))
+    # Catalog wiring is unconditional so each run's params decide
+    # activation (None clears a previous run's root); env IA_CATALOG_DIR
+    # still wins inside catalog.tiers.root() — the fleet-operator path.
+    # The tiers themselves persist across runs (that is the warmth).
+    from image_analogies_tpu.catalog import tiers as catalog_tiers
+
+    catalog_tiers.configure(
+        root_dir=getattr(params, "catalog_dir", None),
+        host_bytes=getattr(params, "catalog_host_bytes", None))
 
 
 def warmup(params: Any, height: int, width: int, *,

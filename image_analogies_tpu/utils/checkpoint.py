@@ -40,7 +40,11 @@ def run_digest(params, a_shape, b_shape) -> str:
         if k not in ("checkpoint_dir", "resume_from_level", "profile_dir",
                      "log_path", "db_shards", "data_shards", "level_retries",
                      "save_levels_dir", "level_sync", "metrics",
-                     "dispatch_timeout_s")),
+                     "dispatch_timeout_s",
+                     # catalog tiering serves bit-identical features at
+                     # every tier, so wiring it on/off never changes the
+                     # bp/s planes — those checkpoints stay resumable
+                     "catalog_dir", "catalog_host_bytes")),
         tuple(a_shape), tuple(b_shape)))
     return hashlib.sha256(payload.encode()).hexdigest()[:16]
 

@@ -83,8 +83,13 @@ def device_put_cached(x, dtype=None):
     global _bytes
     h = hashlib.sha1(arr.tobytes()).hexdigest()
     key = (h, arr.shape, str(arr.dtype), str(jax.default_backend()))
-    hit = _cache.get(key)
-    if hit is not None:
+    # Entries carry their upload size so eviction releases EXACTLY the
+    # bytes the insert charged (recomputing from the device array could
+    # silently fail and drift the gauge) and the per-entry churn is
+    # reportable (devcache.evicted_bytes — the catalog tier report).
+    hit_entry = _cache.get(key)
+    if hit_entry is not None:
+        hit, hit_nbytes = hit_entry
         deleted = True
         try:
             deleted = hit.is_deleted()
@@ -94,23 +99,22 @@ def device_put_cached(x, dtype=None):
             _cache.move_to_end(key)
             obs_metrics.inc("devcache.hits")
             return hit
-        _bytes -= arr.nbytes
+        _bytes -= hit_nbytes
         _cache.pop(key, None)
         obs_metrics.inc("devcache.dead_evictions")
+        obs_metrics.inc("devcache.evicted_bytes", hit_nbytes)
         obs_metrics.set_gauge("devcache.bytes", _bytes)
     chaos.site("devcache.upload", nbytes=arr.nbytes)
     dev = jax.device_put(jnp.asarray(arr))
-    _cache[key] = dev
+    _cache[key] = (dev, arr.nbytes)
     _bytes += arr.nbytes
     obs_metrics.inc("devcache.misses")
     obs_metrics.inc("devcache.upload_bytes", arr.nbytes)
     limit = max_bytes()
     while _bytes > limit and _cache:
-        _, old = _cache.popitem(last=False)
+        _, (_, old_nbytes) = _cache.popitem(last=False)
+        _bytes -= old_nbytes
         obs_metrics.inc("devcache.evictions")
-        try:
-            _bytes -= int(np.prod(old.shape)) * old.dtype.itemsize
-        except Exception:  # pragma: no cover
-            pass
+        obs_metrics.inc("devcache.evicted_bytes", old_nbytes)
     obs_metrics.set_gauge("devcache.bytes", _bytes)
     return dev

@@ -1,0 +1,498 @@
+"""catalog/ — content-addressed exemplar catalog with tiered resolution.
+
+Tier-1 invariants locked here:
+
+- bit-identity at EVERY tier: a request served from the resident
+  ("HBM") tier, the host-RAM tier, or a sealed disk artifact produces
+  exactly the bytes of a cold build (each tier asserted separately);
+- the second request for a cataloged style skips the feature build
+  entirely — proven by counters (``catalog.builds`` absent,
+  ``catalog.hbm.hits`` == levels), not by timing;
+- damage never poisons a load: a flipped byte or a torn tail
+  quarantines the entry as ``.corrupt`` and the request rebuilds
+  bit-identically (same contract — and the same assertion shapes — as
+  tests/test_journal.py's segment-damage tests);
+- prefetch is ring-placement-aware: ``warm_for_fleet`` consults
+  ``Router.home_for_style`` and stages styles into host RAM, and a real
+  fleet join pre-stages a cataloged style before traffic;
+- ``ia bench``'s ``cold_start_ms`` methodology holds at toy scale and
+  its trajectory gate has the legacy no-floor path;
+- catalog/ is host-side only: no module-scope jax, no jit (grep lock,
+  same regexes as serve's).
+"""
+
+import json
+import os
+import re
+
+import numpy as np
+import pytest
+
+import bench
+from image_analogies_tpu import cli
+from image_analogies_tpu.catalog import build as catalog_build
+from image_analogies_tpu.catalog import store as catalog_store
+from image_analogies_tpu.catalog import tiers
+from image_analogies_tpu.chaos import inject
+from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.models.analogy import create_image_analogy
+from image_analogies_tpu.obs import trace as obs_trace
+from image_analogies_tpu.utils.imageio import save_image
+
+
+@pytest.fixture(autouse=True)
+def _clean_catalog_state():
+    """Memory tiers are module-global by design (cross-request warmth);
+    tests must never leak entries or a configured root into the suite."""
+    tiers.clear()
+    tiers.configure(None)
+    yield
+    tiers.clear()
+    tiers.configure(None)
+    inject.disarm()
+
+
+def _inputs(size=20, seed=7):
+    rng = np.random.RandomState(seed)
+    return (rng.rand(size, size).astype(np.float32),
+            rng.rand(size, size).astype(np.float32),
+            rng.rand(size, size).astype(np.float32))
+
+
+def _params(catalog_dir=None, levels=2):
+    return AnalogyParams(backend="cpu", levels=levels, patch_size=3,
+                         coarse_patch_size=3, catalog_dir=catalog_dir,
+                         metrics=True)
+
+
+def _run(a, ap, b, p):
+    """One synthesis; returns (bp plane, catalog.* counter dict)."""
+    with obs_trace.run_scope(p) as ctx:
+        out = np.asarray(create_image_analogy(a, ap, b, p).bp)
+    counters = ctx.registry.snapshot()["counters"]
+    return out, {k: v for k, v in counters.items()
+                 if k.startswith("catalog.")}
+
+
+# ------------------------------------------------- tiered bit-identity
+
+
+def test_every_tier_serves_bit_identical(tmp_path):
+    """The acceptance property: resident hit, host hit, and disk load
+    each produce exactly the cold build's bytes — asserted tier by tier
+    by surgically draining the tiers between requests."""
+    a, ap, b = _inputs()
+    ref = np.asarray(create_image_analogy(a, ap, b, _params()).bp)
+
+    p = _params(catalog_dir=str(tmp_path))
+    # cold: every tier misses, the request builds + seals
+    out, c = _run(a, ap, b, p)
+    assert np.array_equal(out, ref)
+    assert c["catalog.builds"] == 2
+    assert c["catalog.disk.misses"] == 2
+    assert c["catalog.disk.write_bytes"] > 0
+
+    # resident ("HBM") tier hit
+    out, c = _run(a, ap, b, p)
+    assert np.array_equal(out, ref)
+    assert c == {"catalog.hbm.hits": 2}
+
+    # host tier hit: drain ONLY the resident tier
+    with tiers._LOCK:
+        tiers._resident.clear()
+    out, c = _run(a, ap, b, p)
+    assert np.array_equal(out, ref)
+    assert c["catalog.host.hits"] == 2
+    assert "catalog.builds" not in c and "catalog.disk.hits" not in c
+
+    # disk tier: drop both memory tiers (a fresh process)
+    tiers.clear()
+    out, c = _run(a, ap, b, p)
+    assert np.array_equal(out, ref)
+    assert c["catalog.disk.hits"] == 2
+    assert c["catalog.disk.read_bytes"] > 0
+    assert "catalog.builds" not in c
+
+
+def test_second_request_skips_feature_build(tmp_path):
+    """ISSUE acceptance: the second request for a cataloged style skips
+    the feature build entirely, and the skip is visible in counters (the
+    CPU backend is constructed fresh per request, so its private memo
+    cannot be what served this)."""
+    a, ap, b = _inputs()
+    p = _params(catalog_dir=str(tmp_path))
+    _, c1 = _run(a, ap, b, p)
+    assert c1["catalog.builds"] == 2
+    _, c2 = _run(a, ap, b, p)
+    assert "catalog.builds" not in c2
+    assert c2["catalog.hbm.hits"] == 2
+
+
+def test_prebuilt_style_serves_without_any_build(tmp_path):
+    """`ia catalog build`'s engine path: build_style seals entries whose
+    keys MATCH what live requests resolve — the very first request of a
+    fresh process is pure disk hits, zero builds."""
+    a, ap, b = _inputs()
+    p = _params(catalog_dir=str(tmp_path))
+    ref = np.asarray(create_image_analogy(a, ap, b, _params()).bp)
+
+    rep = catalog_build.build_style(a, ap, p, root_dir=str(tmp_path),
+                                    target=b)
+    assert rep["levels"] == 2 and len(rep["entries"]) == 2
+    tiers.clear()  # fresh process: nothing in memory, artifacts on disk
+
+    out, c = _run(a, ap, b, p)
+    assert np.array_equal(out, ref)
+    assert "catalog.builds" not in c
+    assert c["catalog.disk.hits"] == 2
+
+
+def test_video_clip_shares_anchor_frame_entries(tmp_path):
+    """build_style's remap-anchor contract: entries built against
+    target=frame0 resolve for the frame-0 request (same post-remap A
+    planes); bit-identity holds regardless."""
+    a, ap, b = _inputs()
+    p = _params(catalog_dir=str(tmp_path))
+    catalog_build.build_style(a, ap, p, root_dir=str(tmp_path), target=b)
+    style = tiers.style_key(a, ap)
+    keys_built = {k for k, _ in
+                  catalog_store.list_entries(str(tmp_path), style)}
+    tiers.clear()
+    _, c = _run(a, ap, b, p)
+    assert c.get("catalog.disk.hits") == 2  # every level resolved
+    # a DIFFERENT target (different luminance stats) must NOT silently
+    # reuse the anchored entries: remap changes A's bytes, so the keys
+    # differ and the request builds its own
+    b2 = _inputs(seed=23)[2]
+    tiers.clear()
+    _, c2 = _run(a, ap, b2, p)
+    assert c2["catalog.builds"] == 2
+    keys_after = {k for k, _ in
+                  catalog_store.list_entries(str(tmp_path), style)}
+    assert keys_built < keys_after  # new entries, old ones untouched
+
+
+# ------------------------------------------------- damage + quarantine
+# (same .corrupt contract — and the same assertion shapes — as the
+# journal's torn-tail / flipped-byte tests)
+
+
+def _seal_one_style(tmp_path):
+    a, ap, b = _inputs()
+    p = _params(catalog_dir=str(tmp_path))
+    ref = np.asarray(create_image_analogy(a, ap, b, _params()).bp)
+    _run(a, ap, b, p)
+    style = tiers.style_key(a, ap)
+    entries = catalog_store.list_entries(str(tmp_path), style)
+    assert len(entries) == 2
+    return a, ap, b, p, ref, style, entries
+
+
+def test_flipped_byte_quarantines_and_rebuilds_bit_identical(tmp_path):
+    a, ap, b, p, ref, style, entries = _seal_one_style(tmp_path)
+    victim = catalog_store.entry_path(str(tmp_path), style, entries[0][0])
+    blob = bytearray(open(victim, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF  # flip one payload byte
+    with open(victim, "wb") as f:
+        f.write(blob)
+
+    tiers.clear()  # force the disk path
+    out, c = _run(a, ap, b, p)
+    assert np.array_equal(out, ref)                  # rebuilt, not served
+    assert c["catalog.quarantined"] == 1
+    assert os.path.exists(victim + ".corrupt")       # evidence kept
+    assert c["catalog.builds"] == 1                  # only the victim
+    assert c["catalog.disk.hits"] == 1               # the intact sibling
+    # the rebuild resealed a fresh artifact in the victim's place
+    assert os.path.exists(victim)
+
+
+def test_torn_tail_quarantines_and_rebuilds_bit_identical(tmp_path):
+    a, ap, b, p, ref, style, entries = _seal_one_style(tmp_path)
+    victim = catalog_store.entry_path(str(tmp_path), style, entries[1][0])
+    whole = open(victim, "rb").read()
+    with open(victim, "wb") as f:
+        f.write(whole[: len(whole) // 2])  # torn mid-write
+
+    tiers.clear()
+    out, c = _run(a, ap, b, p)
+    assert np.array_equal(out, ref)
+    assert c["catalog.quarantined"] == 1
+    assert os.path.exists(victim + ".corrupt")
+    assert c["catalog.builds"] == 1
+    assert os.path.exists(victim)
+
+
+def test_gc_prunes_litter_and_budget(tmp_path):
+    a, ap, b, p, _ref, style, entries = _seal_one_style(tmp_path)
+    d = catalog_store.style_dir(str(tmp_path), style)
+    open(os.path.join(d, "torn.tmp.npz"), "wb").close()
+    open(os.path.join(d, "old.npz.corrupt"), "wb").close()
+
+    rep = catalog_store.gc(str(tmp_path))  # default: tmp litter only
+    assert rep["removed_entries"] == 1
+    assert os.path.exists(os.path.join(d, "old.npz.corrupt"))
+
+    rep = catalog_store.gc(str(tmp_path), keep=[style], max_bytes=0,
+                           purge_corrupt=True)
+    # keep exempts the style's sealed entries; corrupt evidence purged
+    assert not os.path.exists(os.path.join(d, "old.npz.corrupt"))
+    assert len(catalog_store.list_entries(str(tmp_path), style)) == 2
+
+    rep = catalog_store.gc(str(tmp_path), max_bytes=0)
+    assert rep["removed_styles"] == [style]
+    assert catalog_store.list_styles(str(tmp_path)) == []
+
+
+# ------------------------------------------------- prefetch placement
+
+
+class _RingRouter:
+    """Stub with the one method warm_for_fleet consults."""
+
+    def __init__(self, home):
+        self._home = home
+        self.asked = []
+
+    def home_for_style(self, style):
+        self.asked.append(style)
+        return self._home
+
+
+def test_warm_for_fleet_places_by_ring(tmp_path):
+    a, ap, b = _inputs()
+    p = _params(catalog_dir=str(tmp_path))
+    catalog_build.build_style(a, ap, p, root_dir=str(tmp_path), target=b)
+    style = tiers.style_key(a, ap)
+    tiers.clear()
+
+    router = _RingRouter("w1")
+    rep = tiers.warm_for_fleet(router, root_dir=str(tmp_path))
+    assert router.asked == [style]
+    assert rep["placements"] == {style: "w1"}
+    assert rep["styles"] == 1 and rep["entries"] == 2 and rep["bytes"] > 0
+    assert tiers.snapshot()["host_entries"] == 2
+
+    # only_worker (the multi-host shape): a host that does not own the
+    # style stages nothing
+    tiers.clear()
+    rep = tiers.warm_for_fleet(_RingRouter("w1"), root_dir=str(tmp_path),
+                               only_worker="w0")
+    assert rep["styles"] == 0
+    assert tiers.snapshot()["host_entries"] == 0
+
+
+def test_fleet_join_prestages_cataloged_styles(tmp_path, monkeypatch):
+    """A real fleet start pre-stages every cataloged style into host RAM
+    before traffic arrives (serve/fleet.py's join hook)."""
+    from image_analogies_tpu.chaos import drills
+    from image_analogies_tpu.serve.fleet import Fleet
+    from image_analogies_tpu.serve.types import FleetConfig
+
+    a, ap, b = _inputs()
+    p = _params(catalog_dir=str(tmp_path))
+    catalog_build.build_style(a, ap, p, root_dir=str(tmp_path), target=b)
+    tiers.clear()
+    monkeypatch.setenv("IA_CATALOG_DIR", str(tmp_path))
+
+    cfg = FleetConfig(serve=drills.serve_config(workers=1), size=2)
+    with Fleet(cfg):
+        snap = tiers.snapshot()
+        assert snap["host_entries"] == 2
+        assert snap["host_bytes"] > 0
+
+
+def test_host_tier_budget_evicts_lru(monkeypatch):
+    monkeypatch.setenv("IA_CATALOG_HOST_BYTES", "4096")
+    with obs_trace.run_scope(AnalogyParams(metrics=True)) as ctx:
+        for i in range(4):  # 4 x ~2 KiB entries > 4 KiB budget
+            db = np.full((16, 32), float(i), np.float32)
+            aff = np.zeros(16, np.float32)
+            tiers.record_build("style", f"key{i}", db, aff)
+        snap = ctx.registry.snapshot()
+    c, g = snap["counters"], snap["gauges"]
+    assert c["catalog.host.evictions"] >= 1
+    assert c["catalog.host.evicted_bytes"] >= 2048
+    assert g["catalog.host.bytes"] == tiers.snapshot()["host_bytes"]
+    assert g["catalog.host.bytes"] <= 4096
+
+
+def test_chaos_eviction_falls_through_bit_identical(tmp_path):
+    """The devcache.tier drill's core, inline: an armed plan evicts the
+    key mid-request on every resolution; output stays bit-identical and
+    the evictions reconcile against disk-hit recoveries."""
+    from image_analogies_tpu.chaos.plan import ChaosPlan, SiteRule
+
+    a, ap, b = _inputs()
+    p = _params(catalog_dir=str(tmp_path))
+    ref = np.asarray(create_image_analogy(a, ap, b, _params()).bp)
+    _run(a, ap, b, p)  # populate every tier
+
+    plan = ChaosPlan(seed=3, sites=(
+        ("devcache.tier", SiteRule(kind="corrupt", schedule=(0, 1))),))
+    with inject.plan_scope(plan):
+        out, c = _run(a, ap, b, p)
+    assert np.array_equal(out, ref)
+    assert c["catalog.chaos_evictions"] == 2
+    assert c["catalog.disk.hits"] == 2  # both evictions recovered on disk
+
+
+# ------------------------------------------------- config + checkpoint
+
+
+def test_catalog_config_precedence(monkeypatch, tmp_path):
+    from image_analogies_tpu.tune import warmup as tune_warmup
+
+    assert not tiers.active()
+    tune_warmup.apply_runtime_config(
+        AnalogyParams(catalog_dir=str(tmp_path), catalog_host_bytes=123))
+    assert tiers.root() == str(tmp_path)
+    assert tiers.host_budget() == 123
+    # env beats the configured values, read at call time
+    monkeypatch.setenv("IA_CATALOG_DIR", "/elsewhere")
+    monkeypatch.setenv("IA_CATALOG_HOST_BYTES", "456")
+    assert tiers.root() == "/elsewhere"
+    assert tiers.host_budget() == 456
+    monkeypatch.delenv("IA_CATALOG_DIR")
+    monkeypatch.delenv("IA_CATALOG_HOST_BYTES")
+    # a catalog-free run clears the previous run's configuration
+    tune_warmup.apply_runtime_config(AnalogyParams())
+    assert not tiers.active()
+    assert tiers.host_budget() == tiers._DEFAULT_HOST_BYTES
+    with pytest.raises(ValueError):
+        AnalogyParams(catalog_host_bytes=0)
+
+
+def test_catalog_knobs_do_not_split_run_digest(tmp_path):
+    """Catalog tiers are bit-identical by construction, so the checkpoint
+    run digest must not change when they are configured — resumability
+    survives flipping the catalog on."""
+    from image_analogies_tpu.utils import checkpoint as ckpt
+
+    base = AnalogyParams(backend="cpu")
+    tiered = AnalogyParams(backend="cpu", catalog_dir=str(tmp_path),
+                           catalog_host_bytes=1 << 20)
+    shapes = ((20, 20), (20, 20))
+    assert (ckpt.run_digest(base, *shapes)
+            == ckpt.run_digest(tiered, *shapes))
+
+
+# ------------------------------------------------- cold-start metric
+
+
+def test_bench_cold_start_toy_scale():
+    out = bench.measure_cold_start(size=20, levels=2)
+    assert out["bit_identical"]
+    assert out["cold_start_ms"] > 0
+    assert out["cold_start_ms"] == out["warm_first_ms"]
+    assert not tiers.active()  # the measurement cleans up after itself
+
+
+def test_bench_check_gates_cold_start_with_no_floor_path(tmp_path):
+    """Satellite 6: cold_start_ms rides `ia bench --check`.  A floored
+    archive gates regressions; legacy archives (pre-catalog rounds)
+    record the number without gating."""
+    floored = {"points": [
+        {"value": 6.0, "metric_key": "1024x1024", "cold_start_ms": 100.0,
+         "round": 1, "file": "BENCH_r01.json", "source": "parsed"}]}
+    ok = bench.check_regression(floored, fresh_value=6.0,
+                                fresh_key="1024x1024", fresh_cold=105.0)
+    assert ok["ok"] and ok["cold_start_floor"] == 100.0
+    bad = bench.check_regression(floored, fresh_value=6.0,
+                                 fresh_key="1024x1024", fresh_cold=500.0)
+    assert not bad["ok"]
+    assert any("cold_start_ms" in s for s in bad["problems"])
+
+    legacy = {"points": [
+        {"value": 6.0, "metric_key": "1024x1024",
+         "round": 1, "file": "BENCH_r01.json", "source": "parsed"}]}
+    rec = bench.check_regression(legacy, fresh_value=6.0,
+                                 fresh_key="1024x1024", fresh_cold=500.0)
+    assert rec["ok"]
+    assert rec["cold_start_ms"] == 500.0
+    assert rec["cold_start_floor"] is None
+
+    # the headline extractor carries the rider out of an archive doc
+    head = bench.extract_headline(
+        {"parsed": {"value": 6.0, "metric": "1024x1024 wall",
+                    "cold_start_ms": 42.0}})
+    assert head["cold_start_ms"] == 42.0
+
+
+# ------------------------------------------------- CLI + report
+
+
+def test_catalog_cli_roundtrip(tmp_path, capsys):
+    a, ap, b = _inputs()
+    for name, img in (("a", a), ("ap", ap), ("b", b)):
+        save_image(str(tmp_path / f"{name}.png"), img)
+    root = str(tmp_path / "cat")
+
+    assert cli.main(["catalog", "build", "--a", str(tmp_path / "a.png"),
+                     "--ap", str(tmp_path / "ap.png"),
+                     "--b", str(tmp_path / "b.png"),
+                     "--dir", root, "--levels", "2",
+                     "--patch-size", "3", "--coarse-patch-size", "3"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["levels"] == 2 and len(rep["entries"]) == 2
+
+    assert cli.main(["catalog", "inspect", root, "--json"]) == 0
+    info = json.loads(capsys.readouterr().out)
+    assert info["entries"] == 2 and info["corrupt"] == 0
+
+    tiers.clear()
+    assert cli.main(["catalog", "warm", root]) == 0
+    warm = json.loads(capsys.readouterr().out)
+    assert warm["entries"] == 2
+    assert tiers.snapshot()["host_entries"] == 2
+
+    assert cli.main(["catalog", "gc", root, "--max-bytes", "0"]) == 0
+    gc = json.loads(capsys.readouterr().out)
+    assert gc["removed_entries"] == 2
+    assert cli.main(["catalog", "inspect", root, "--json"]) == 0
+    assert json.loads(capsys.readouterr().out)["entries"] == 0
+
+
+def test_report_renders_catalog_section(tmp_path):
+    from image_analogies_tpu.obs import report as obs_report
+
+    a, ap, b = _inputs()
+    log = str(tmp_path / "run.jsonl")
+    p = _params(catalog_dir=str(tmp_path / "cat")).replace(log_path=log)
+    create_image_analogy(a, ap, b, p)
+    tiers.clear()
+    create_image_analogy(a, ap, b, p)  # disk-hit run rides the same log
+
+    text = obs_report.report(log)
+    assert "catalog:" in text
+    assert "disk tier" in text and "cold builds" in text
+    doc = json.loads(obs_report.report_json(log))
+    cats = [r["catalog"] for r in doc["runs"] if r.get("catalog")]
+    assert cats
+    assert sum(c["builds"] for c in cats) == 2
+    assert sum(c["disk"]["hits"] for c in cats) == 2
+
+
+# ------------------------------------------------------- grep lock
+
+
+def test_catalog_never_touches_jax():
+    """catalog/ is a host-side store exactly like serve/: all device
+    work stays behind the engine entry points — same lock, same
+    regexes as test_serve's."""
+    import image_analogies_tpu.catalog as catalog_pkg
+
+    root = os.path.dirname(catalog_pkg.__file__)
+    forbidden = re.compile(r"\bjax\.jit\s*\(|\bpjit\s*\(|\bjax\.pmap\s*\(")
+    toplevel_jax = re.compile(r"^(import jax|from jax)", re.MULTILINE)
+    scanned = set()
+    for name in sorted(os.listdir(root)):
+        if not name.endswith(".py"):
+            continue
+        scanned.add(name)
+        with open(os.path.join(root, name)) as f:
+            src = f.read()
+        assert not forbidden.findall(src), f"catalog/{name} calls jit/pjit"
+        assert not toplevel_jax.findall(src), (
+            f"catalog/{name} imports jax at module scope")
+    assert {"__init__.py", "store.py", "tiers.py", "build.py"} <= scanned

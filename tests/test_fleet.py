@@ -74,6 +74,31 @@ def test_ring_rebalance_keeps_untouched_keys():
     assert {k: ring.successors(k)[0] for k in keys} == before
 
 
+def test_home_for_style_ring_affinity():
+    """Style-grain placement (catalog prefetch) walks the SAME ring as
+    request routing: empty ring -> None; otherwise the style's home is
+    its first ring successor, and membership changes move prefetch
+    placement exactly the way they move traffic (join steals styles TO
+    the joiner only; leave restores them)."""
+    from image_analogies_tpu.serve.router import Router
+
+    router = Router(None, vnodes=32)
+    assert router.home_for_style("deadbeef0123") is None
+
+    for i in range(4):
+        router.ring.add(f"w{i}")
+    styles = [f"{i:012x}" for i in range(50)]
+    before = {s: router.home_for_style(s) for s in styles}
+    assert all(before[s] == router.ring.successors(s)[0] for s in styles)
+
+    router.ring.add("w4")
+    after = {s: router.home_for_style(s) for s in styles}
+    moved = [s for s in styles if after[s] != before[s]]
+    assert moved and all(after[s] == "w4" for s in moved)
+    router.ring.remove("w4")
+    assert {s: router.home_for_style(s) for s in styles} == before
+
+
 def test_fleet_config_validation():
     cfg = drills.serve_config()
     with pytest.raises(ValueError):

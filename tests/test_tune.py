@@ -663,6 +663,12 @@ def test_devcache_budget_and_gauge_honest(monkeypatch):
             gauge = snap["gauges"]["devcache.bytes"]
             assert gauge == devcache._bytes
             assert gauge <= 600 * 1024
+            # per-entry byte accounting at eviction: every uploaded byte
+            # is either still resident (the gauge) or was counted out
+            # through devcache.evicted_bytes — exact identity, not >=
+            assert snap["counters"]["devcache.evicted_bytes"] >= 256 * 1024
+            assert (gauge + snap["counters"]["devcache.evicted_bytes"]
+                    == snap["counters"]["devcache.upload_bytes"])
             devcache.clear()
             assert obs_metrics.snapshot()["gauges"]["devcache.bytes"] == 0
         # env beats the configured budget, read at call time
