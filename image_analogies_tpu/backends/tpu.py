@@ -42,6 +42,7 @@ Strategies (see config.AnalogyParams.strategy):
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import threading
 import time
@@ -198,6 +199,19 @@ class TpuLevelDB:
     # cannot query-bucket — its packed (Nb, 2) carry and anti-diagonal
     # schedule are program structure keyed on the exact (hb, wb).
     dims_b: Optional[jax.Array] = None
+    # Two-stage ANN matcher state (ISSUE 13 / ROADMAP item 3) — all None
+    # unless the level was built with ann_prefilter on AND past the
+    # parity gate (`_ann_gate_allows`): the (F, Kp) PCA basis and the
+    # (F,) mean it centers on (catalog-sealed artifact when one exists,
+    # else computed on device), plus the pre-projected (Na, Kp) DB and
+    # its (Na,) HALF squared norms the prefilter ranks against.  The
+    # projection source is the strategy's scoring DB (full for
+    # wavefront, rowsafe-masked for batched), decided at build time like
+    # db_pad's — the exact re-score then reads db/db_rowsafe untouched.
+    ann_proj: Optional[jax.Array] = None  # (F, Kp)
+    ann_mean: Optional[jax.Array] = None  # (F,)
+    ann_dbp: Optional[jax.Array] = None  # (Na, Kp)
+    ann_dbnh: Optional[jax.Array] = None  # (Na,)
 
     def a_dims(self):
         """(ha, wa) as ints (static path) or traced scalars (bucketed)."""
@@ -1063,6 +1077,25 @@ def make_approx_fn(db: TpuLevelDB):
     — their picks are heuristic anyway and tolerate ~1e-3 score error."""
     precision = (jax.lax.Precision.HIGHEST if db.strategy == "wavefront"
                  else jax.lax.Precision.DEFAULT)
+    if db.ann_dbp is not None and db.strategy != "wavefront":
+        # Two-stage ANN (ISSUE 13): rank ALL rows in the Kp-dim projected
+        # space (one cheap matmul), exact-fp32 re-score only the top-m
+        # slab against the SAME rowsafe DB the one-stage scan scores.
+        # Only built when ann_prefilter passed the parity gate; slab
+        # size resolves through tune (override > env > store > packaged
+        # > default) at trace time like every other geometry knob.
+        from image_analogies_tpu.ops.pallas_match import (
+            ann_rescore_slab, ann_topm_candidates)
+
+        top_m = tune.ann_top_m()
+
+        def approx_fn(queries):
+            na = db.a_rows()
+            cand = ann_topm_candidates(queries, db.ann_proj, db.ann_mean,
+                                       db.ann_dbp, db.ann_dbnh, na, top_m)
+            return ann_rescore_slab(queries, db.db_rowsafe, cand, na)
+
+        return approx_fn
     if db.db_pad is not None:
         def approx_fn(queries):
             tile = tune.tile_rows(
@@ -1145,6 +1178,28 @@ def make_anchor_fn(db: TpuLevelDB, defer_rescore: bool = False):
 
     The mesh-sharded step never comes here: parallel/step.py builds its own
     anchor over the all-reduced sharded argmin."""
+    if db.match_mode == "ann_rescue" and db.ann_dbp is not None:
+        # Two-stage ANN anchor (ISSUE 13): the prefilter ranks every DB
+        # row in the Kp-dim PCA subspace (one (M, Na) matmul over Kp-wide
+        # operands — ~F/Kp cheaper than the exact scan), the exact fp32
+        # re-score covers only the top-m slab, and the winner keeps the
+        # oracle's lowest-index tie rule within the slab.  A slab miss of
+        # the true argmin is exactly what the parity gate's audited probe
+        # bounds: the mode is only reachable after the audit came back
+        # fully tie-explained on this device class + strategy.
+        top_m = tune.ann_top_m()
+        na = db.a_rows()
+
+        def anchor(queries):
+            from image_analogies_tpu.ops.pallas_match import (
+                ann_rescore_slab, ann_topm_candidates)
+
+            cand = ann_topm_candidates(queries, db.ann_proj, db.ann_mean,
+                                       db.ann_dbp, db.ann_dbnh, na, top_m)
+            return ann_rescore_slab(queries, db.db, cand, na)
+
+        return anchor
+
     if (db.match_mode in ("scan_rescue", "scan_rescue_1p")
             and db.db_pad is not None
             and db.db_pad.dtype == jnp.bfloat16):
@@ -1679,11 +1734,12 @@ def reset_bf16_gate() -> None:
         _BF16_GATE.clear()
 
 
-def _bf16_probe_pair() -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+def _bf16_probe_pair(n: int = 32
+                     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Deterministic structured probe inputs: textured enough that fine
     levels carry real near-tie structure, small enough to audit in well
-    under a second of device time."""
-    n = 32
+    under a second of device time.  Shared by the bf16 and ANN parity
+    gates and the `ia tune --knob ann` sweep (which passes its own n)."""
     yy, xx = np.meshgrid(np.linspace(0.0, 1.0, n, dtype=np.float32),
                          np.linspace(0.0, 1.0, n, dtype=np.float32),
                          indexing="ij")
@@ -1694,18 +1750,32 @@ def _bf16_probe_pair() -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     return a, ap, b
 
 
+def _probe_base_params(params=None, *, levels: int = 2,
+                       strategy: str = "wavefront"):
+    """The gates' hermetic EXACT baseline params: every approximate /
+    resilience / IO knob forced off so a probe run is a pure synthesis
+    of the probe pair.  Shared by the bf16 gate, the ANN gate, and the
+    `ia tune --knob ann` sweep (which passes no ``params``)."""
+    if params is None:
+        from image_analogies_tpu.config import AnalogyParams
+
+        params = AnalogyParams()
+    return params.replace(
+        levels=levels, backend="tpu", strategy=strategy, match_mode="auto",
+        bf16_scoring=False, ann_prefilter=False, db_shards=1,
+        data_shards=1, temporal_weight=0.0, level_retries=0,
+        dispatch_timeout_s=0.0, level_sync=True, checkpoint_dir=None,
+        resume_from_level=None, profile_dir=None, log_path=None,
+        metrics=False, save_levels_dir=None, pipeline=False,
+        donate_buffers=False)
+
+
 def _bf16_probe_verdict(params) -> Dict[str, Any]:
     """Run the probe pair through both engines and audit (see gate note)."""
     from image_analogies_tpu.models.analogy import create_image_analogy
     from image_analogies_tpu.utils.parity import audit_source_map_mismatches
 
-    base = params.replace(
-        levels=2, backend="tpu", strategy="wavefront", match_mode="auto",
-        bf16_scoring=False, db_shards=1, data_shards=1,
-        temporal_weight=0.0, level_retries=0, dispatch_timeout_s=0.0,
-        level_sync=True, checkpoint_dir=None, resume_from_level=None,
-        profile_dir=None, log_path=None, metrics=False,
-        save_levels_dir=None, pipeline=False, donate_buffers=False)
+    base = _probe_base_params(params)
     a, ap, b = _bf16_probe_pair()
     exact = create_image_analogy(a, ap, b, base, keep_levels=True)
     _BF16_TLS.probing = True
@@ -1744,6 +1814,163 @@ def _bf16_gate_allows(params) -> bool:
                  "device": key, **verdict},
                 ctx.log_path if ctx is not None else None)
     return verdict["ok"]
+
+
+# ------------------------------------------------- ANN prefilter parity gate
+#
+# AnalogyParams.ann_prefilter routes the wavefront anchor / batched approx
+# scan through the two-stage matcher (PCA prefilter + exact-f32 slab
+# re-score).  Same support contract as bf16_scoring, same machinery: the
+# FIRST ann-prefiltered synthesis on a (device class, strategy) runs the
+# deterministic probe pair through the exact engine and the two-stage
+# engine and audits the source maps; only a fully tie-explained verdict
+# (unexplained == 0, first divergence a tie) enables the mode — anything
+# else caches a refusal (ann.disabled_unexplained) and every synthesis
+# silently keeps the exact matcher.  Keyed per strategy too: the two
+# strategies prefilter against different DBs (full vs rowsafe-masked),
+# so one verdict must not vouch for the other.
+
+_ANN_GATE: Dict[str, Dict[str, Any]] = {}
+_ANN_GATE_LOCK = threading.Lock()
+_ANN_TLS = threading.local()  # .probing: True inside the gate's ann run
+
+
+def reset_ann_gate() -> None:
+    """Forget cached gate verdicts (tests re-probe after monkeypatching)."""
+    with _ANN_GATE_LOCK:
+        _ANN_GATE.clear()
+
+
+@contextlib.contextmanager
+def ann_gate_bypass():
+    """Run the body with the ANN gate forced open (the `ia tune --knob
+    ann` sweep: it audits every candidate itself, and probing the gate
+    per candidate would double every measurement)."""
+    prev = getattr(_ANN_TLS, "probing", False)
+    _ANN_TLS.probing = True
+    try:
+        yield
+    finally:
+        _ANN_TLS.probing = prev
+
+
+def _ann_probe_verdict(params, strategy: str) -> Dict[str, Any]:
+    """Probe pair through the exact and two-stage engines + audit."""
+    from image_analogies_tpu.models.analogy import create_image_analogy
+    from image_analogies_tpu.utils.parity import audit_source_map_mismatches
+
+    base = _probe_base_params(params, strategy=strategy)
+    a, ap, b = _bf16_probe_pair()
+    exact = create_image_analogy(a, ap, b, base, keep_levels=True)
+    _ANN_TLS.probing = True
+    try:
+        two = create_image_analogy(a, ap, b,
+                                   base.replace(ann_prefilter=True),
+                                   keep_levels=True)
+    finally:
+        _ANN_TLS.probing = False
+    audit = audit_source_map_mismatches(a, ap, b, base,
+                                        two.levels, exact.levels)
+    ok = (audit["unexplained"] == 0
+          and audit["first_divergence_is_tie"] is not False)
+    return {"ok": ok, "mismatches": audit["mismatches"],
+            "unexplained": audit["unexplained"],
+            "first_divergence_is_tie": audit["first_divergence_is_tie"]}
+
+
+def _ann_gate_allows(params, strategy: str) -> bool:
+    if getattr(_ANN_TLS, "probing", False):
+        return True  # the gate's own two-stage probe run must not recurse
+    key = f"{tune.device_kind()}|{strategy}"
+    with _ANN_GATE_LOCK:
+        verdict = _ANN_GATE.get(key)
+    if verdict is None:
+        fresh = _ann_probe_verdict(params, strategy)
+        with _ANN_GATE_LOCK:
+            verdict = _ANN_GATE.setdefault(key, fresh)
+        if verdict is fresh:  # first prober logs/counts the verdict once
+            obs_metrics.inc("ann.gate_ok" if verdict["ok"]
+                            else "ann.disabled_unexplained")
+            ctx = obs_trace._CURRENT
+            ia_logging.emit(
+                {"event": "ann_gate", "severity":
+                 "info" if verdict["ok"] else "warning",
+                 "device": key, "strategy": strategy, **verdict},
+                ctx.log_path if ctx is not None else None)
+    return verdict["ok"]
+
+
+# --------------------------------------------- ANN projection resolution
+
+
+@functools.partial(jax.jit, static_argnames=("dims",))
+def _ann_arrays_on_device(src, dims: int):
+    """No-catalog fallback: PCA basis + projected DB computed on device
+    in one program (no host round-trip of the DB — the PJRT tunnel moves
+    ~9 MB/s).  The basis need not bit-match the catalog artifact's
+    host-numpy build: ANY basis only steers candidate RANKING, the slab
+    re-score is exact fp32 either way, and parity is owned by the gate's
+    tie audit — so device eigh determinism is not load-bearing."""
+    n, f = src.shape
+    kp = max(1, min(int(dims), f, n))
+    mean = jnp.mean(src, axis=0)
+    xc = src - mean[None, :]
+    cov = jnp.dot(xc.T, xc, preferred_element_type=_F32)
+    _, vecs = jnp.linalg.eigh(cov)  # ascending eigenvalues
+    proj = vecs[:, ::-1][:, :kp]
+    dbp = jnp.dot(xc, proj, preferred_element_type=_F32)
+    return mean, proj, dbp, 0.5 * jnp.sum(dbp * dbp, axis=1)
+
+
+@jax.jit
+def _ann_project_db(src, mean, proj):
+    """Catalog-artifact path: project the scoring DB through the sealed
+    basis (the basis itself came off disk, host-side)."""
+    dbp = jnp.dot(src - mean[None, :], proj, preferred_element_type=_F32)
+    return dbp, 0.5 * jnp.sum(dbp * dbp, axis=1)
+
+
+def _resolve_ann_projection(job: LevelJob):
+    """Resolve this level's ANN basis through the catalog's sealed
+    artifacts.  Returns one of:
+
+    - ``("artifact", mean, proj)`` — sealed artifact loaded and verified;
+    - ``("fresh",)`` — no catalog / no artifact for this key: compute the
+      basis on device (`_ann_arrays_on_device`);
+    - ``("rebuild", root, key)`` — an artifact EXISTED but failed its
+      seal and was quarantined (``.corrupt``): this request runs the
+      exact matcher (bit-identical by construction) and the caller
+      rebuilds + re-seals the artifact from the feature bytes so the
+      next request recovers the fast path.
+
+    The ``match.prefilter`` chaos site fires here; its ``"corrupt"``
+    directive flips one byte of the sealed artifact BEFORE the load —
+    the drill (chaos/drills ann_corrupt) then asserts the quarantine +
+    exact-fallback + rebuild chain end to end."""
+    import os
+
+    from image_analogies_tpu import chaos
+    from image_analogies_tpu.catalog import ann as catalog_ann
+    from image_analogies_tpu.catalog import tiers as catalog_tiers
+
+    if not catalog_tiers.active():
+        return ("fresh",)
+    root_dir = catalog_tiers.root()
+    key = catalog_tiers.feature_key(job.spec, job.a_src, job.a_filt,
+                                    job.a_src_coarse, job.a_filt_coarse,
+                                    job.a_temporal)
+    path = catalog_ann.artifact_path(root_dir, key)
+    directive = chaos.site("match.prefilter", level=job.level)
+    if directive == "corrupt":
+        catalog_ann.damage_artifact(path, seed=chaos.plan_seed() or 0)
+    existed = os.path.exists(path)
+    got = catalog_ann.load_artifact(root_dir, key)
+    if got is not None:
+        obs_metrics.inc("ann.artifact_hits")
+        return ("artifact", got[0], got[1])
+    if existed:
+        return ("rebuild", root_dir, key)
+    return ("fresh",)
 
 
 class TpuMatcher(Matcher):
@@ -1818,6 +2045,26 @@ class TpuMatcher(Matcher):
             # re-score.  Only reachable after the parity gate's probe
             # audit came back fully tie-explained on this device class.
             mode = "scan_rescue"
+        # Opt-in two-stage ANN matcher (ISSUE 13), gated like bf16 —
+        # per (device class, strategy).  When both flags are on, ANN wins
+        # for the wavefront anchor (its prefilter already subsumes the
+        # scan-rescue bandwidth saving).  Any refused/unsupported request
+        # silently runs the exact matcher and counts ann.fallback_exact.
+        ann_plan = None
+        if (self.params.ann_prefilter
+                and strategy in ("wavefront", "batched") and not sharded):
+            if _ann_gate_allows(self.params, strategy):
+                ann_plan = _resolve_ann_projection(job)
+                if ann_plan[0] == "rebuild":
+                    # quarantined artifact: THIS level runs exact
+                    obs_metrics.inc("ann.fallback_exact")
+            else:
+                obs_metrics.inc("ann.fallback_exact")
+        elif self.params.ann_prefilter:
+            obs_metrics.inc("ann.fallback_exact")
+        if (ann_plan is not None and ann_plan[0] != "rebuild"
+                and strategy == "wavefront"):
+            mode = "ann_rescue"
         if strategy != "wavefront":
             pad_mode = "f32"
         elif mode == "exact_hi2":
@@ -1925,8 +2172,48 @@ class TpuMatcher(Matcher):
             to_j(job.b_src_coarse), to_j(job.b_filt_coarse),
             to_j(job.b_temporal), template.rowsafe, pad_tile, pad_full,
             pad_mode, db_rows_pad, q_rows_pad)
+        ann_kw: Dict[str, Any] = {}
+        if ann_plan is not None:
+            # the prefilter ranks against the strategy's scoring DB —
+            # full rows for wavefront (the oracle's metric), rowsafe-
+            # masked for batched — mirroring the pad-copy choice above
+            ann_src = (arrs["db"] if strategy == "wavefront"
+                       else arrs["db_rowsafe"])
+            if ann_plan[0] == "rebuild":
+                # quarantined artifact: rebuild + re-seal from the
+                # feature bytes so the NEXT request recovers the fast
+                # path; this one already committed to the exact matcher
+                from image_analogies_tpu.catalog import ann as catalog_ann
+
+                mean_np, proj_np = catalog_ann.build_projection(
+                    np.asarray(ann_src), tune.ann_proj_dims())
+                catalog_ann.save_artifact(ann_plan[1], ann_plan[2],
+                                          mean_np, proj_np)
+                obs_metrics.inc("ann.artifacts_rebuilt")
+            else:
+                if ann_plan[0] == "artifact":
+                    mean_j = jnp.asarray(ann_plan[1], _F32)
+                    proj_j = jnp.asarray(ann_plan[2], _F32)
+                    dbp, dbnh = _ann_project_db(ann_src, mean_j, proj_j)
+                else:
+                    mean_j, proj_j, dbp, dbnh = _ann_arrays_on_device(
+                        ann_src, tune.ann_proj_dims())
+                    obs_metrics.inc("ann.projection_built")
+                top_m = tune.ann_top_m()
+                obs_metrics.inc("ann.prefilter_used")
+                obs_metrics.set_gauge("ann.top_m", top_m)
+                obs_metrics.set_gauge("ann.proj_dims",
+                                      int(proj_j.shape[1]))
+                obs_trace.emit_record(
+                    {"event": "ann_prefilter", "level": job.level,
+                     "strategy": strategy, "source": ann_plan[0],
+                     "top_m": top_m, "proj_dims": int(proj_j.shape[1]),
+                     "db_rows": int(ann_src.shape[0])})
+                ann_kw = dict(ann_proj=proj_j, ann_mean=mean_j,
+                              ann_dbp=dbp, ann_dbnh=dbnh)
         return dataclasses.replace(
             template,
+            **ann_kw,
             db=arrs["db"],
             db_sqnorm=arrs["db_sqnorm"],
             db_rowsafe=arrs["db_rowsafe"],

@@ -24,6 +24,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from image_analogies_tpu.catalog import tiers
+from image_analogies_tpu.obs import metrics as obs_metrics
 
 
 def build_style(a, ap, params=None, *, root_dir: Optional[str] = None,
@@ -70,6 +71,21 @@ def build_style(a, ap, params=None, *, root_dir: Optional[str] = None,
         aff = np.asarray(a_filt_pyr[level], np.float32).reshape(-1)
         tiers.record_build(style, key, db, aff, build_ms=ms,
                            root_dir=root_dir)
-        entries.append({"level": level, "key": key,
-                        "rows": int(db.shape[0]), "ms": ms})
+        entry = {"level": level, "key": key,
+                 "rows": int(db.shape[0]), "ms": ms}
+        # Derived ANN state rides the build (ISSUE 13): seal the PCA
+        # basis for this level's feature DB next to the entry so a
+        # request with ann_prefilter on never pays the eigendecomposition
+        # on the serving path.  numpy-only like the features themselves.
+        r = root_dir or tiers.root()
+        if r:
+            from image_analogies_tpu.catalog import ann as _ann
+            from image_analogies_tpu.tune import resolve as _tune_resolve
+
+            mean, proj = _ann.build_projection(
+                db, _tune_resolve.ann_proj_dims())
+            _ann.save_artifact(r, key, mean, proj)
+            obs_metrics.inc("ann.artifacts_built")
+            entry["ann_dims"] = int(proj.shape[1])
+        entries.append(entry)
     return {"style": style, "levels": levels, "entries": entries}

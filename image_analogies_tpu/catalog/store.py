@@ -90,10 +90,13 @@ def load_entry(root: str, style: str, key: str
 
 
 def list_styles(root: str) -> List[str]:
+    """Style directories only: ``_``-prefixed siblings (the sealed ANN
+    bases under ``_ann/``) are derived state, not styles."""
     if not root or not os.path.isdir(root):
         return []
     return sorted(d for d in os.listdir(root)
-                  if os.path.isdir(os.path.join(root, d)))
+                  if os.path.isdir(os.path.join(root, d))
+                  and not d.startswith("_"))
 
 
 def list_entries(root: str, style: str) -> List[Tuple[str, int]]:

@@ -50,6 +50,21 @@ def catalog_params(catalog_dir: str, *, levels: int = 2):
                          catalog_dir=catalog_dir, metrics=True)
 
 
+def ann_params(catalog_dir: str, *, levels: int = 2):
+    """Two-stage ANN drill config: TPU-backend wavefront engine (the ANN
+    matcher lives in the TPU backend; its XLA programs compile on any
+    host) with the exemplar catalog rooted at ``catalog_dir`` and the
+    prefilter armed.  No retries — the ``match.prefilter`` corrupt
+    directive never raises; recovery is the quarantine → exact-fallback
+    → rebuild chain itself."""
+    from image_analogies_tpu.config import AnalogyParams
+
+    return AnalogyParams(backend="tpu", strategy="wavefront", levels=levels,
+                         patch_size=3, coarse_patch_size=3, level_retries=0,
+                         ann_prefilter=True, catalog_dir=catalog_dir,
+                         metrics=True)
+
+
 def run_image(a: np.ndarray, ap: np.ndarray, b: np.ndarray, params
               ) -> np.ndarray:
     """One engine synthesis; returns the host bp plane."""

@@ -7,6 +7,9 @@ Engine layers call ``site("name", **ctx)`` at their boundaries:
     devcache.tier     catalog/tiers.py    — per-level catalog tier
                                             resolution ("corrupt" =
                                             evict the key mid-request)
+    match.prefilter   backends/tpu.py     — per-level ANN projection
+                                            resolution ("corrupt" =
+                                            damage the sealed artifact)
     ckpt.save         utils/checkpoint.py — checkpoint write
     ckpt.load         utils/checkpoint.py — checkpoint read
     serve.admit       serve/queue.py      — request admission

@@ -48,6 +48,8 @@ _KIND_NOTES = {
                      "bit-identically",
     "devcache_tier": "mid-request catalog tier eviction falls through to "
                      "disk/rebuild bit-identically",
+    "ann_corrupt": "sealed ANN basis damaged mid-request; quarantine + "
+                   "exact fallback + rebuild, bit-identically",
 }
 
 # What `selftest` (and the tier-1 parametrization) iterates: every raw
@@ -58,7 +60,7 @@ _KIND_NOTES = {
 def _drill_kinds():
     from image_analogies_tpu.chaos import FAULT_KINDS
     return tuple(FAULT_KINDS) + ("fleet_death", "batch_partial",
-                                 "devcache_tier")
+                                 "devcache_tier", "ann_corrupt")
 
 
 DRILL_KINDS = _drill_kinds()
@@ -117,6 +119,18 @@ def plan_for_kind(kind: str, seed: int = 0) -> ChaosPlan:
         # rebuild) and still produce the clean run's exact bytes.
         sites = (("devcache.tier", SiteRule(kind="corrupt",
                                             schedule=(0, 1))),)
+    elif kind == "ann_corrupt":
+        # ANN-artifact drill geometry (2 levels, sealed artifacts built
+        # ahead of time): the match.prefilter site is visited once per
+        # level's projection resolution — and, on a cold parity gate,
+        # extra times by the gate's own probe syntheses, whose
+        # probe-plane keys have no artifact (the damage helper no-ops on
+        # absent paths).  p=1.0 rather than a schedule so EVERY visit of
+        # the armed run corrupts regardless of how many probe visits
+        # precede it: each level's artifact is damaged the instant the
+        # request resolves it, so every level must quarantine, answer on
+        # the exact path bit-identically, and re-seal a rebuilt basis.
+        sites = (("match.prefilter", SiteRule(kind="corrupt", p=1.0)),)
     elif kind == "batch_partial":
         # Batched-engine drill geometry (k=3 lanes, 2 levels): the
         # engine.batch site is visited once per (level, lane), coarsest
@@ -165,7 +179,7 @@ def _reconcile(plan: ChaosPlan, counters: Dict[str, float]) -> List[str]:
     # raising kind at a serve batch boundary is contained as a crash
     # regardless of its class — the containment layer can't tell.
     retries = watchdogs = quarantines = crashes = deaths = 0.0
-    hop_faults = lane_faults = tier_evictions = 0.0
+    hop_faults = lane_faults = tier_evictions = ann_faults = 0.0
     for name, rule in plan.sites:
         n = counters.get(f"chaos.site.{name}", 0)
         if not n:
@@ -184,6 +198,14 @@ def _reconcile(plan: ChaosPlan, counters: Dict[str, float]) -> List[str]:
             # counter — must be matched before the generic corrupt →
             # ckpt.quarantined accounting below
             tier_evictions += n
+        elif name == "match.prefilter":
+            # the corrupt directive here damages the sealed ANN artifact
+            # — but only when one exists at the resolved key (gate-probe
+            # visits resolve probe-plane keys with no artifact, where the
+            # damage helper no-ops), so the evidence is the quarantine →
+            # exact-fallback → rebuild chain checked loosely below, not
+            # an equality against the visit count
+            ann_faults += n
         elif rule.kind == "process_death":
             # not contained: the worker thread dies; the only matching
             # evidence is the death counter (recovery is the journal's)
@@ -221,6 +243,19 @@ def _reconcile(plan: ChaosPlan, counters: Dict[str, float]) -> List[str]:
         want("batch.lane_faults", lane_faults)
     if tier_evictions:
         want("catalog.chaos_evictions", tier_evictions)
+    if ann_faults:
+        quarantined = counters.get("ann.quarantined", 0)
+        if not quarantined:
+            problems.append(
+                "match.prefilter fired but nothing was quarantined")
+        if counters.get("ann.fallback_exact", 0) < quarantined:
+            problems.append(
+                f"{quarantined} ANN quarantines but only "
+                f"{counters.get('ann.fallback_exact', 0)} exact fallbacks")
+        if counters.get("ann.artifacts_rebuilt", 0) < quarantined:
+            problems.append(
+                f"{quarantined} ANN quarantines but only "
+                f"{counters.get('ann.artifacts_rebuilt', 0)} rebuilds")
     return problems
 
 
@@ -333,6 +368,67 @@ def drill_catalog_tier(plan: ChaosPlan, *, seed: int = 7,
         "sites": snap,
         "counters": {k: v for k, v in counters.items()
                      if k.startswith(("chaos.", "catalog."))},
+        "identical": identical,
+        "ok": not problems,
+        "problems": problems,
+    }
+
+
+def drill_ann_corrupt(plan: ChaosPlan, *, seed: int = 7,
+                      size=(20, 20), workdir: Optional[str] = None
+                      ) -> Dict[str, Any]:
+    """ANN-artifact corruption drill: exact reference run → AOT catalog
+    build (seals the per-level PCA artifacts) → warm two-stage run
+    (disarmed; pays the parity-gate probe and proves the artifacts load)
+    → armed run whose ``match.prefilter`` directives flip a byte of each
+    level's sealed artifact the instant the request resolves it.
+    Invariants: every damaged artifact quarantines (``.corrupt``), every
+    quarantined level answers on the exact path — the armed run's output
+    is bit-identical to the exact reference — and each quarantine is
+    matched by a rebuilt, re-sealed artifact."""
+    from image_analogies_tpu.catalog import build as catalog_build
+    from image_analogies_tpu.catalog import tiers as catalog_tiers
+    from image_analogies_tpu.obs import trace as obs_trace
+
+    a, ap, b = drills.make_inputs(size, seed)
+    catalog_tiers.clear()
+    try:
+        with tempfile.TemporaryDirectory(dir=workdir) as tmp:
+            root = os.path.join(tmp, "catalog")
+            params = drills.ann_params(root)
+            exact_bp = drills.run_image(
+                a, ap, b, params.replace(ann_prefilter=False))
+            catalog_build.build_style(a, ap, params, root_dir=root,
+                                      target=b)
+            with obs_trace.run_scope(params) as ctx:
+                # warm two-stage run: output is the gate-audited
+                # approximate path, so only its counters are asserted
+                drills.run_image(a, ap, b, params)
+                with inject.plan_scope(plan):
+                    chaos_bp = drills.run_image(a, ap, b, params)
+                    snap = inject.snapshot()
+                counters = _counters(ctx)
+    finally:
+        catalog_tiers.clear()
+        catalog_tiers.configure(None)
+
+    identical = bool(np.array_equal(exact_bp, chaos_bp))
+    problems = [] if identical else ["output differs from exact run"]
+    problems += _reconcile(plan, counters)
+    if not counters.get("ann.artifact_hits", 0):
+        problems.append("warm run never loaded a sealed ANN artifact")
+    if not counters.get("ann.quarantined", 0):
+        problems.append("armed run quarantined no damaged artifact")
+    injected = sum(st["injected"] for st in snap.values())
+    if injected == 0:
+        problems.append("plan injected nothing (dead drill)")
+    return {
+        "workload": "ann_corrupt",
+        "plan": plan.to_dict(),
+        "injected": injected,
+        "sites": snap,
+        "counters": {k: v for k, v in counters.items()
+                     if k.startswith(("chaos.", "ann."))},
         "identical": identical,
         "ok": not problems,
         "problems": problems,
@@ -759,6 +855,8 @@ def drill_batch_partial(plan: ChaosPlan, *, k: int = 3, seed: int = 7
 
 def run_drill(plan: ChaosPlan, **kw) -> Dict[str, Any]:
     """Dispatch a plan to the workload its sites target."""
+    if any(name == "match.prefilter" for name, _ in plan.sites):
+        return drill_ann_corrupt(plan, **kw)
     if any(name == "devcache.tier" for name, _ in plan.sites):
         return drill_catalog_tier(plan, **kw)
     if any(name == "engine.batch" for name, _ in plan.sites):

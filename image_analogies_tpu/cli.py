@@ -79,6 +79,14 @@ def _add_engine_flags(p: argparse.ArgumentParser) -> None:
                         "passes per scan row")
     p.add_argument("--no-ann", action="store_true",
                    help="disable the cKDTree index (CPU backend brute force)")
+    p.add_argument("--ann-prefilter", action="store_true",
+                   help="two-stage TPU matcher: a PCA-projected prefilter "
+                        "ranks the whole exemplar DB cheaply and the exact "
+                        "f32 scorer re-scores only the top-m slab "
+                        "(tune: ann_top_m / ann_proj_dims).  Gated by a "
+                        "first-use oracle-parity probe per device class; "
+                        "refused or unsupported requests silently run the "
+                        "exact matcher (ann.fallback_exact)")
     p.add_argument("--no-remap", action="store_true",
                    help="disable luminance remapping")
     p.add_argument("--no-gaussian", action="store_true",
@@ -171,6 +179,8 @@ def _params_from_args(args, base: AnalogyParams) -> AnalogyParams:
         kw["coarse_patch_size"] = args.coarse_patch_size
     if args.no_ann:
         kw["use_ann"] = False
+    if getattr(args, "ann_prefilter", False):
+        kw["ann_prefilter"] = True
     if args.metrics or getattr(args, "metrics_port", None) is not None:
         kw["metrics"] = True
     if args.no_level_sync:
@@ -753,6 +763,13 @@ def cmd_bench(args) -> int:
         # the distinct `batched_qps` trajectory metric
         return int(bench.bench_batched(args.batch) or 0)
 
+    if args.exemplar_scale:
+        # exemplar-DB scaling point (bench.measure_exemplar_scaling):
+        # the two-stage ANN matcher against 1x/4x/16x the exemplar rows;
+        # the headline exemplar_scale_ratio is what --check gates
+        print(json.dumps(bench.measure_exemplar_scaling()))
+        return 0
+
     if not args.check and not args.dry_run:
         return int(bench.main() or 0)
 
@@ -763,6 +780,7 @@ def cmd_bench(args) -> int:
     fresh_gap = None
     fresh_obs = None
     fresh_cold = None
+    fresh_scale = None
     fresh_key = args.metric_key
     if args.value is not None:
         fresh = args.value
@@ -782,6 +800,8 @@ def cmd_bench(args) -> int:
                 fresh_obs = float(doc["obs_overhead_pct"])
             if doc.get("cold_start_ms") is not None:
                 fresh_cold = float(doc["cold_start_ms"])
+            if doc.get("exemplar_scale_ratio") is not None:
+                fresh_scale = float(doc["exemplar_scale_ratio"])
         else:
             head = bench.extract_headline(doc if isinstance(doc, dict)
                                           else {})
@@ -793,6 +813,7 @@ def cmd_bench(args) -> int:
             fresh_gap = head.get("host_gap_ms")
             fresh_obs = head.get("obs_overhead_pct")
             fresh_cold = head.get("cold_start_ms")
+            fresh_scale = head.get("exemplar_scale_ratio")
             if fresh_key is None:
                 fresh_key = head.get("metric_key")
     verdict = bench.check_regression(trajectory, fresh_value=fresh,
@@ -800,7 +821,8 @@ def cmd_bench(args) -> int:
                                      fresh_gap=fresh_gap,
                                      fresh_key=fresh_key,
                                      fresh_obs=fresh_obs,
-                                     fresh_cold=fresh_cold)
+                                     fresh_cold=fresh_cold,
+                                     fresh_scale=fresh_scale)
     print(json.dumps(verdict, sort_keys=True))
     for problem in verdict.get("problems", []):
         print(f"bench: warning: {problem}", file=sys.stderr)
@@ -914,6 +936,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "bit-identity; records the 'batched_qps' "
                          "trajectory metric (marginal s/lane, lower is "
                          "better)")
+    bn.add_argument("--exemplar-scale", action="store_true",
+                    help="measure the two-stage ANN matcher against "
+                         "1x/4x/16x the exemplar DB rows instead of the "
+                         "full harness; prints the per-scale s and "
+                         "s-per-Mrow points plus the exemplar_scale_ratio "
+                         "headline that --check gates (relative floor + "
+                         "absolute sub-linearity)")
     bn.add_argument("--check", action="store_true",
                     help="no measurement: parse the trajectory and fail "
                          "(exit 1) when the candidate regresses past "
@@ -950,8 +979,13 @@ def build_parser() -> argparse.ArgumentParser:
                              "tune store (.ia_tune.json)")
     tn.add_argument("--dry-run", action="store_true",
                     help="print the sweep plan JSON; no device work")
-    tn.add_argument("--knob", choices=("packed_tile", "argmin_tile", "all"),
-                    default="all")
+    tn.add_argument("--knob",
+                    choices=("packed_tile", "argmin_tile", "ann", "all"),
+                    default="all",
+                    help="ann sweeps ann_top_m with full two-stage "
+                         "syntheses, each tie-audited against an exact "
+                         "run before persistence; NOT part of 'all' "
+                         "(minutes, and it exercises the parity gate)")
     tn.add_argument("--store", default=None,
                     help="tune store path (default: repo .ia_tune.json, "
                          "IA_TUNE_STORE overrides)")
@@ -1096,7 +1130,7 @@ def build_parser() -> argparse.ArgumentParser:
                     help="one canonical drill per kind "
                          "(transient, oom, latency, corrupt, crash, "
                          "process_death, fleet_death, batch_partial, "
-                         "devcache_tier) plus the "
+                         "devcache_tier, ann_corrupt) plus the "
                          "same-seed schedule-determinism check")
     ch.add_argument("--kinds", default=None,
                     help="comma-separated fault-kind subset for "

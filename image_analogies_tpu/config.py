@@ -264,6 +264,21 @@ class AnalogyParams:
     # Unlike IA_EXPERIMENTAL match modes, this is a supported production
     # flag BECAUSE of that gate — it refuses to run non-parity.
     bf16_scoring: bool = False
+    # Opt-in two-stage ANN matcher (ROADMAP item 3): a cheap prefilter
+    # over a PCA-projected copy of the A/A' DB selects a top-m candidate
+    # slab per query, then the existing exact-f32 scorer re-scores only
+    # that slab — per-pixel cost goes from O(|A|) toward O(m + proj).
+    # OFF by default and gated exactly like bf16_scoring: first use on a
+    # device class runs a small probe twice (exact vs two-stage) and
+    # audits the source maps (utils/parity.py); any mismatch not
+    # explained as a tie auto-disables the flag for the process (counter
+    # ann.disabled_unexplained, event ann_gate) and synthesis silently
+    # stays exact.  Slab size / projection rank resolve through tune/
+    # (ann_top_m / ann_proj_dims; env IA_ANN_TOP_M / IA_ANN_PROJ_DIMS).
+    # Projection matrices are sha256-sealed catalog artifacts when a
+    # catalog root is configured (built at `ia catalog build`), else
+    # computed on the fly from the level's DB.
+    ann_prefilter: bool = False
 
     def __post_init__(self):
         if self.levels < 1:
@@ -321,6 +336,16 @@ class AnalogyParams:
             raise ValueError(
                 "bf16_scoring requires strategy 'wavefront' or 'auto', "
                 f"got {self.strategy!r}")
+        if self.ann_prefilter and self.backend != "tpu":
+            raise ValueError(
+                "ann_prefilter is the TPU engine's two-stage matcher; "
+                f"backend {self.backend!r} has its own ANN toggle "
+                "(use_ann)")
+        if self.ann_prefilter and self.strategy not in ("wavefront",
+                                                        "batched", "auto"):
+            raise ValueError(
+                "ann_prefilter requires strategy 'wavefront', 'batched' "
+                f"or 'auto', got {self.strategy!r}")
 
     def pipeline_active(self) -> bool:
         """Resolved pipeline flag: explicit setting wins, auto enables the

@@ -110,8 +110,19 @@ def _classify(rec: Dict[str, Any]) -> Tuple[str, int, str, Optional[float]]:
             name = (f"journal handoff {rec.get('worker', '?')} "
                     f"gen {rec.get('generation', '?')}")
         return "i", CHAOS_TID, name, None
+    if ev in ("ann_gate", "ann_prefilter"):
+        # two-stage matcher instants on the host track: the parity
+        # gate's verdict and each level's prefilter engagement (with its
+        # basis source and slab size in args)
+        if ev == "ann_gate":
+            name = (f"ann gate {'ok' if rec.get('ok') else 'refused'} "
+                    f"{rec.get('device', '?')}")
+        else:
+            name = (f"ann prefilter L{rec.get('level', '?')} "
+                    f"{rec.get('source', '?')} m={rec.get('top_m', '?')}")
+        return "i", HOST_TID, name, None
     if ev in ("chaos_inject", "ckpt_quarantined", "journal_quarantined",
-              "watchdog_timeout",
+              "ann_quarantined", "watchdog_timeout",
               "retry_exhausted", "serve_worker_crash", "serve_process_death",
               "breaker_open",
               "breaker_half_open", "breaker_closed", "blackbox_dump"):

@@ -431,6 +431,46 @@ def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             "fallbacks": fallbacks,
         }
 
+    # --- ANN section (ann.* counters + gate/prefilter records) ------------
+    # The two-stage matcher's ledger: the parity gate's verdicts, each
+    # level's prefilter engagement with its basis source, sealed-artifact
+    # integrity (quarantines + rebuilds), and the exact-fallback count
+    # that accounts for every request the matcher declined.
+    gate_recs = [r for r in records if r.get("event") == "ann_gate"]
+    engage_recs = [r for r in records if r.get("event") == "ann_prefilter"]
+    ann_info: Optional[Dict[str, Any]] = None
+    if (gate_recs or engage_recs
+            or any(k.startswith("ann.") for k in counters)):
+        ann_info = {
+            "prefilter_used": int(counters.get("ann.prefilter_used", 0)),
+            "fallback_exact": int(counters.get("ann.fallback_exact", 0)),
+            "gate_ok": int(counters.get("ann.gate_ok", 0)),
+            "disabled_unexplained": int(counters.get(
+                "ann.disabled_unexplained", 0)),
+            "artifact_hits": int(counters.get("ann.artifact_hits", 0)),
+            "artifacts_built": int(counters.get("ann.artifacts_built", 0)),
+            "artifacts_rebuilt": int(counters.get(
+                "ann.artifacts_rebuilt", 0)),
+            "projection_built": int(counters.get(
+                "ann.projection_built", 0)),
+            "quarantined": int(counters.get("ann.quarantined", 0)),
+            "chaos_corruptions": int(counters.get(
+                "ann.chaos_corruptions", 0)),
+            "artifact_write_bytes": int(counters.get(
+                "ann.artifact_write_bytes", 0)),
+            "top_m": gauges.get("ann.top_m"),
+            "proj_dims": gauges.get("ann.proj_dims"),
+            # each gate verdict, in order (one per device class+strategy)
+            "gates": [{k: r[k] for k in
+                       ("device", "strategy", "ok", "mismatches",
+                        "unexplained") if k in r} for r in gate_recs],
+            # each level's prefilter engagement, in order
+            "engagements": [{k: r[k] for k in
+                             ("level", "strategy", "source", "top_m",
+                              "proj_dims", "db_rows") if k in r}
+                            for r in engage_recs],
+        }
+
     return {
         "manifest": manifest,
         "run_end": run_end,
@@ -447,6 +487,7 @@ def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "pipeline": pipeline_info,
         "serve": serve_info,
         "batch": batch_info,
+        "ann": ann_info,
         "catalog": catalog_info,
         "router": router_info,
         "slo": slo_info,
@@ -518,7 +559,8 @@ def render(an: Dict[str, Any], run_id: Optional[str] = None) -> str:
             if k not in shown and v
             and not k.startswith(("serve.", "chaos.", "watchdog.",
                                   "ckpt.", "retry.", "pipeline.",
-                                  "router.", "batch.", "catalog."))}
+                                  "router.", "batch.", "catalog.",
+                                  "ann."))}
     for k in sorted(rest):
         w(f"    {k:<13} {rest[k]:g}")
 
@@ -653,6 +695,32 @@ def render(an: Dict[str, Any], run_id: Optional[str] = None) -> str:
             w(f"    placed        {pf.get('style', '?')} -> "
               f"{pf.get('worker', '?')} ({pf.get('entries', 0)} entries, "
               f"{_fmt_bytes(pf.get('bytes', 0))})")
+
+    ann = an.get("ann")
+    if ann:
+        w("  ann matcher:")
+        knobs = ""
+        if ann["top_m"] is not None:
+            knobs = (f" (top_m={int(ann['top_m'])}, "
+                     f"proj_dims={int(ann['proj_dims'] or 0)})")
+        w(f"    two-stage     {ann['prefilter_used']} levels prefiltered "
+          f"/ {ann['fallback_exact']} exact fallbacks{knobs}")
+        if ann["gate_ok"] or ann["disabled_unexplained"]:
+            w(f"    parity gate   {ann['gate_ok']} ok / "
+              f"{ann['disabled_unexplained']} refused "
+              "(unexplained divergence)")
+        sealed = ann["artifacts_built"] + ann["artifacts_rebuilt"]
+        w(f"    bases         {ann['artifact_hits']} artifact hits / "
+          f"{ann['projection_built']} device builds / {sealed} sealed "
+          f"({_fmt_bytes(ann['artifact_write_bytes'])})")
+        if ann["quarantined"] or ann["chaos_corruptions"]:
+            w(f"    integrity     {ann['quarantined']} artifacts "
+              f"quarantined, {ann['chaos_corruptions']} chaos corruptions")
+        for g in ann["gates"]:
+            w(f"    gate          {g.get('device', '?')} "
+              f"{'ok' if g.get('ok') else 'REFUSED'} "
+              f"(mismatches={g.get('mismatches', '?')}, "
+              f"unexplained={g.get('unexplained', '?')})")
 
     rt = an.get("router")
     if rt:

@@ -1031,3 +1031,61 @@ def argmin_l2(queries, db, db_sqnorm, *, force_xla: bool = False,
     if force_xla or jax.default_backend() != "tpu":
         return xla_argmin_l2(queries, db, db_sqnorm)
     return pallas_argmin_l2(queries, db, db_sqnorm, precision=precision)
+
+
+# ----------------------------------------------------------------------
+# Two-stage ANN matcher (sub-linear candidate search, ROADMAP item 3).
+#
+# Stage 1 scores every DB row in a Kp-dim PCA subspace (Kp << F, so the
+# prefilter matmul is ~F/Kp cheaper than an exact scan) and keeps the
+# top-m candidates per query; stage 2 gathers that (M, m) slab and
+# re-scores it with the SAME exact-fp32 distance the one-stage matcher
+# uses.  Both stages are plain jnp on purpose: the slab shapes (m is 64
+# by default) are far below the Pallas tiling quanta, XLA fuses the
+# gather + re-score fine, and the same program runs on the CPU tier-1
+# platform where the Pallas kernels are unavailable.
+
+
+def ann_topm_candidates(queries, proj, mean, dbp, dbp_halfnorm, n_valid,
+                        top_m: int):
+    """Stage 1: the top-``top_m`` candidate rows per query, by projected
+    distance.
+
+    ``proj`` is the (F, Kp) catalog-sealed PCA basis, ``mean`` the (F,)
+    feature column mean it was centered on, ``dbp`` the pre-projected
+    (Npad, Kp) DB and ``dbp_halfnorm`` its (Npad,) half squared norms.
+    Scoring uses  -0.5*||dbp_n - qp||^2 = qp.dbp_n - 0.5||dbp_n||^2 +
+    const  so one (M, Npad) matmul ranks all rows (bigger = closer); the
+    query norm constant cannot change the per-query ordering and is
+    dropped.  Rows at or past ``n_valid`` (shape-bucket padding — which
+    projects to FINITE scores, zero rows are near the feature mean) are
+    masked to -inf before the top-k; ``n_valid`` may be a traced scalar.
+    Returns (M, m) int32 candidate indices clamped into [0, n_valid) so
+    a gather through them never reads a padding row."""
+    m_sel = max(1, min(int(top_m), dbp.shape[0]))
+    qp = jnp.dot(queries - mean[None, :queries.shape[1]], proj,
+                 preferred_element_type=_F32)
+    scores = jnp.dot(qp, dbp.T, preferred_element_type=_F32) \
+        - dbp_halfnorm[None, :]
+    row = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+    scores = jnp.where(row < n_valid, scores, -jnp.inf)
+    _, cand = jax.lax.top_k(scores, m_sel)
+    return jnp.minimum(cand, n_valid - 1).astype(jnp.int32)
+
+
+def ann_rescore_slab(queries, db, cand, n_valid):
+    """Stage 2: exact-fp32 re-score of the candidate slab.
+
+    Gathers ``db[cand]`` ((M, m, F)) and computes true squared
+    distances directly (no matmul trick — the slab is tiny and the
+    difference form is exactly the one-stage scorer's d >= 0 contract).
+    The winner uses the one-stage tie rule: among candidates at the
+    minimum distance, the LOWEST DB index wins — a min over indices
+    masked to the tie set, which also collapses the duplicate indices
+    the stage-1 clamp can produce.  Returns (idx (M,) int32, d (M,))."""
+    cf = db[cand]
+    diff = cf - queries[:, None, :]
+    d = jnp.sum(diff * diff, axis=-1)
+    bv = jnp.min(d, axis=1)
+    bi = jnp.min(jnp.where(d <= bv[:, None], cand, n_valid), axis=1)
+    return bi.astype(jnp.int32), bv

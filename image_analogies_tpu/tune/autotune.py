@@ -31,6 +31,12 @@ from image_analogies_tpu.tune import store as _store
 # grid, now measured per device class instead of frozen).
 PACKED_TILE_CANDIDATES = (4096, 8192, 16384, 32768)
 
+# Candidate slab sizes for the two-stage ANN matcher (`ia tune --knob
+# ann`).  NOT part of the "all" sweep: an ANN sweep runs full synthesis
+# pairs + tie audits (minutes, and it probes the parity gate), while
+# "all" is the cheap kernel-geometry sweep operators run casually.
+ANN_TOP_M_CANDIDATES = (16, 32, 64, 128)
+
 
 def _argmin_candidates(fp: int) -> List[int]:
     base = _geometry.default_tile_rows(fp)
@@ -83,6 +89,24 @@ def build_plan(*, knob: str = "all", rows: int = 262144, f: int = 253,
             "candidates": cands,
             "shape": {"npad": npad, "fp": fp, "m": m},
         })
+    if knob == "ann":
+        cands = sorted(set(int(c) for c in (candidates or
+                                            ANN_TOP_M_CANDIDATES)))
+        if any(c < 1 for c in cands):
+            raise ValueError(
+                f"ann candidates must be positive slab sizes, got {cands}")
+        sweeps.append({
+            "knob": "ann_top_m",
+            "kernel": "two_stage",
+            # the canonical ANN key: slab size is a candidate COUNT, not
+            # a tile shape, so every call site resolves it at the
+            # wrapper defaults (wavefront|f32|f128) and one wildcard row
+            # covers both strategies and every feature width
+            "store_key": _resolve.make_key(device, "wavefront", "f32",
+                                           128, "*"),
+            "candidates": cands,
+            "shape": {"size": 32, "levels": 2},
+        })
     if not sweeps:
         raise ValueError(f"unknown tune knob {knob!r}")
     return {"device_kind": device, "reps": int(reps),
@@ -105,6 +129,50 @@ def _time_call(fn, reps: int, **attrs) -> float:
     return best
 
 
+def _run_ann_sweep(sweep: Dict[str, Any], reps: int) -> Dict[str, Any]:
+    """Sweep ann_top_m with FULL two-stage syntheses, one per candidate,
+    each audited against an exact run.  Persistence criterion (ISSUE 13):
+    only candidates whose audited first divergence is a tie (and whose
+    mismatches are fully explained) may become the champion — a fast slab
+    that loses parity is reported but never stored."""
+    from image_analogies_tpu.backends import tpu as _tpu
+    from image_analogies_tpu.models.analogy import create_image_analogy
+    from image_analogies_tpu.utils.parity import audit_source_map_mismatches
+
+    shape = sweep["shape"]
+    a, ap, b = _tpu._bf16_probe_pair(shape["size"])
+    base = _tpu._probe_base_params(levels=shape["levels"],
+                                   strategy="wavefront")
+    exact = create_image_analogy(a, ap, b, base, keep_levels=True)
+    results: List[Dict[str, Any]] = []
+    for cand in sweep["candidates"]:
+        with _resolve.override(ann_top_m=cand), _tpu.ann_gate_bypass():
+            ann_params = base.replace(ann_prefilter=True)
+            run = lambda: create_image_analogy(a, ap, b, ann_params,
+                                               keep_levels=True)
+            res = run()  # warmup/compile outside timing
+            best = float("inf")
+            for _ in range(max(reps, 1)):
+                with _trace.span("tune.candidate", knob="ann_top_m",
+                                 candidate=cand):
+                    t0 = time.perf_counter()
+                    res = run()
+                    best = min(best, (time.perf_counter() - t0) * 1e3)
+        audit = audit_source_map_mismatches(a, ap, b, base, res.levels,
+                                            exact.levels)
+        tie_ok = (audit["unexplained"] == 0
+                  and audit["first_divergence_is_tie"] is not False)
+        results.append({"candidate": cand, "ms": round(best, 3),
+                        "tie_ok": tie_ok,
+                        "explained": audit["mismatch_explained_by_ties"]})
+    clean = [r for r in results if r["tie_ok"]]
+    best = min(clean, key=lambda r: r["ms"]) if clean else None
+    return {"knob": sweep["knob"], "store_key": sweep["store_key"],
+            "results": results, "verified": bool(clean),
+            "winner": best["candidate"] if best else None,
+            "winner_ms": best["ms"] if best else None}
+
+
 def _run_sweep(sweep: Dict[str, Any], reps: int,
                interpret: bool) -> Dict[str, Any]:
     import jax.numpy as jnp
@@ -113,6 +181,9 @@ def _run_sweep(sweep: Dict[str, Any], reps: int,
         pallas_argmin_l2_prepadded,
         packed2k_best,
     )
+
+    if sweep["kernel"] == "two_stage":
+        return _run_ann_sweep(sweep, reps)
 
     rng = np.random.RandomState(0)
     shape = sweep["shape"]

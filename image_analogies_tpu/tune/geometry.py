@@ -42,6 +42,16 @@ DEFAULT_WAVEFRONT_MAX_ROWS = WAVEFRONT_MAX_ROWS_CEILING
 # the pathological just-past-a-bucket-edge shapes.
 DEFAULT_BATCH_PAD_WASTE = 25
 
+# Two-stage ANN matcher knobs (ROADMAP item 3): the prefilter selects a
+# top-m candidate slab per query from PCA-projected distances, then the
+# exact-f32 scorer re-scores only the slab.  64 keeps recall high enough
+# that divergences from exact stay inside the tie-audit's resolution
+# band at probe sizes while still pruning >90% of large DBs; 32
+# projection dims capture essentially all variance of the ~30-200-wide
+# patch feature vectors (texture features are low-rank).
+DEFAULT_ANN_TOP_M = 64
+DEFAULT_ANN_PROJ_DIMS = 32
+
 
 def round_up(n: int, m: int) -> int:
     return -(-n // m) * m

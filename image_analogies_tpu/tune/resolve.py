@@ -12,7 +12,8 @@ shape-bucket)`` with per-knob precedence:
   :func:`override` so the swept value flows through the SAME call sites
   production uses.
 - **env**: ``IA_TILE_ROWS`` / ``IA_PACKED_TILE`` / ``IA_PACKED_VMEM`` /
-  ``IA_WAVEFRONT_ROWS`` / ``IA_BATCH_PAD_WASTE``, parsed at CALL time
+  ``IA_WAVEFRONT_ROWS`` / ``IA_BATCH_PAD_WASTE`` / ``IA_ANN_TOP_M`` /
+  ``IA_ANN_PROJ_DIMS``, parsed at CALL time
   (the legacy module-import
   read silently ignored later changes); invalid values warn once and are
   ignored.
@@ -60,6 +61,8 @@ _ENV_VARS = {
     "packed_vmem_limit": "IA_PACKED_VMEM",
     "wavefront_max_rows": "IA_WAVEFRONT_ROWS",
     "batch_pad_waste_pct": "IA_BATCH_PAD_WASTE",
+    "ann_top_m": "IA_ANN_TOP_M",
+    "ann_proj_dims": "IA_ANN_PROJ_DIMS",
 }
 
 _TLS = threading.local()  # .overrides: Dict[str, int] while tuner active
@@ -89,6 +92,10 @@ class TuneConfig:
     # Batched engine admission knob, not a kernel shape: max query-row
     # pad waste (percent of the bucket) before a lane refuses batching.
     batch_pad_waste_pct: int = _geometry.DEFAULT_BATCH_PAD_WASTE
+    # Two-stage ANN matcher knobs: candidate slab size per query and the
+    # PCA projection rank the prefilter scores against.
+    ann_top_m: int = _geometry.DEFAULT_ANN_TOP_M
+    ann_proj_dims: int = _geometry.DEFAULT_ANN_PROJ_DIMS
 
     def origin_of(self, knob: str) -> str:
         return dict(self.origin).get(knob, "default")
@@ -192,6 +199,8 @@ def _record(cfg: TuneConfig, fp: int, bucket: int) -> None:
                 "packed_vmem_limit": cfg.packed_vmem_limit,
                 "wavefront_max_rows": cfg.wavefront_max_rows,
                 "batch_pad_waste_pct": cfg.batch_pad_waste_pct,
+                "ann_top_m": cfg.ann_top_m,
+                "ann_proj_dims": cfg.ann_proj_dims,
                 "origin": origins,
             }
     if _metrics._ACTIVE:
@@ -212,6 +221,8 @@ def _record(cfg: TuneConfig, fp: int, bucket: int) -> None:
                            "packed_vmem_limit": cfg.packed_vmem_limit,
                            "wavefront_max_rows": cfg.wavefront_max_rows,
                            "batch_pad_waste_pct": cfg.batch_pad_waste_pct,
+                           "ann_top_m": cfg.ann_top_m,
+                           "ann_proj_dims": cfg.ann_proj_dims,
                            "origin": origins, "fp": fp, "bucket": bucket},
                           ctx.log_path)
 
@@ -256,6 +267,8 @@ def resolve(*, strategy: str, dtype: str, fp: int, n_rows: int = 0,
         "packed_vmem_limit": _geometry.DEFAULT_PACKED_VMEM_LIMIT,
         "wavefront_max_rows": _geometry.DEFAULT_WAVEFRONT_MAX_ROWS,
         "batch_pad_waste_pct": _geometry.DEFAULT_BATCH_PAD_WASTE,
+        "ann_top_m": _geometry.DEFAULT_ANN_TOP_M,
+        "ann_proj_dims": _geometry.DEFAULT_ANN_PROJ_DIMS,
     }
     values: Dict[str, int] = {}
     origin: Dict[str, str] = {}
@@ -349,6 +362,29 @@ def batch_pad_waste_pct(*, strategy: str = "batched", dtype: str = "f32",
     cfg = resolve(strategy=strategy, dtype=_norm_dtype(dtype), fp=fp,
                   n_rows=n_rows, store=store)
     return cfg.batch_pad_waste_pct
+
+
+def ann_top_m(*, strategy: str = "wavefront", dtype: str = "f32",
+              fp: int = 128, n_rows: int = 0,
+              store: Optional[str] = None) -> int:
+    """Candidate-slab size for the two-stage ANN matcher
+    (``IA_ANN_TOP_M``): how many prefilter survivors the exact-f32
+    re-score walks per query.  Never a hard-coded call-site constant —
+    the grep-lock on slab geometry pins every consumer to this funnel."""
+    cfg = resolve(strategy=strategy, dtype=_norm_dtype(dtype), fp=fp,
+                  n_rows=n_rows, store=store)
+    return cfg.ann_top_m
+
+
+def ann_proj_dims(*, strategy: str = "wavefront", dtype: str = "f32",
+                  fp: int = 128, n_rows: int = 0,
+                  store: Optional[str] = None) -> int:
+    """PCA projection rank the ANN prefilter scores against
+    (``IA_ANN_PROJ_DIMS``); catalog/build.py resolves it when sealing
+    projection artifacts so build-time and request-time agree."""
+    cfg = resolve(strategy=strategy, dtype=_norm_dtype(dtype), fp=fp,
+                  n_rows=n_rows, store=store)
+    return cfg.ann_proj_dims
 
 
 def scan_tile(npad: int, fp: int, cap_rows: int = 0, *,

@@ -39,7 +39,7 @@ SCHEMA_VERSION = 1
 # Integer knobs an entry may carry; each must be a positive int when
 # present.  Unknown keys are allowed (provenance annotations).
 _KNOBS = ("tile_rows", "packed_tile_cap", "packed_vmem_limit",
-          "wavefront_max_rows")
+          "wavefront_max_rows", "ann_top_m", "ann_proj_dims")
 
 _LOCK = threading.Lock()
 # path -> ((mtime_ns, size), entries)

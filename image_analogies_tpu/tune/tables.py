@@ -34,23 +34,31 @@ _MIB = 2 ** 20
 TABLES: Dict[str, Dict[str, Dict[str, int]]] = {
     "v4": {
         # Reference class: the legacy defaults WERE the v4 sweep winners.
+        # ANN slab: v4's MXU amortizes the slab gather well — the default
+        # 64-candidate slab holds.
         "*": {"packed_tile_cap": 16384, "packed_vmem_limit": 110 * _MIB,
-              "wavefront_max_rows": 1 << 24, "batch_pad_waste_pct": 25},
+              "wavefront_max_rows": 1 << 24, "batch_pad_waste_pct": 25,
+              "ann_top_m": 64, "ann_proj_dims": 32},
     },
     "v5e": {
         # 128 MiB VMEM (see pallas guide) but a narrower core than v4:
         # leave more compiler headroom and keep scan tiles smaller.
         # Narrower core also means pad-row FLOPs hurt more, so the
         # batched engine's waste ceiling is tighter than on v4/v5p.
+        # ANN slab: the narrow core pays more per re-scored candidate, so
+        # the slab is half the v4 default (recall guarded by the gate).
         "*": {"packed_tile_cap": 8192, "packed_vmem_limit": 96 * _MIB,
-              "wavefront_max_rows": 1 << 24, "batch_pad_waste_pct": 20},
+              "wavefront_max_rows": 1 << 24, "batch_pad_waste_pct": 20,
+              "ann_top_m": 32, "ann_proj_dims": 32},
         "wavefront|bf16": {"tile_rows": 2048},
     },
     "v5p": {
         # More VMEM headroom + HBM bandwidth: larger tiles amortize the
-        # per-grid-step overhead better.
+        # per-grid-step overhead better.  ANN slab: bandwidth to spare —
+        # a wider slab buys recall at near-zero marginal cost.
         "*": {"packed_tile_cap": 32768, "packed_vmem_limit": 120 * _MIB,
-              "wavefront_max_rows": 1 << 24, "batch_pad_waste_pct": 25},
+              "wavefront_max_rows": 1 << 24, "batch_pad_waste_pct": 25,
+              "ann_top_m": 128, "ann_proj_dims": 32},
         "wavefront|bf16": {"tile_rows": 8192},
     },
 }

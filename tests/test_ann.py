@@ -1,0 +1,331 @@
+"""Two-stage ANN matcher (ISSUE 13): PCA prefilter + exact-f32 re-score.
+
+Tier-1 invariants locked here:
+
+- parity: a two-stage synthesis vs the exact matcher at 32^2/64^2
+  (wavefront) and 32^2 (batched) leaves every source-map mismatch
+  tie-explained (utils/parity.py audit) and the output planes value-
+  matching — the same theorem discipline as tests/test_parity_audit.py;
+- the parity gate probes ONCE per (device class, strategy), caches a
+  refusal, and a refused gate leaves synthesis bit-identical to the
+  exact engine (``ann.fallback_exact``, never ``ann.prefilter_used``);
+- sealed artifacts (catalog/ann.py): save/load roundtrip is bit-exact,
+  rebuilding from the same bytes is deterministic, damage (flipped
+  byte, stored-key mismatch) quarantines as ``.corrupt`` and returns
+  None instead of poisoned state;
+- the slab/rank knobs resolve through tune/ (env ``IA_ANN_TOP_M`` /
+  ``IA_ANN_PROJ_DIMS``, tuner ``override`` above env), and the
+  adversarial ``ann_top_m=1`` floor still synthesizes valid output;
+- ``ia catalog build`` seals one ``_ann/`` basis per level and the next
+  prefiltered request resolves them (``ann.artifact_hits``) instead of
+  paying the eigendecomposition (``ann.projection_built`` absent);
+- ``ia bench --check``'s exemplar-scaling gates: the absolute
+  sub-linearity gate needs no archive floor, legacy archives record
+  only, and the relative floor gate fails a regressed ratio.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import bench
+from examples.make_assets import make_structured
+from image_analogies_tpu import cli
+from image_analogies_tpu.backends import tpu
+from image_analogies_tpu.catalog import ann as catalog_ann
+from image_analogies_tpu.catalog import build as catalog_build
+from image_analogies_tpu.catalog import tiers
+from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.models.analogy import create_image_analogy
+from image_analogies_tpu.obs import trace as obs_trace
+from image_analogies_tpu.tune import geometry
+from image_analogies_tpu.tune import resolve as tune
+from image_analogies_tpu.utils.parity import audit_source_map_mismatches
+
+
+@pytest.fixture(autouse=True)
+def _clean_ann_state(monkeypatch, tmp_path):
+    """Gate verdicts and memory tiers are process-global by design;
+    tests must never leak a cached verdict, a configured catalog root,
+    or a developer store/env into the suite."""
+    for var in ("IA_ANN_TOP_M", "IA_ANN_PROJ_DIMS"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("IA_TUNE_STORE", str(tmp_path / "no_store.json"))
+    tpu.reset_ann_gate()
+    tiers.clear()
+    tiers.configure(None)
+    yield
+    tpu.reset_ann_gate()
+    tiers.clear()
+    tiers.configure(None)
+
+
+def _inputs(size=20, seed=7):
+    rng = np.random.RandomState(seed)
+    return (rng.rand(size, size).astype(np.float32),
+            rng.rand(size, size).astype(np.float32),
+            rng.rand(size, size).astype(np.float32))
+
+
+def _params(**kw):
+    base = dict(backend="tpu", strategy="wavefront", levels=2,
+                patch_size=3, coarse_patch_size=3, metrics=True)
+    base.update(kw)
+    return AnalogyParams(**base)
+
+
+_OK_VERDICT = {"ok": True, "mismatches": 0, "unexplained": 0,
+               "first_divergence_is_tie": None}
+_REFUSED_VERDICT = {"ok": False, "mismatches": 3, "unexplained": 3,
+                    "first_divergence_is_tie": False}
+
+
+# ------------------------------------------------------ config surface
+
+
+def test_ann_prefilter_param_validation():
+    with pytest.raises(ValueError, match="ann_prefilter"):
+        AnalogyParams(backend="cpu", ann_prefilter=True)
+    with pytest.raises(ValueError, match="ann_prefilter"):
+        AnalogyParams(backend="tpu", strategy="exact", ann_prefilter=True)
+    for s in ("wavefront", "batched", "auto"):
+        AnalogyParams(backend="tpu", strategy=s, ann_prefilter=True)
+
+
+# ------------------------------------------------------- parity audits
+
+
+@pytest.mark.parametrize("strategy,size", [("wavefront", 32),
+                                           ("wavefront", 64),
+                                           ("batched", 32)])
+def test_two_stage_parity_audit(strategy, size):
+    """The support theorem behind the gate: every pick the two-stage
+    matcher makes differently from the exact engine is an exact or
+    fp32-resolution tie (gate bypassed — the gate's own probe is the
+    production copy of this test)."""
+    a, ap, b = make_structured(size, seed=5)
+    p = AnalogyParams(levels=2, kappa=5.0, backend="tpu",
+                      strategy=strategy, patch_size=3,
+                      coarse_patch_size=3)
+    exact = create_image_analogy(a, ap, b, p, keep_levels=True)
+    with tpu.ann_gate_bypass():
+        two = create_image_analogy(a, ap, b,
+                                   p.replace(ann_prefilter=True),
+                                   keep_levels=True)
+    audit = audit_source_map_mismatches(a, ap, b, p, two.levels,
+                                        exact.levels)
+    assert audit["unexplained"] == 0, audit
+    match = float((np.asarray(exact.bp_y) == np.asarray(two.bp_y)).mean())
+    assert match >= 0.99, match
+
+
+def test_off_means_bit_identical():
+    """Acceptance: ann_prefilter (default False) leaves the engine
+    byte-for-byte the exact matcher."""
+    a, ap, b = _inputs()
+    x = np.asarray(create_image_analogy(a, ap, b, _params()).bp)
+    y = np.asarray(create_image_analogy(
+        a, ap, b, _params(ann_prefilter=False)).bp)
+    assert np.array_equal(x, y)
+
+
+# ----------------------------------------------------- parity gate
+
+
+def test_gate_refusal_caches_and_stays_exact(monkeypatch):
+    """A refused verdict is probed once, cached per (device, strategy),
+    and every synthesis silently keeps the exact matcher."""
+    calls = []
+
+    def fake_verdict(params, strategy):
+        calls.append(strategy)
+        return dict(_REFUSED_VERDICT)
+
+    monkeypatch.setattr(tpu, "_ann_probe_verdict", fake_verdict)
+    tpu.reset_ann_gate()
+    a, ap, b = _inputs()
+    ref = np.asarray(create_image_analogy(a, ap, b, _params()).bp)
+    p = _params(ann_prefilter=True)
+    with obs_trace.run_scope(p) as ctx:
+        out1 = np.asarray(create_image_analogy(a, ap, b, p).bp)
+        out2 = np.asarray(create_image_analogy(a, ap, b, p).bp)
+    c = ctx.registry.snapshot()["counters"]
+    assert calls == ["wavefront"]  # second run hits the cached refusal
+    assert np.array_equal(out1, ref) and np.array_equal(out2, ref)
+    assert c["ann.disabled_unexplained"] == 1
+    assert c["ann.fallback_exact"] >= 4  # two levels x two runs
+    assert "ann.prefilter_used" not in c
+
+
+def test_gate_ok_engages_prefilter_per_level(monkeypatch):
+    monkeypatch.setattr(tpu, "_ann_probe_verdict",
+                        lambda p, s: dict(_OK_VERDICT))
+    tpu.reset_ann_gate()
+    a, ap, b = _inputs()
+    p = _params(ann_prefilter=True)
+    with obs_trace.run_scope(p) as ctx:
+        out = np.asarray(create_image_analogy(a, ap, b, p).bp)
+    c = ctx.registry.snapshot()["counters"]
+    gauges = ctx.registry.snapshot()["gauges"]
+    assert c["ann.gate_ok"] == 1
+    assert c["ann.prefilter_used"] == 2  # one per level
+    assert c["ann.projection_built"] == 2  # no catalog root: on-the-fly
+    assert gauges["ann.top_m"] == tune.ann_top_m()
+    assert out.shape == b.shape and np.isfinite(out).all()
+
+
+# ------------------------------------------------- sealed artifacts
+
+
+def test_artifact_roundtrip_and_determinism(tmp_path):
+    rng = np.random.RandomState(0)
+    db = rng.rand(200, 37).astype(np.float32)
+    m1, p1 = catalog_ann.build_projection(db, 8)
+    m2, p2 = catalog_ann.build_projection(db, 8)
+    assert np.array_equal(m1, m2) and np.array_equal(p1, p2)
+    assert m1.shape == (37,) and p1.shape == (37, 8)
+    path = catalog_ann.save_artifact(str(tmp_path), "feedcafe", m1, p1)
+    assert path == catalog_ann.artifact_path(str(tmp_path), "feedcafe")
+    got = catalog_ann.load_artifact(str(tmp_path), "feedcafe")
+    assert got is not None
+    assert np.array_equal(got[0], m1) and np.array_equal(got[1], p1)
+    # rank clamps to min(dims, F, N) — a tiny DB can't mint a wide basis
+    _, p3 = catalog_ann.build_projection(db[:5], 64)
+    assert p3.shape[1] == 5
+
+
+def test_artifact_damage_quarantines(tmp_path):
+    rng = np.random.RandomState(1)
+    m, p = catalog_ann.build_projection(rng.rand(64, 16), 4)
+    path = catalog_ann.save_artifact(str(tmp_path), "deadbeef", m, p)
+    catalog_ann.damage_artifact(path, seed=3)
+    assert catalog_ann.load_artifact(str(tmp_path), "deadbeef") is None
+    assert not os.path.exists(path)
+    assert os.path.exists(path + ".corrupt")
+    # a second load of the quarantined key is a clean miss, not a crash
+    assert catalog_ann.load_artifact(str(tmp_path), "deadbeef") is None
+    # damaging an absent artifact is a no-op (chaos may fire pre-build)
+    catalog_ann.damage_artifact(
+        catalog_ann.artifact_path(str(tmp_path), "nope"), seed=3)
+
+
+def test_artifact_key_mismatch_reads_as_damage(tmp_path):
+    """Bytes filed under the wrong content key must NOT serve: the seal
+    binds the stored key, so a renamed artifact quarantines."""
+    rng = np.random.RandomState(2)
+    m, p = catalog_ann.build_projection(rng.rand(64, 16), 4)
+    src = catalog_ann.save_artifact(str(tmp_path), "aaaa1111", m, p)
+    dst = catalog_ann.artifact_path(str(tmp_path), "bbbb2222")
+    os.rename(src, dst)
+    assert catalog_ann.load_artifact(str(tmp_path), "bbbb2222") is None
+    assert os.path.exists(dst + ".corrupt")
+
+
+# --------------------------------------------------- tune knob funnel
+
+
+def test_ann_knob_resolution_env_and_override(monkeypatch):
+    assert tune.ann_top_m() == geometry.DEFAULT_ANN_TOP_M
+    assert tune.ann_proj_dims() == geometry.DEFAULT_ANN_PROJ_DIMS
+    monkeypatch.setenv("IA_ANN_TOP_M", "48")
+    monkeypatch.setenv("IA_ANN_PROJ_DIMS", "12")
+    assert tune.ann_top_m() == 48
+    assert tune.ann_proj_dims() == 12
+    with tune.override(ann_top_m=7, ann_proj_dims=5):
+        assert tune.ann_top_m() == 7  # tuner override beats env
+        assert tune.ann_proj_dims() == 5
+    assert tune.ann_top_m() == 48
+    monkeypatch.setenv("IA_ANN_TOP_M", "not-a-number")
+    assert tune.ann_top_m() == geometry.DEFAULT_ANN_TOP_M
+
+
+def test_adversarial_top_m_one():
+    """Slab floor: a single prefilter survivor per query degenerates the
+    re-score to the prefilter's own champion — still a valid synthesis
+    (every pick a real DB row, output drawn from A')."""
+    a, ap, b = make_structured(32, seed=5)
+    p = AnalogyParams(levels=2, kappa=5.0, backend="tpu",
+                      strategy="wavefront", patch_size=3,
+                      coarse_patch_size=3, ann_prefilter=True)
+    with tune.override(ann_top_m=1), tpu.ann_gate_bypass():
+        out = create_image_analogy(a, ap, b, p)
+    bp = np.asarray(out.bp)
+    assert bp.shape == b.shape
+    assert np.isfinite(bp).all()
+    assert bp.min() >= ap.min() - 1e-6 and bp.max() <= ap.max() + 1e-6
+
+
+# ------------------------------------------------ catalog integration
+
+
+def test_catalog_build_seals_bases_and_request_hits(tmp_path,
+                                                    monkeypatch):
+    a, ap, b = _inputs()
+    root = str(tmp_path)
+    p = _params(catalog_dir=root, ann_prefilter=True)
+    res = catalog_build.build_style(a, ap, p, root_dir=root, target=b)
+    sealed = [f for f in os.listdir(os.path.join(root, catalog_ann.ANN_DIR))
+              if f.endswith(".npz")]
+    assert len(sealed) == res["levels"] == 2
+    assert all(e.get("ann_dims") for e in res["entries"])
+    monkeypatch.setattr(tpu, "_ann_probe_verdict",
+                        lambda pp, s: dict(_OK_VERDICT))
+    tpu.reset_ann_gate()
+    with obs_trace.run_scope(p) as ctx:
+        create_image_analogy(a, ap, b, p)
+    c = ctx.registry.snapshot()["counters"]
+    assert c["ann.artifact_hits"] == 2
+    assert c["ann.prefilter_used"] == 2
+    assert "ann.projection_built" not in c  # sealed bases, no eigh
+
+
+# --------------------------------------------- bench gates + CLI seam
+
+
+def test_exemplar_scale_check_gates():
+    legacy = [{"metric_key": "k", "value": 1.0, "file": "BENCH_r1.json"}]
+    with_floor = legacy + [{"metric_key": "k", "value": 1.0,
+                            "file": "BENCH_r2.json",
+                            "exemplar_scale_ratio": 6.0}]
+    # absolute sub-linearity gate fires with no archive floor at all
+    out = bench.check_regression({"points": legacy}, fresh_value=1.0,
+                                 fresh_key="k", fresh_scale=9.4)
+    assert out["ok"] is False
+    assert any("exemplar_scale_not_sublinear" in pr
+               for pr in out["problems"])
+    assert out["exemplar_scale_floor"] is None
+    # legacy archive + sub-linear candidate: recorded only
+    out = bench.check_regression({"points": legacy}, fresh_value=1.0,
+                                 fresh_key="k", fresh_scale=6.3)
+    assert out["ok"] is True
+    assert out["exemplar_scale_ratio"] == 6.3
+    assert out["exemplar_scale_floor"] is None
+    # relative floor gate: 6.0 -> 7.9 is a 31.7% regression
+    out = bench.check_regression({"points": with_floor}, fresh_value=1.0,
+                                 fresh_key="k", fresh_scale=7.9)
+    assert out["ok"] is False
+    assert out["exemplar_scale_floor"] == 6.0
+    assert any("exemplar_scale_ratio regressed" in pr
+               for pr in out["problems"])
+    # within threshold (and under 8x) passes both gates
+    out = bench.check_regression({"points": with_floor}, fresh_value=1.0,
+                                 fresh_key="k", fresh_scale=6.5)
+    assert out["ok"] is True
+
+
+def test_cli_bench_exemplar_scale_flag(monkeypatch, capsys):
+    # cmd_bench imports the repo-root bench.py through its own loader;
+    # stub THAT seam so the flag test never pays a real measurement
+    class _Stub:
+        @staticmethod
+        def measure_exemplar_scaling():
+            return {"exemplar_scale_ratio": 5.0, "max_scale": 16,
+                    "points": []}
+
+    monkeypatch.setattr(cli, "_load_bench_module", lambda: _Stub)
+    rc = cli.main(["bench", "--exemplar-scale"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["exemplar_scale_ratio"] == 5.0

@@ -32,7 +32,7 @@ def _clean_tune_env(monkeypatch, tmp_path):
     """Isolate every test from developer stores and env overrides."""
     for var in ("IA_TILE_ROWS", "IA_PACKED_TILE", "IA_PACKED_VMEM",
                 "IA_WAVEFRONT_ROWS", "IA_SHAPE_BUCKETS",
-                "IA_DEVCACHE_BYTES"):
+                "IA_DEVCACHE_BYTES", "IA_ANN_TOP_M", "IA_ANN_PROJ_DIMS"):
         monkeypatch.delenv(var, raising=False)
     monkeypatch.setenv("IA_TUNE_STORE", str(tmp_path / "no_store.json"))
     tune_store.invalidate_cache()
@@ -382,7 +382,7 @@ def test_no_call_site_reads_legacy_geometry_constants():
     legacy = re.compile(
         r"\b_tile_rows\b|\b_scan_tile\b|\b_packed_tile_cap\b"
         r"|_PACKED_TILE_CAP|_PACKED_VMEM_LIMIT|_ARGMIN_TILE"
-        r"|_WAVEFRONT_MAX_ROWS")
+        r"|_WAVEFRONT_MAX_ROWS|DEFAULT_ANN_TOP_M|DEFAULT_ANN_PROJ_DIMS")
     for path in consumers:
         with open(path) as f:
             src = f.read()
@@ -463,6 +463,22 @@ def test_autotune_dry_run_cli(capsys):
         assert s["candidates"] and s["store_key"].endswith("|b*")
         npad = s["shape"]["npad"]
         assert all(npad % c == 0 for c in s["candidates"])
+
+
+def test_autotune_dry_run_ann_knob(capsys):
+    """`ia tune --knob ann` plans the two-stage slab sweep — and stays
+    OUT of the default plan above (a full-synthesis sweep is not the
+    casual kernel-geometry pass)."""
+    from image_analogies_tpu import cli
+
+    rc = cli.main(["tune", "--dry-run", "--knob", "ann"])
+    assert rc == 0
+    plan = json.loads(capsys.readouterr().out)
+    (sweep,) = plan["sweeps"]
+    assert sweep["knob"] == "ann_top_m"
+    assert sweep["kernel"] == "two_stage"
+    assert tuple(sweep["candidates"]) == autotune.ANN_TOP_M_CANDIDATES
+    assert sweep["store_key"].endswith("|b*")
 
 
 def test_autotune_rejects_bad_candidates():
