@@ -119,6 +119,12 @@ def extract_headline(doc: dict):
         if obj.get("exemplar_scale_ratio") is not None:
             out["exemplar_scale_ratio"] = float(
                 obj["exemplar_scale_ratio"])
+        # timeline trajectory (PR 14): armed temporal plane (windowed
+        # store + background sampler) vs disarmed at 256^2 — the
+        # always-on cockpit only stays always-on if this stays small
+        if obj.get("timeline_overhead_pct") is not None:
+            out["timeline_overhead_pct"] = float(
+                obj["timeline_overhead_pct"])
         return out
 
     parsed = doc.get("parsed")
@@ -174,7 +180,7 @@ def check_regression(trajectory: dict, fresh_value=None,
                      threshold_pct: float = 20.0,
                      fresh_gap=None, fresh_key=None,
                      fresh_obs=None, fresh_cold=None,
-                     fresh_scale=None) -> dict:
+                     fresh_scale=None, fresh_timeline=None) -> dict:
     """Gate a wall-clock number against the trajectory floor.
 
     With ``fresh_value`` (a just-measured number), it is compared against
@@ -223,6 +229,12 @@ def check_regression(trajectory: dict, fresh_value=None,
     16x the rows cost at least half of linear and the prefilter has
     stopped paying for itself, which fails regardless of what the
     archive says (``exemplar_scale_not_sublinear``).
+
+    ``timeline_overhead_pct`` (armed temporal plane — windowed store +
+    background sampler — vs disarmed at 256^2, PR 14) rides via
+    ``fresh_timeline`` with the same ABSOLUTE percentage-points gate
+    as ``obs_overhead_pct``; archives from rounds before the timeline
+    existed carry no floor, so the first point records without gating.
     """
     points = trajectory.get("points") or []
     problems = list(trajectory.get("problems", []))
@@ -248,6 +260,7 @@ def check_regression(trajectory: dict, fresh_value=None,
         cand_obs = fresh_obs
         cand_cold = fresh_cold
         cand_scale = fresh_scale
+        cand_timeline = fresh_timeline
         prior = same
         floor = min(p["value"] for p in same)
     else:
@@ -259,6 +272,7 @@ def check_regression(trajectory: dict, fresh_value=None,
         cand_obs = latest.get("obs_overhead_pct")
         cand_cold = latest.get("cold_start_ms")
         cand_scale = latest.get("exemplar_scale_ratio")
+        cand_timeline = latest.get("timeline_overhead_pct")
         prior = same[:-1]
         if not prior:
             return {"ok": True, "reason": "single_point",
@@ -353,6 +367,27 @@ def check_regression(trajectory: dict, fresh_value=None,
             # legacy archives (pre-ANN rounds) carry no floor: the
             # relative gate records only; the absolute gate above ran
             out["exemplar_scale_floor"] = None
+    prior_timelines = [p["timeline_overhead_pct"] for p in prior
+                       if p.get("timeline_overhead_pct") is not None]
+    if cand_timeline is not None and prior_timelines:
+        tl_floor = min(prior_timelines)
+        # already a percentage — gate in absolute points, like the obs
+        # overhead above (a relative gate on a near-zero floor flaps)
+        tl_delta = float(cand_timeline) - tl_floor
+        out["timeline_overhead_pct"] = float(cand_timeline)
+        out["timeline_overhead_floor"] = tl_floor
+        out["timeline_overhead_delta_pts"] = round(tl_delta, 2)
+        if tl_delta > threshold_pct:
+            out["ok"] = False
+            problems.append(
+                f"timeline_overhead_pct grew {tl_delta:.1f} points past "
+                f"the {tl_floor:.1f}% floor "
+                f"(candidate {cand_timeline:.1f}%)")
+    elif cand_timeline is not None:
+        # legacy archives (pre-timeline rounds) carry no floor: record
+        # the point without gating, same posture as cold_start_ms
+        out["timeline_overhead_pct"] = float(cand_timeline)
+        out["timeline_overhead_floor"] = None
     return out
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -486,6 +521,49 @@ def _measure_obs_overhead(a, ap, b, p, reps=3):
         "obs_overhead_pct": round((on - off) / off * 100.0, 2),
         "instrumented_s": round(on, 3),
         "disabled_s": round(off, 3),
+        "reps": reps,
+    }
+
+
+def _measure_timeline_overhead(a, ap, b, p, reps=3):
+    """Wall-clock cost of the ARMED temporal plane at one 256^2
+    synthesis.  Both arms carry a metrics-bearing run scope — the obs
+    cost itself is already gated by ``obs_overhead_pct``; this isolates
+    what the timeline adds ON TOP: an armed process :class:`Timeline`
+    with a live background sampler folding registry snapshots into
+    windows mid-synthesis.  Headline ``timeline_overhead_pct`` rides
+    the archive and ``ia bench --check`` gates it in percentage points
+    (legacy archives carry no floor, so the first point records only).
+    """
+    from image_analogies_tpu.models.analogy import create_image_analogy
+    from image_analogies_tpu.obs import timeline as obs_timeline
+    from image_analogies_tpu.obs import trace as obs_trace
+
+    p_on = p.replace(metrics=True, log_path=None)
+    create_image_analogy(a, ap, b, p_on)  # shared compile warm-up
+    disarmed = armed = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        with obs_trace.run_scope(p_on):
+            create_image_analogy(a, ap, b, p_on)
+        disarmed = min(disarmed, time.perf_counter() - t0)
+    for _ in range(reps):
+        tl = obs_timeline.arm()
+        # an aggressive sampler interval keeps the armed arm honest:
+        # several snapshot folds land inside every synthesis
+        tl.start_sampler(interval_s=0.05)
+        try:
+            t0 = time.perf_counter()
+            with obs_trace.run_scope(p_on):
+                create_image_analogy(a, ap, b, p_on)
+            armed = min(armed, time.perf_counter() - t0)
+        finally:
+            obs_timeline.disarm()
+    return {
+        "timeline_overhead_pct": round(
+            (armed - disarmed) / disarmed * 100.0, 2),
+        "armed_s": round(armed, 3),
+        "disarmed_s": round(disarmed, 3),
         "reps": reps,
     }
 
@@ -786,6 +864,12 @@ def main() -> int:
     obs_overhead = _measure_obs_overhead(a, ap, b, p)
     configs["obs_overhead_256"] = obs_overhead
 
+    # ---- timeline overhead (PR 14): armed temporal plane (windowed
+    # store + background sampler) vs disarmed, both under a live run
+    # scope — what `ia top`'s always-on cockpit costs at 256^2
+    timeline_overhead = _measure_timeline_overhead(a, ap, b, p)
+    configs["timeline_overhead_256"] = timeline_overhead
+
     # ---- catalog cold start (PR 12): first-request wall at 256^2 with
     # a warm exemplar catalog vs an empty one, on the CPU path the
     # catalog serves; bit-identity between the two runs gates the number
@@ -1023,6 +1107,8 @@ def main() -> int:
         "obs_overhead_pct": obs_overhead["obs_overhead_pct"],
         "cold_start_ms": cold_start["cold_start_ms"],
         "exemplar_scale_ratio": exemplar_scale["exemplar_scale_ratio"],
+        "timeline_overhead_pct":
+            timeline_overhead["timeline_overhead_pct"],
         "vs_baseline": round(oracle_s / ns_s, 1),
         "ssim_vs_oracle": round(ns_ssim, 4),
         "value_match": round(ns_match, 4),

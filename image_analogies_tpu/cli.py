@@ -432,19 +432,26 @@ def cmd_serve(args) -> int:
         print("serve: pass --selftest N or --http PORT", file=sys.stderr)
         return 2
 
+    from image_analogies_tpu.obs import timeline as obs_timeline
     from image_analogies_tpu.serve.http import serve_http
 
     with Server(cfg) as srv:
+        # single-server deployment: arm the temporal plane and run its
+        # own background sampler (the fleet path samples per worker from
+        # its health daemon instead) so /timeline and `ia top` are live
+        tl = obs_timeline.arm()
+        tl.start_sampler(interval_s=1.0)
         httpd = serve_http(srv, args.http)
         print(f"serving on http://127.0.0.1:{args.http} "
-              f"(POST /v1/analogy, GET /healthz, GET /metrics); "
-              f"Ctrl-C to drain+exit")
+              f"(POST /v1/analogy, GET /healthz, GET /metrics, "
+              f"GET /timeline); Ctrl-C to drain+exit")
         try:
             httpd.serve_forever()
         except KeyboardInterrupt:
             pass
         finally:
             httpd.shutdown()
+            obs_timeline.disarm()
     return 0
 
 
@@ -495,7 +502,8 @@ def cmd_fleet(args) -> int:
         httpd = serve_fleet_http(fl, args.http)
         print(f"fleet of {fcfg.size} serving on "
               f"http://127.0.0.1:{args.http} "
-              f"(POST /v1/analogy, GET /healthz); Ctrl-C to drain+exit")
+              f"(POST /v1/analogy, GET /healthz, GET /timeline); "
+              f"Ctrl-C to drain+exit")
         try:
             httpd.serve_forever()
         except KeyboardInterrupt:
@@ -781,6 +789,7 @@ def cmd_bench(args) -> int:
     fresh_obs = None
     fresh_cold = None
     fresh_scale = None
+    fresh_timeline = None
     fresh_key = args.metric_key
     if args.value is not None:
         fresh = args.value
@@ -802,6 +811,8 @@ def cmd_bench(args) -> int:
                 fresh_cold = float(doc["cold_start_ms"])
             if doc.get("exemplar_scale_ratio") is not None:
                 fresh_scale = float(doc["exemplar_scale_ratio"])
+            if doc.get("timeline_overhead_pct") is not None:
+                fresh_timeline = float(doc["timeline_overhead_pct"])
         else:
             head = bench.extract_headline(doc if isinstance(doc, dict)
                                           else {})
@@ -814,6 +825,7 @@ def cmd_bench(args) -> int:
             fresh_obs = head.get("obs_overhead_pct")
             fresh_cold = head.get("cold_start_ms")
             fresh_scale = head.get("exemplar_scale_ratio")
+            fresh_timeline = head.get("timeline_overhead_pct")
             if fresh_key is None:
                 fresh_key = head.get("metric_key")
     verdict = bench.check_regression(trajectory, fresh_value=fresh,
@@ -822,11 +834,57 @@ def cmd_bench(args) -> int:
                                      fresh_key=fresh_key,
                                      fresh_obs=fresh_obs,
                                      fresh_cold=fresh_cold,
-                                     fresh_scale=fresh_scale)
+                                     fresh_scale=fresh_scale,
+                                     fresh_timeline=fresh_timeline)
     print(json.dumps(verdict, sort_keys=True))
     for problem in verdict.get("problems", []):
         print(f"bench: warning: {problem}", file=sys.stderr)
     return 0 if verdict["ok"] else 1
+
+
+def cmd_top(args) -> int:
+    """Live terminal cockpit over a serving front end's ``/timeline``
+    endpoint: QPS, windowed p50/p95, queue depth, breaker states, HBM
+    peak, and anomaly flags per worker (obs/timeline.py renders; this
+    command only fetches and redraws).  ``--once`` prints a single
+    frame and exits — the CI-friendly mode tier-1 drives against a
+    live selftest server."""
+    import time as _time
+    import urllib.error
+    import urllib.request
+
+    from image_analogies_tpu.obs import timeline as obs_timeline
+
+    url = args.url.rstrip("/") + "/timeline"
+    if args.window is not None:
+        url += f"?window={args.window:g}"
+
+    def fetch():
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            return json.loads(resp.read().decode())
+
+    if args.once:
+        try:
+            doc = fetch()
+        except (OSError, ValueError, urllib.error.URLError) as exc:
+            print(f"top: cannot fetch {url}: {exc}", file=sys.stderr)
+            return 2
+        print(obs_timeline.render_cockpit(doc))
+        return 0
+    try:
+        while True:
+            try:
+                frame = obs_timeline.render_cockpit(fetch())
+            except (OSError, ValueError,
+                    urllib.error.URLError) as exc:
+                frame = f"top: cannot fetch {url}: {exc}"
+            # ANSI clear+home, then one full frame: flicker-free enough
+            # for a 1 Hz cockpit without a curses dependency
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            _time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
 
 
 def cmd_trace(args) -> int:
@@ -914,6 +972,23 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("-o", "--out", default="trace.json",
                     help="output trace path (default: trace.json)")
     tr.set_defaults(fn=cmd_trace)
+
+    tp = sub.add_parser("top",
+                        help="live terminal cockpit over a serving front "
+                             "end's /timeline endpoint (QPS, windowed "
+                             "p50/p95, queue depth, breakers, HBM, "
+                             "anomalies per worker)")
+    tp.add_argument("--url", default="http://127.0.0.1:8080",
+                    help="serving front end base URL "
+                         "(default: http://127.0.0.1:8080)")
+    tp.add_argument("--interval", type=float, default=1.0,
+                    help="refresh period in seconds (default: 1.0)")
+    tp.add_argument("--window", type=float, default=None,
+                    help="downsampling tier to read (e.g. 10 or 60; "
+                         "default: the finest)")
+    tp.add_argument("--once", action="store_true",
+                    help="print one frame and exit (CI mode)")
+    tp.set_defaults(fn=cmd_top)
 
     mx = sub.add_parser("metrics",
                         help="Prometheus text exposition of a run log's "

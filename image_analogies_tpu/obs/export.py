@@ -19,6 +19,14 @@ One Chrome ``pid`` per run_id; tids 1/2/3 = host/device/compile, named
 via ``ph=M`` metadata events (which carry ``ts``/``dur`` 0 so every
 event in the file uniformly has ph/ts/pid/tid and dur-or-instant).
 Timestamps are microseconds relative to the earliest event start.
+
+Cross-hop traces: a record carrying a ``trace`` attr (stamped by the
+ambient request context — HTTP front end, router, worker, engine spans
+all share one id via X-IA-Trace / the IAT1 wire frame) is re-homed onto
+a per-trace track (tids from 16 up, named ``trace <id>``), so one
+request's whole journey — even across two isolated worker registries —
+renders as a single horizontal track instead of being scattered over
+the host/serve/device lanes.
 """
 
 from __future__ import annotations
@@ -36,6 +44,10 @@ CHAOS_TID = 5
 
 _TID_NAMES = {HOST_TID: "host", DEVICE_TID: "device", COMPILE_TID: "compile",
               SERVE_TID: "serve", CHAOS_TID: "chaos"}
+
+# Records stamped with a trace id get their own per-trace track; the
+# base leaves room below for future fixed lanes without renumbering.
+TRACE_TID_BASE = 16
 
 # bookkeeping fields that don't belong in an event's args payload
 _DROP_ARGS = ("ts",)
@@ -158,6 +170,7 @@ def to_chrome_trace(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         return pids[rid]
 
     # pass 1: classify + find the earliest start so ts stays small
+    trace_tids: Dict[str, int] = {}
     rows = []
     base = None
     for rec in records:
@@ -165,6 +178,13 @@ def to_chrome_trace(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         if not isinstance(ts, (int, float)):
             continue
         ph, tid, name, dur_ms = _classify(rec)
+        trace_id = rec.get("trace")
+        if isinstance(trace_id, str) and trace_id:
+            # a traced record leaves its kind-lane for the request's own
+            # track — the whole hop chain reads as one horizontal story
+            if trace_id not in trace_tids:
+                trace_tids[trace_id] = TRACE_TID_BASE + len(trace_tids)
+            tid = trace_tids[trace_id]
         start_s = float(ts) - (dur_ms or 0.0) / 1e3 if ph == "X" \
             else float(ts)
         if base is None or start_s < base:
@@ -173,12 +193,16 @@ def to_chrome_trace(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     base = base or 0.0
 
     events: List[Dict[str, Any]] = []
+    trace_tracks = set()  # (pid, tid, trace_id) needing thread_name meta
     for rec, ph, tid, name, dur_ms, start_s in rows:
         args = {k: v for k, v in rec.items() if k not in _DROP_ARGS}
+        pid = pid_of(rec)
+        if tid >= TRACE_TID_BASE:
+            trace_tracks.add((pid, tid, str(rec.get("trace"))))
         event: Dict[str, Any] = {
             "ph": ph,
             "ts": round((start_s - base) * 1e6, 1),  # µs
-            "pid": pid_of(rec),
+            "pid": pid,
             "tid": tid,
             "name": name,
             "args": args,
@@ -200,6 +224,10 @@ def to_chrome_trace(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             meta.append({"ph": "M", "name": "thread_name", "ts": 0,
                          "dur": 0, "pid": pid, "tid": tid,
                          "args": {"name": tname}})
+    for pid, tid, trace_id in sorted(trace_tracks):
+        meta.append({"ph": "M", "name": "thread_name", "ts": 0, "dur": 0,
+                     "pid": pid, "tid": tid,
+                     "args": {"name": f"trace {trace_id}"}})
     return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 
 

@@ -216,10 +216,45 @@ def start_http_server(port: int,
             self.wfile.write(body)
 
         def do_GET(self):  # noqa: N802 - stdlib API
-            if self.path == "/metrics":
-                self._reply(200, render_prometheus(snap_fn()).encode(),
-                            CONTENT_TYPE)
-            elif self.path == "/healthz":
+            import urllib.parse
+
+            parts = urllib.parse.urlsplit(self.path)
+            if parts.path == "/metrics":
+                t0 = time.perf_counter()
+                _metrics.inc("obs.scrape.metrics.total")
+                try:
+                    self._reply(200, render_prometheus(snap_fn()).encode(),
+                                CONTENT_TYPE)
+                except Exception:  # noqa: BLE001 - counted, then raised
+                    _metrics.inc("obs.scrape.errors")
+                    _metrics.inc("obs.scrape.metrics.errors")
+                    raise
+                finally:
+                    _metrics.observe("obs.scrape.metrics.duration_ms",
+                                     (time.perf_counter() - t0) * 1e3)
+            elif parts.path == "/timeline":
+                from image_analogies_tpu.obs import timeline as _timeline
+
+                t0 = time.perf_counter()
+                _metrics.inc("obs.scrape.timeline.total")
+                try:
+                    query = urllib.parse.parse_qs(parts.query)
+                    window = (query.get("window") or [None])[0]
+                    doc = _timeline.snapshot_json(
+                        float(window) if window is not None else None)
+                    self._reply(200, json.dumps(doc).encode(),
+                                "application/json")
+                except (KeyError, ValueError) as exc:
+                    _metrics.inc("obs.scrape.errors")
+                    _metrics.inc("obs.scrape.timeline.errors")
+                    self._reply(400, json.dumps(
+                        {"error": "bad_window",
+                         "detail": str(exc)}).encode(),
+                        "application/json")
+                finally:
+                    _metrics.observe("obs.scrape.timeline.duration_ms",
+                                     (time.perf_counter() - t0) * 1e3)
+            elif parts.path == "/healthz":
                 self._reply(200, json.dumps(hz_fn()).encode(),
                             "application/json")
             else:

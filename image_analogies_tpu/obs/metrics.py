@@ -103,6 +103,42 @@ class Histogram:
                 "buckets": {str(k): v
                             for k, v in sorted(self.buckets.items())}}
 
+    def merge(self, other: "Histogram") -> None:
+        """Fold ``other`` into this histogram so the result equals a
+        single histogram fed the union of both sample sets (count, sum,
+        min, max, and every bucket are all exactly additive — percentile
+        estimates therefore agree too).  Merging an EMPTY other must be
+        a no-op: its min/max sentinels (inf/-inf) would otherwise poison
+        the extremes of a non-empty target."""
+        if not other.count:
+            return
+        if other.min < self.min:
+            self.min = other.min
+        if other.max > self.max:
+            self.max = other.max
+        self.count += other.count
+        self.total += other.total
+        for k, v in other.buckets.items():
+            self.buckets[k] = self.buckets.get(k, 0) + v
+
+    @classmethod
+    def from_summary(cls, summ: Dict) -> "Histogram":
+        """Rebuild a mergeable histogram from a :meth:`summary` dict —
+        the timeline downsampler merges window aggregates that crossed a
+        snapshot boundary as plain dicts.  Tolerates the legacy
+        empty-summary shape (no ``buckets`` key)."""
+        h = cls()
+        count = int(summ.get("count", 0) or 0)
+        if not count:
+            return h
+        h.count = count
+        h.total = float(summ.get("sum", 0.0))
+        h.min = float(summ.get("min", 0.0))
+        h.max = float(summ.get("max", 0.0))
+        h.buckets = {int(k): int(v)
+                     for k, v in (summ.get("buckets") or {}).items()}
+        return h
+
 
 class MetricsRegistry:
     """Thread-safe named counters / gauges / histograms."""

@@ -123,6 +123,11 @@ def dump_current(reason: str,
     ``dump_dir`` was assigned (non-journaled runs have nowhere durable
     to put a black box).  Successful dumps bump ``obs.blackbox.dumps``
     (+ a per-reason counter); failures bump ``obs.blackbox.dump_errors``.
+
+    The calling thread's ambient ``request_context`` attrs (request id,
+    trace id, batch key) are folded into the dump's ``context`` field —
+    a crash dump that cannot say WHICH request it died on is half a
+    black box.  Explicit ``extra`` keys win on collision.
     """
     from image_analogies_tpu.obs import metrics as _metrics
 
@@ -130,6 +135,13 @@ def dump_current(reason: str,
         scope = _metrics.current_scope()
         if scope is None or scope.recorder is None or not scope.dump_dir:
             return None
+        from image_analogies_tpu.obs import trace as _trace_ctx
+
+        ambient = _trace_ctx.context_attrs()
+        if ambient:
+            merged = dict(ambient)
+            merged.update(extra or {})
+            extra = merged
         path = dump(scope.recorder, scope.dump_dir, reason,
                     scope_id=scope.scope_id, extra=extra)
         _metrics.inc("obs.blackbox.dumps")

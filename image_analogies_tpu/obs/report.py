@@ -471,6 +471,33 @@ def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                             for r in engage_recs],
         }
 
+    # --- cross-hop trace section (ambient trace ids on records) -----------
+    # Every record stamped inside a request_context carries the trace id
+    # the HTTP hop adopted (or the router minted); grouping by it shows
+    # each request's whole journey — http -> router -> worker -> engine —
+    # even when the hops wrote to two isolated worker registries.
+    traced = [r for r in records if isinstance(r.get("trace"), str)
+              and r.get("trace")]
+    traces_info: Optional[List[Dict[str, Any]]] = None
+    if traced:
+        by_trace: Dict[str, List[Dict[str, Any]]] = {}
+        for r in traced:
+            by_trace.setdefault(r["trace"], []).append(r)
+        traces_info = []
+        for tid in by_trace:  # insertion order == first-seen order
+            recs = by_trace[tid]
+            traces_info.append({
+                "trace": tid,
+                "records": len(recs),
+                "spans": sum(1 for r in recs if r.get("event") == "span"),
+                "events": sorted({str(r.get("event") or r.get("name")
+                                      or "record") for r in recs}),
+                "workers": sorted({str(r["worker"]) for r in recs
+                                   if r.get("worker")}),
+                "requests": sorted({str(r["request"]) for r in recs
+                                    if r.get("request")}),
+            })
+
     return {
         "manifest": manifest,
         "run_end": run_end,
@@ -491,6 +518,7 @@ def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "catalog": catalog_info,
         "router": router_info,
         "slo": slo_info,
+        "traces": traces_info,
         "journal": journal_info,
         "chaos": chaos_info,
         "hbm": hbm or None,
@@ -766,6 +794,15 @@ def render(an: Dict[str, Any], run_id: Optional[str] = None) -> str:
             w(f"    burn rate     fast {bf if bf is not None else '-'} / "
               f"slow {bs if bs is not None else '-'}  "
               "(1.0 = exactly on budget)")
+
+    trs = an.get("traces")
+    if trs:
+        w("  traces:")
+        for t in trs:
+            w(f"    {t['trace']:<16} {t['records']} records / "
+              f"{t['spans']} spans"
+              f"  workers={','.join(t['workers']) or '-'}"
+              f"  requests={','.join(t['requests']) or '-'}")
 
     jn = an.get("journal")
     if jn:

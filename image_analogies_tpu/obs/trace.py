@@ -123,6 +123,87 @@ def context_attrs() -> Optional[Dict[str, Any]]:
     return getattr(_REQ_CTX, "attrs", None)
 
 
+# --- cross-process trace context ---------------------------------------------
+#
+# The ambient request_context keys that must SURVIVE a process boundary
+# (HTTP hop via the X-IA-Trace header, router->worker hop via the IAF2
+# trace-context frame).  "trace" is the end-to-end trace id shared by
+# every span of one client request; "parent_span" names the hop that
+# forwarded it; "origin_request" pins the id the client saw at admission
+# even when a downstream layer re-mints its own request id.
+
+TRACE_HEADER = "X-IA-Trace"
+TRACE_KEYS = ("trace", "parent_span", "origin_request")
+_TOKEN_OK = frozenset(
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789_-")
+
+
+def mint_trace_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def _token_ok(part: str) -> bool:
+    return 0 < len(part) <= 64 and all(c in _TOKEN_OK for c in part)
+
+
+def parse_trace_header(value: Optional[str]) -> Optional[Dict[str, str]]:
+    """Parse an ``X-IA-Trace`` header: ``trace/parent_span/request``
+    (``-`` marks an absent field).  Returns the context dict or None for
+    anything malformed — a bad header degrades to a fresh trace, never
+    an error."""
+    if not value:
+        return None
+    parts = value.strip().split("/")
+    if len(parts) != 3 or not all(_token_ok(p) for p in parts):
+        return None
+    ctx: Dict[str, str] = {}
+    for key, part in zip(TRACE_KEYS, parts):
+        if part != "-":
+            ctx[key] = part
+    return ctx if "trace" in ctx else None
+
+
+def format_trace_header(ctx: Optional[Dict[str, Any]] = None
+                        ) -> Optional[str]:
+    """Render a trace context (default: the ambient one) as the
+    ``X-IA-Trace`` header value, or None when there is no trace."""
+    if ctx is None:
+        ctx = capture_trace()
+    if not ctx or "trace" not in ctx:
+        return None
+    parts = []
+    for key in TRACE_KEYS:
+        part = str(ctx.get(key, "") or "-")
+        parts.append(part if _token_ok(part) else "-")
+    return "/".join(parts)
+
+
+def capture_trace() -> Optional[Dict[str, str]]:
+    """The portable subset of the ambient request attrs — what a hop
+    serializes before handing the request to another registry/process.
+    None when the calling thread carries no trace."""
+    ambient = getattr(_REQ_CTX, "attrs", None)
+    if not ambient or "trace" not in ambient:
+        return None
+    return {k: str(ambient[k]) for k in TRACE_KEYS if ambient.get(k)}
+
+
+@contextlib.contextmanager
+def ensure_trace(parent_span: Optional[str] = None, **extra: Any):
+    """Guarantee the block runs under a trace: adopt the thread's
+    ambient trace id if one is set, else mint one.  ``parent_span``
+    (and any extra attrs) overlay the context either way, so records
+    emitted inside name the hop that owns them."""
+    ambient = getattr(_REQ_CTX, "attrs", None)
+    attrs: Dict[str, Any] = dict(extra)
+    if not ambient or not ambient.get("trace"):
+        attrs["trace"] = mint_trace_id()
+    if parent_span is not None:
+        attrs["parent_span"] = parent_span
+    with request_context(**attrs):
+        yield
+
+
 _UNSET = object()
 _GIT_REV: Any = _UNSET
 

@@ -49,6 +49,7 @@ import numpy as np
 from image_analogies_tpu.obs import fleet as obs_fleet
 from image_analogies_tpu.obs import live as obs_live
 from image_analogies_tpu.obs import metrics as obs_metrics
+from image_analogies_tpu.obs import timeline as obs_timeline
 from image_analogies_tpu.obs import trace as obs_trace
 from image_analogies_tpu.serve import wire
 from image_analogies_tpu.serve.router import Router
@@ -192,6 +193,10 @@ class Fleet:
                                       "wire": self.cfg.wire,
                                       "vnodes": self.cfg.vnodes}}))
         self._scope = obs_metrics.current_scope()
+        # Temporal plane: the health loop below is the fleet's sampling
+        # cadence — arm the process timeline for the fleet's lifetime so
+        # each poll lands worker-labeled windowed series in it.
+        obs_timeline.arm()
         for i in range(self.cfg.size):
             wid = "w{}".format(i)
             self._spawn(wid, generation=0)
@@ -221,6 +226,7 @@ class Fleet:
             self._health_thread.join(5.0)
         for handle in list(self.workers.values()):
             handle.server.shutdown()
+        obs_timeline.disarm()
         self._scope_exit.close()
         self._started = False
 
@@ -252,20 +258,36 @@ class Fleet:
     def forward(self, wid: str, a, ap, b, params,
                 deadline_s: Optional[float], idem: Optional[str]
                 ) -> "Future[Response]":
-        """One router->worker hop: request planes through the negotiated
-        codec, submit, response planes back through the codec."""
+        """One router->worker hop: request planes AND the trace context
+        through the negotiated codec, submit, response planes back
+        through the codec."""
         handle = self.workers[wid]
+        ctx = obs_trace.capture_trace()
         if handle.codec == "iaf2":
             planes = [np.asarray(x, np.float32) for x in (a, ap, b)]
             frame = wire.encode_planes(planes)
             obs_metrics.inc("router.wire_bytes", len(frame))
             a, ap, b = wire.decode_planes(frame)
+            if ctx:
+                # The IAT1 side frame rides next to the plane frame; the
+                # roundtrip is the same process-boundary rehearsal the
+                # planes get.
+                cframe = wire.encode_context(ctx)
+                obs_metrics.inc("router.wire_bytes", len(cframe))
+                ctx = wire.decode_context(cframe)
         else:
             a, ap, b = _roundtrip_json([a, ap, b])
+            if ctx:
+                ctx = _json.loads(_json.dumps(ctx))
         obs_metrics.inc("router.wire.{}".format(handle.codec))
-        src = handle.server.submit(a, ap, b, params=params,
-                                   deadline_s=deadline_s,
-                                   idempotency_key=idem)
+        # Submit under the DECODED context: the worker-side Request
+        # carries exactly what survived the wire, so the stitched trace
+        # proves cross-codec propagation, not thread-local leakage.
+        with obs_trace.request_context(**ctx) if ctx \
+                else contextlib.nullcontext():
+            src = handle.server.submit(a, ap, b, params=params,
+                                       deadline_s=deadline_s,
+                                       idempotency_key=idem)
         return _wrap_response(src, handle.codec)
 
     def submit(self, a, ap, b, params=None, deadline_s=None,
@@ -305,14 +327,26 @@ class Fleet:
         """
         if handle.scope is None:
             return
+        snap = handle.scope.registry.snapshot()
         self._scrapes[wid] = {
             "scope": handle.scope.scope_id,
             "t": time.monotonic(),
-            "snapshot": handle.scope.registry.snapshot(),
+            "snapshot": snap,
         }
+        # Feed the temporal plane: the worker's isolated registry
+        # becomes worker-labeled windowed series (counter deltas /
+        # gauge last-values / windowed histograms) in the timeline —
+        # delta logic there treats a replacement's reset counters as a
+        # fresh generation, so wN keeps one continuous series across
+        # incarnations.
+        obs_timeline.sample_snapshot(snap, worker=wid)
 
     def _health_loop(self) -> None:
         while not self._stop.wait(self.cfg.health_interval_s):
+            if self._scope is not None:
+                # Fleet-level series (router.* live only here) sampled
+                # unlabeled, alongside the worker-labeled ones below.
+                obs_timeline.sample_snapshot(self._scope.registry.snapshot())
             for wid in list(self.workers):
                 if self._stop.is_set():
                     return

@@ -11,6 +11,11 @@ API:
                         hbm_peak_bytes, slo{target, burn rates, ...}
   GET  /metrics      -> Prometheus text exposition (obs/live.py) of the
                         server's live metrics registry
+  GET  /timeline     -> windowed time-series JSON (obs/timeline.py)
+                        when the process timeline is armed
+                        (?window=10 selects a downsampling tier);
+                        both scrape endpoints self-report duration and
+                        errors under obs.scrape.*
   POST /v1/analogy   -> body {"a": [[...]], "ap": [[...]], "b": [[...]],
                         "deadline_ms": optional float,
                         "idempotency_key": optional str (journal dedupe;
@@ -28,18 +33,28 @@ metadata fields relocated to ``X-IA-Request``/``X-IA-Status``/
 ``X-IA-Degraded``/``X-IA-Batch-Size``/``X-IA-Timings`` response
 headers.  The two directions negotiate independently (binary in / JSON
 out and vice versa both work); errors are always JSON.
+
+Trace propagation: every POST reads ``X-IA-Trace``
+(``trace_id/parent_span/request_id``, ``-`` for absent fields) and
+adopts the caller's trace context — or mints one — before submitting,
+so client, router, worker, and engine spans share one trace id; the
+header is echoed on every response (success and error alike).
 """
 
 from __future__ import annotations
 
 import json
+import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import numpy as np
 
 from image_analogies_tpu.obs import live as obs_live
+from image_analogies_tpu.obs import metrics as obs_metrics
+from image_analogies_tpu.obs import timeline as obs_timeline
+from image_analogies_tpu.obs import trace as obs_trace
 from image_analogies_tpu.serve import journal as serve_journal
 from image_analogies_tpu.serve import wire
 from image_analogies_tpu.serve.server import Server
@@ -51,21 +66,27 @@ def _make_handler(server: Server):
                               server.refresh_gauges)
 
 
-def _make_handler_from(health_fn, submit_fn, refresh_fn, metrics_fn=None):
+def _make_handler_from(health_fn, submit_fn, refresh_fn, metrics_fn=None,
+                       timeline_fn=None):
     # metrics_fn(worker: Optional[str]) -> Optional[str]: override for
     # the /metrics exposition (the fleet's federated view, with
     # ?worker=<wid> selecting one worker's isolated registry).  None
     # keeps the default ambient-scope exposition.
+    # timeline_fn(window_s: Optional[float]) -> dict: override for the
+    # /timeline document; None uses the armed process timeline.
     class Handler(BaseHTTPRequestHandler):
         # Silence per-request stderr chatter; obs records cover it.
         def log_message(self, fmt, *args):  # noqa: A003
             pass
 
-        def _reply(self, code: int, payload: Dict[str, Any]) -> None:
+        def _reply(self, code: int, payload: Dict[str, Any],
+                   headers: Optional[Dict[str, str]] = None) -> None:
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -82,23 +103,64 @@ def _make_handler_from(health_fn, submit_fn, refresh_fn, metrics_fn=None):
             if parts.path == "/healthz":
                 self._reply(200, health_fn())
             elif parts.path == "/metrics":
-                refresh_fn()
-                if metrics_fn is not None:
-                    query = urllib.parse.parse_qs(parts.query)
-                    worker = (query.get("worker") or [None])[0]
-                    text = metrics_fn(worker)
-                    if text is None:
-                        self._reply(404, {"error": "unknown_worker",
-                                          "worker": worker})
-                        return
-                    self._reply_text(200, text, obs_live.CONTENT_TYPE)
-                    return
-                self._reply_text(
-                    200,
-                    obs_live.render_prometheus(obs_live.snapshot_or_none()),
-                    obs_live.CONTENT_TYPE)
+                self._scrape("metrics", self._get_metrics, parts)
+            elif parts.path == "/timeline":
+                self._scrape("timeline", self._get_timeline, parts)
             else:
                 self._reply(404, {"error": "not_found"})
+
+        def _scrape(self, endpoint: str, fn, parts) -> None:
+            """Meta-observability wrapper: every scrape endpoint counts
+            itself and times itself (obs.scrape.*), so a slow or failing
+            collector is visible in the very plane it collects.  The
+            total is bumped BEFORE rendering (this scrape sees itself);
+            the duration lands after (the next scrape exports it)."""
+            t0 = time.perf_counter()
+            obs_metrics.inc(f"obs.scrape.{endpoint}.total")
+            try:
+                fn(parts)
+            except Exception as exc:  # noqa: BLE001 - counted + surfaced
+                obs_metrics.inc("obs.scrape.errors")
+                obs_metrics.inc(f"obs.scrape.{endpoint}.errors")
+                self._reply(500, {"error": "scrape_failed",
+                                  "detail": str(exc)})
+            finally:
+                obs_metrics.observe(f"obs.scrape.{endpoint}.duration_ms",
+                                    (time.perf_counter() - t0) * 1e3)
+
+        def _get_metrics(self, parts) -> None:
+            refresh_fn()
+            if metrics_fn is not None:
+                query = urllib.parse.parse_qs(parts.query)
+                worker = (query.get("worker") or [None])[0]
+                text = metrics_fn(worker)
+                if text is None:
+                    self._reply(404, {"error": "unknown_worker",
+                                      "worker": worker})
+                    return
+                self._reply_text(200, text, obs_live.CONTENT_TYPE)
+                return
+            self._reply_text(
+                200,
+                obs_live.render_prometheus(obs_live.snapshot_or_none()),
+                obs_live.CONTENT_TYPE)
+
+        def _get_timeline(self, parts) -> None:
+            query = urllib.parse.parse_qs(parts.query)
+            window = (query.get("window") or [None])[0]
+            try:
+                window_s = float(window) if window is not None else None
+            except ValueError:
+                self._reply(400, {"error": "bad_window", "window": window})
+                return
+            fn = timeline_fn or obs_timeline.snapshot_json
+            try:
+                doc = fn(window_s)
+            except KeyError as exc:
+                self._reply(404, {"error": "unknown_window",
+                                  "detail": str(exc)})
+                return
+            self._reply(200, doc)
 
         def do_POST(self):  # noqa: N802 - stdlib API
             if self.path != "/v1/analogy":
@@ -138,21 +200,39 @@ def _make_handler_from(health_fn, submit_fn, refresh_fn, metrics_fn=None):
                         "detail": "idempotency_key must match "
                                   "[A-Za-z0-9_-]{1,64}"})
                     return
+            # Cross-process trace adoption: an inbound X-IA-Trace header
+            # (trace/parent_span/request; malformed degrades to None,
+            # never an error) joins the caller's trace; without one this
+            # hop mints the trace id.  Either way every downstream span
+            # — router, worker, engine — stitches to it, and the id is
+            # echoed back so the client can correlate.
+            ctx = obs_trace.parse_trace_header(
+                self.headers.get(obs_trace.TRACE_HEADER)) or {}
+            if "trace" not in ctx:
+                ctx["trace"] = obs_trace.mint_trace_id()
+            ctx["parent_span"] = "http"
+            trace_hdr = obs_trace.format_trace_header(ctx)
+            trace_headers = {obs_trace.TRACE_HEADER: trace_hdr} \
+                if trace_hdr else None
             try:
-                resp = submit_fn(
-                    a, ap, b,
-                    deadline_s=None if deadline_ms is None
-                    else float(deadline_ms) / 1e3,
-                    idempotency_key=idem).result()
+                with obs_trace.request_context(**ctx):
+                    resp = submit_fn(
+                        a, ap, b,
+                        deadline_s=None if deadline_ms is None
+                        else float(deadline_ms) / 1e3,
+                        idempotency_key=idem).result()
             except Rejected as exc:
-                self._reply(429, {"error": "rejected", "reason": exc.reason})
+                self._reply(429, {"error": "rejected", "reason": exc.reason},
+                            headers=trace_headers)
                 return
             except DeadlineExceeded:
-                self._reply(504, {"error": "deadline_exceeded"})
+                self._reply(504, {"error": "deadline_exceeded"},
+                            headers=trace_headers)
                 return
             except Exception as exc:  # noqa: BLE001 - surfaced to caller
                 self._reply(500, {"error": "dispatch_failed",
-                                  "detail": str(exc)})
+                                  "detail": str(exc)},
+                            headers=trace_headers)
                 return
             timings = {"queue_ms": round(resp.queue_ms, 3),
                        "dispatch_ms": round(resp.dispatch_ms, 3),
@@ -170,6 +250,8 @@ def _make_handler_from(health_fn, submit_fn, refresh_fn, metrics_fn=None):
                                  "1" if resp.degraded else "0")
                 self.send_header("X-IA-Batch-Size", str(resp.batch_size))
                 self.send_header("X-IA-Timings", json.dumps(timings))
+                if trace_hdr:
+                    self.send_header(obs_trace.TRACE_HEADER, trace_hdr)
                 self.end_headers()
                 self.wfile.write(frame)
                 return
@@ -179,8 +261,9 @@ def _make_handler_from(health_fn, submit_fn, refresh_fn, metrics_fn=None):
                 "degraded": resp.degraded,
                 "batch_size": resp.batch_size,
                 "timings": timings,
+                "trace": ctx["trace"],
                 "bp": resp.bp.tolist(),
-            })
+            }, headers=trace_headers)
 
     return Handler
 

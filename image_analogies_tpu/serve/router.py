@@ -163,7 +163,11 @@ class Router:
         obs_metrics.inc("router.requests")
         fut: "Future[Response]" = Future()
         payload = (a, ap, b, p)
-        wid, src = self._route(kstr, idem, payload, deadline_s)
+        # Every routing record (router_route / router_spill) and the
+        # downstream worker's spans share one trace id: adopt the
+        # caller's (the HTTP hop set it from X-IA-Trace) or mint here.
+        with obs_trace.ensure_trace("router_submit", origin_request=idem):
+            wid, src = self._route(kstr, idem, payload, deadline_s)
         ent = _Pending(idem, wid, fut, payload, deadline_s)
         with self._lock:
             self._pending[idem] = ent

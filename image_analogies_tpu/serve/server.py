@@ -355,6 +355,10 @@ class Server:
             key=key if key is not None else batcher.batch_key(a, ap, b, p),
             future=fut,
             idem=idem,
+            # Submit runs on the caller's thread; the worker thread that
+            # dispatches is a different one — the trace context crosses
+            # via the request itself.
+            trace=obs_trace.capture_trace(),
         )
         if deadline_s is not None:
             req.deadline = req.t_submit + deadline_s

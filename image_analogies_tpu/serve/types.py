@@ -201,6 +201,11 @@ class Request:
     # its dispatch transitions continue the pre-restart history.
     idem: Optional[str] = None
     replayed: bool = False
+    # Cross-hop trace context (obs/trace.py TRACE_KEYS): captured from
+    # the submitting thread, adopted by the worker thread that runs the
+    # request — worker threads are NOT the submit thread, so the trace
+    # must travel in the request, not in a thread-local.
+    trace: Optional[Dict[str, str]] = None
 
     def remaining(self, now: Optional[float] = None) -> Optional[float]:
         if self.deadline is None:

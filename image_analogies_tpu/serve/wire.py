@@ -27,6 +27,7 @@ allocation happens.
 
 from __future__ import annotations
 
+import json
 import struct
 from typing import List, Sequence
 
@@ -109,3 +110,47 @@ def decode_planes(data: bytes) -> List[np.ndarray]:
     if off != len(buf):
         raise WireError(f"{len(buf) - off} trailing bytes after frame")
     return out
+
+
+# --- trace-context frame -----------------------------------------------------
+#
+# Negotiated alongside IAF2 on router->worker hops: a tiny side frame
+# carrying the request's trace context (obs/trace.py TRACE_KEYS) so the
+# hop that re-encodes planes also re-encodes the context — the codec
+# roundtrip is the process-boundary rehearsal.  Same strictness rules
+# as the plane frame: exact consume, validated lengths, string-only
+# payload, hard cap before any allocation.
+
+CONTEXT_MAGIC = b"IAT1"
+MAX_CONTEXT = 4096
+
+
+def encode_context(ctx: dict) -> bytes:
+    """Serialize a str->str trace-context dict into one IAT1 frame."""
+    for k, v in ctx.items():
+        if not isinstance(k, str) or not isinstance(v, str):
+            raise WireError("trace context must be str->str")
+    blob = json.dumps(ctx, sort_keys=True).encode()
+    if len(blob) > MAX_CONTEXT:
+        raise WireError(f"trace context {len(blob)}B exceeds {MAX_CONTEXT}")
+    return CONTEXT_MAGIC + _U32.pack(len(blob)) + blob
+
+
+def decode_context(data: bytes) -> dict:
+    """Parse one IAT1 frame back into a str->str dict (exact-consume)."""
+    if len(data) < 8 or data[:4] != CONTEXT_MAGIC:
+        raise WireError("bad magic (not an IAT1 context frame)")
+    (n,) = _U32.unpack_from(data, 4)
+    if n > MAX_CONTEXT:
+        raise WireError(f"trace context {n}B exceeds {MAX_CONTEXT}")
+    if len(data) != 8 + n:
+        raise WireError("truncated/padded IAT1 frame")
+    try:
+        ctx = json.loads(data[8:8 + n].decode())
+    except (UnicodeDecodeError, ValueError) as exc:
+        raise WireError(f"undecodable trace context: {exc}")
+    if not isinstance(ctx, dict) or not all(
+            isinstance(k, str) and isinstance(v, str)
+            for k, v in ctx.items()):
+        raise WireError("trace context must be a str->str object")
+    return ctx

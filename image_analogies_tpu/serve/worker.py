@@ -122,9 +122,15 @@ class WorkerPool:
                                        "batch_size": len(batch)})
                 # Black box out the door LAST, so the ring contains the
                 # death record itself; the sealed dump in the journal
-                # dir is what `ia blackbox` renders post-mortem.
-                obs_recorder.dump_current("process_death",
-                                          extra={"batch_size": len(batch)})
+                # dir is what `ia blackbox` renders post-mortem.  The
+                # per-request context already unwound with the raise, so
+                # the dump's attribution (which requests, which trace)
+                # comes from the batch itself.
+                obs_recorder.dump_current("process_death", extra={
+                    "batch_size": len(batch),
+                    "requests": [r.request_id for r in batch],
+                    "key": batcher.key_str(batch[0].key),
+                    "trace": (batch[0].trace or {}).get("trace")})
                 return
             except BaseException as exc:  # noqa: BLE001 - crash containment
                 self._contain_crash(batch, exc)
@@ -256,7 +262,9 @@ class WorkerPool:
             self.breaker.record_success()
 
         for lane, (req, res) in enumerate(zip(batch, results)):
-            with obs_trace.request_context(request=req.request_id):
+            with obs_trace.request_context(request=req.request_id,
+                                           key=batcher.key_str(req.key),
+                                           **(req.trace or {})):
                 if isinstance(res, Exception):
                     # per-lane fault isolation: only this member
                     # re-runs, sequentially, with its own retry budget
@@ -320,11 +328,15 @@ class WorkerPool:
             self.slo.record(met)
 
     def _run_one(self, req: Request, backend, batch_size: int):
-        # Ambient request id for the whole per-request path: every span
-        # and record below — including the engine's own level/fetch spans
-        # inside create_image_analogy — inherits it, so `ia trace` renders
-        # one connected request-id chain from admit to dispatch.
-        with obs_trace.request_context(request=req.request_id):
+        # Ambient request id + inbound trace context for the whole
+        # per-request path: every span and record below — including the
+        # engine's own level/fetch spans inside create_image_analogy —
+        # inherits them, so `ia trace` renders one connected request-id
+        # chain from admit to dispatch, stitched to the submitting hop's
+        # trace even though this thread is not the submit thread.
+        with obs_trace.request_context(request=req.request_id,
+                                       key=batcher.key_str(req.key),
+                                       **(req.trace or {})):
             return self._dispatch_one(req, backend, batch_size)
 
     def _dispatch_one(self, req: Request, backend, batch_size: int):

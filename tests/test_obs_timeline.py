@@ -1,0 +1,696 @@
+"""Temporal observability plane (ISSUE 14): windowed time-series store,
+cross-hop trace propagation, and the `ia top` cockpit.
+
+Locked here:
+
+- Histogram.merge == union-of-samples (empty/one-sample edge cases) and
+  the from_summary round-trip the timeline's window folding relies on;
+- Timeline windowing under a fake clock: counter deltas (with the
+  generation-reset rule), gauge last-value, per-window histogram
+  percentiles, and the 1s -> 10s downsampling cascade;
+- the EWMA/MAD anomaly detector: a spike past warmup raises a hint,
+  bumps obs.anomaly.* through the ambient scope, and surfaces as an
+  advisory without dragging the baseline;
+- the DISARMED module plane allocates nothing (tracemalloc, same
+  contract as the disabled metrics registry);
+- X-IA-Trace header parse/format round-trip + the IAT1 wire context
+  frame's strict validation;
+- cross-process stitching acceptance: one POSTed X-IA-Trace id spans
+  router + worker records written from two ISOLATED worker registries,
+  and `ia trace` re-homes the whole chain onto a single per-trace
+  track;
+- /timeline over the serve front end (tier select, 400/404 contracts)
+  with the obs.scrape.* self-report counters visible in /metrics;
+- blackbox dumps fold the ambient request context (explicit extra
+  wins);
+- `ia bench --check` gates timeline_overhead_pct in absolute points
+  (legacy archives record-only), and `ia top --once` renders the
+  cockpit from a live server and exits 0.
+"""
+
+import dataclasses
+import gc
+import json
+import os
+import threading
+import time
+import tracemalloc
+import urllib.error
+import urllib.request
+
+import pytest
+
+from image_analogies_tpu.chaos import drills
+from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.obs import live as obs_live
+from image_analogies_tpu.obs import metrics as obs_metrics
+from image_analogies_tpu.obs import timeline as obs_timeline
+from image_analogies_tpu.obs import trace as obs_trace
+from image_analogies_tpu.obs.metrics import Histogram
+from image_analogies_tpu.obs.timeline import Timeline
+from tests.conftest import make_pair
+
+
+def _params(**kw):
+    kw.setdefault("levels", 2)
+    kw.setdefault("backend", "cpu")
+    kw.setdefault("metrics", True)
+    return AnalogyParams(**kw)
+
+
+def _snap(counters=None, gauges=None, histograms=None):
+    return {"counters": counters or {}, "gauges": gauges or {},
+            "histograms": histograms or {}}
+
+
+# ------------------------------------------------ histogram merge
+
+
+def test_histogram_merge_is_union_of_samples():
+    """Acceptance satellite: merging two histograms is indistinguishable
+    from observing the union of their samples — count/sum/min/max/
+    buckets/percentiles all agree."""
+    sa, sb = [0.5, 3.0, 100.0, 0.0], [7.0, 3.5]
+    ha, hb, hu = Histogram(), Histogram(), Histogram()
+    for v in sa:
+        ha.observe(v)
+        hu.observe(v)
+    for v in sb:
+        hb.observe(v)
+        hu.observe(v)
+    ha.merge(hb)
+    assert ha.summary() == hu.summary()
+    assert ha.percentile(50) == hu.percentile(50)
+    assert ha.percentile(95) == hu.percentile(95)
+
+    # empty other: a no-op (its inf/-inf extremes must not leak in)
+    h = Histogram()
+    h.observe(1.0)
+    before = h.summary()
+    h.merge(Histogram())
+    assert h.summary() == before
+
+    # empty self absorbs the other wholesale
+    h2 = Histogram()
+    h2.merge(hb)
+    assert h2.summary() == hb.summary()
+
+    # empty + empty stays empty (and keeps the legacy summary shape)
+    e = Histogram()
+    e.merge(Histogram())
+    assert e.summary() == {"count": 0, "sum": 0.0, "min": 0.0,
+                           "max": 0.0, "mean": 0.0}
+
+    # single-sample merge
+    h3, h4 = Histogram(), Histogram()
+    h3.observe(7.0)
+    h4.merge(h3)
+    assert h4.percentile(50) == 7.0 and h4.count == 1
+
+
+def test_histogram_from_summary_roundtrip():
+    h = Histogram()
+    for v in (0.5, 3.0, 100.0):
+        h.observe(v)
+    assert Histogram.from_summary(h.summary()).summary() == h.summary()
+    # empty summary (no buckets key) -> empty histogram
+    back = Histogram.from_summary(Histogram().summary())
+    assert back.count == 0 and back.summary()["count"] == 0
+
+
+# ------------------------------------------------ timeline windowing
+
+
+def test_counter_delta_gauge_last_and_generation_reset():
+    clk = {"t": 1000.2}
+    tl = Timeline(tiers=((1.0, 120), (10.0, 90)),
+                  clock=lambda: clk["t"])
+    tl.sample_snapshot(_snap(counters={"serve.completed": 5.0},
+                             gauges={"serve.queue_depth": 3.0}))
+    clk["t"] = 1001.1
+    tl.sample_snapshot(_snap(counters={"serve.completed": 9.0},
+                             gauges={"serve.queue_depth": 1.0}))
+    clk["t"] = 1001.6  # same window: gauge overwrites, delta accumulates
+    tl.sample_snapshot(_snap(counters={"serve.completed": 10.0},
+                             gauges={"serve.queue_depth": 7.0}))
+    # a replacement worker restarts its registry: v < prev means the
+    # whole value is this window's delta, never a negative
+    clk["t"] = 1002.5
+    tl.sample_snapshot(_snap(counters={"serve.completed": 2.0}))
+    assert tl.range("serve.completed") == [
+        (1000.0, 5.0), (1001.0, 5.0), (1002.0, 2.0)]
+    assert tl.range("serve.queue_depth") == [(1000.0, 3.0), (1001.0, 7.0)]
+    # worker labels namespace the same metric into distinct series
+    tl.sample_snapshot(_snap(counters={"serve.completed": 4.0}),
+                       worker="w1")
+    assert tl.range("w1:serve.completed") == [(1002.0, 4.0)]
+
+
+def test_histogram_windows_have_per_window_percentiles():
+    clk = {"t": 2000.0}
+    tl = Timeline(tiers=((1.0, 120),), clock=lambda: clk["t"])
+    h = Histogram()
+    for v in (10.0, 12.0):
+        h.observe(v)
+    tl.sample_snapshot(_snap(histograms={"serve.latency_ms": h.summary()}))
+    # next window: cumulative summary grows by two much-slower samples;
+    # the window must show ONLY the new ones
+    clk["t"] = 2001.0
+    for v in (100.0, 120.0):
+        h.observe(v)
+    tl.sample_snapshot(_snap(histograms={"serve.latency_ms": h.summary()}))
+    pts = tl.range("serve.latency_ms")
+    assert [p[0] for p in pts] == [2000.0, 2001.0]
+    assert pts[0][1]["count"] == 2 and pts[0][1]["mean"] == 11.0
+    assert pts[1][1]["count"] == 2 and pts[1][1]["mean"] == 110.0
+    assert pts[1][1]["p50"] >= 64.0  # window p50, not lifetime
+
+
+def test_downsampling_cascade_folds_closed_windows():
+    clk = {"t": 0.5}
+    tl = Timeline(tiers=((1.0, 120), (10.0, 90), (60.0, 60)),
+                  clock=lambda: clk["t"])
+    h = Histogram()
+    total = 0.0
+    for i in range(10):
+        clk["t"] = i + 0.5
+        total += 2.0
+        h.observe(float(i + 1))
+        tl.sample_snapshot(_snap(counters={"serve.completed": total},
+                                 gauges={"serve.queue_depth": float(i)},
+                                 histograms={"serve.latency_ms":
+                                             h.summary()}))
+    clk["t"] = 12.0  # every tier-0 window of [0, 10) is now closed
+    pts = tl.range("serve.completed", window_s=10.0)
+    assert pts == [(0.0, 20.0)]  # counter deltas ADD across the fold
+    gpts = tl.range("serve.queue_depth", window_s=10.0)
+    assert gpts == [(0.0, 9.0)]  # gauge: last closed window's value
+    hpts = tl.range("serve.latency_ms", window_s=10.0)
+    assert hpts[0][1]["count"] == 10  # histograms merge across the fold
+    assert hpts[0][1]["sum"] == pytest.approx(55.0)
+    # unknown tier -> KeyError (the /timeline 404 contract)
+    with pytest.raises(KeyError):
+        tl.range("serve.completed", window_s=7.0)
+    # to_json carries tier geometry + series kinds
+    doc = tl.to_json(10.0)
+    assert doc["armed"] is True and doc["window_s"] == 10.0
+    assert doc["series"]["serve.completed"]["kind"] == "counter"
+    assert [t["window_s"] for t in doc["tiers"]] == [1.0, 10.0, 60.0]
+
+
+# ------------------------------------------------ anomaly detection
+
+
+def test_anomaly_detector_flags_spike_and_keeps_baseline():
+    clk = {"t": 0.5}
+    tl = Timeline(tiers=((1.0, 120),), clock=lambda: clk["t"],
+                  warmup=4, z_threshold=4.0)
+    scope = obs_metrics.ObsScope(scope_id="det")
+    with obs_metrics.scope_active(scope):
+        # alternating steady values give the MAD a small nonzero floor
+        for i in range(10):
+            clk["t"] = i + 0.5
+            tl.sample_snapshot(_snap(
+                gauges={"serve.queue_depth": 5.0 + 0.2 * (i % 2)}))
+        clk["t"] = 10.5  # closes the last steady window
+        tl.sample_snapshot(_snap(gauges={"serve.queue_depth": 50.0}))
+        clk["t"] = 11.5  # closes the spike window -> detection fires
+        tl.sample_snapshot(_snap(gauges={"serve.queue_depth": 5.0}))
+        doc = tl.to_json()
+    hints = [h for h in doc["anomalies"]
+             if h["series"] == "serve.queue_depth"]
+    assert len(hints) == 1
+    assert hints[0]["value"] == 50.0 and hints[0]["z"] > 4.0
+    assert hints[0]["baseline"] == pytest.approx(5.1, abs=0.2)
+    # the outlier bumped the ambient scope's counters
+    assert scope.registry.counter("obs.anomaly.total") == 1
+    assert scope.registry.counter(
+        "obs.anomaly.serve.queue_depth") == 1
+    # advisory: fresh hint -> degrade_hint dict; stale hint -> None
+    adv = tl.advisory()
+    assert adv is not None and adv["degrade_hint"] is True
+    clk["t"] = 1000.0
+    assert tl.advisory() is None
+    # non-latency/queue series never detect
+    assert not any(h["series"] == "serve.completed"
+                   for h in doc["anomalies"])
+
+
+# ------------------------------------------------ disarmed fast path
+
+
+def test_disarmed_timeline_plane_allocates_nothing():
+    """Acceptance: with the plane disarmed, sample_snapshot and
+    sample_ambient are one module-bool read — no steady-state
+    allocations attributable to obs/ (same tracemalloc lock as the
+    disabled metrics registry)."""
+    assert obs_timeline.current() is None
+    snap = _snap(counters={"x": 1.0})
+    # a cyclic-GC pass triggered mid-loop runs earlier tests' finalizers
+    # with OUR frame innermost, mis-attributing their tiny allocations
+    # to obs/ — collect first, then keep the collector out of the window
+    gc.collect()
+    gc.disable()
+    tracemalloc.start()
+    try:
+        for _ in range(2000):
+            obs_timeline.sample_snapshot(snap)
+            obs_timeline.sample_ambient()
+        taken = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+        gc.enable()
+    obs_allocs = [t for t in taken.traces
+                  if any("image_analogies_tpu/obs/" in fr.filename
+                         for fr in t.traceback)]
+    # The interpreter may keep a couple hundred bytes of per-function
+    # internal state live and attribute it to the `def` line (seen only
+    # when certain earlier tests ran in-process). That noise is bounded;
+    # a disarmed fast path that actually allocated and retained would
+    # leave thousands of live traces after 2000 calls — allow at most a
+    # handful of tiny ones.
+    assert len(obs_allocs) <= 8
+    assert sum(t.size for t in obs_allocs) <= 1024
+    # disarmed /timeline document says so instead of erroring
+    assert obs_timeline.snapshot_json() == {"armed": False, "series": {},
+                                            "anomalies": []}
+
+
+def test_arm_nests_and_last_disarm_clears():
+    t1 = obs_timeline.arm()
+    t2 = obs_timeline.arm()
+    assert t1 is t2 and obs_timeline.current() is t1
+    obs_timeline.disarm()
+    assert obs_timeline.current() is t1  # still held by the first owner
+    obs_timeline.disarm()
+    assert obs_timeline.current() is None
+
+
+# ------------------------------------------------ trace header + wire frame
+
+
+def test_trace_header_parse_and_format_roundtrip():
+    parse = obs_trace.parse_trace_header
+    assert parse("cafe0123/http/r42") == {
+        "trace": "cafe0123", "parent_span": "http",
+        "origin_request": "r42"}
+    assert parse("cafe0123/-/-") == {"trace": "cafe0123"}
+    # no trace id -> no adoption, even with other fields present
+    assert parse("-/http/r42") is None
+    # malformed degrades to None, never an exception
+    assert parse(None) is None
+    assert parse("") is None
+    assert parse("a/b") is None                   # wrong arity
+    assert parse("bad$chars/-/-") is None         # charset violation
+    assert parse("x" * 65 + "/-/-") is None       # token too long
+    hdr = obs_trace.format_trace_header({"trace": "cafe0123"})
+    assert hdr == "cafe0123/-/-"
+    assert parse(hdr) == {"trace": "cafe0123"}
+    # capture_trace reflects the ambient request context
+    with obs_trace.request_context(trace="t1", parent_span="http",
+                                   origin_request="r9"):
+        assert obs_trace.capture_trace() == {
+            "trace": "t1", "parent_span": "http", "origin_request": "r9"}
+        assert obs_trace.format_trace_header() == "t1/http/r9"
+    assert obs_trace.capture_trace() is None
+
+
+def test_ensure_trace_mints_or_adopts():
+    with obs_trace.ensure_trace("router_submit", origin_request="idem1"):
+        ctx = obs_trace.context_attrs()
+        assert ctx["parent_span"] == "router_submit"
+        assert ctx["origin_request"] == "idem1"
+        minted = ctx["trace"]
+        assert obs_trace.parse_trace_header(f"{minted}/-/-") is not None
+        # an inner ensure_trace ADOPTS the ambient id, never re-mints
+        with obs_trace.ensure_trace("inner"):
+            assert obs_trace.context_attrs()["trace"] == minted
+    assert obs_trace.context_attrs() is None
+
+
+def test_wire_context_frame_strict_roundtrip():
+    from image_analogies_tpu.serve import wire
+
+    ctx = {"trace": "cafe0123", "parent_span": "http",
+           "origin_request": "r42"}
+    frame = wire.encode_context(ctx)
+    assert frame.startswith(wire.CONTEXT_MAGIC)
+    assert wire.decode_context(frame) == ctx
+    assert wire.decode_context(wire.encode_context({})) == {}
+    with pytest.raises(wire.WireError):
+        wire.decode_context(b"IAXX" + frame[4:])      # bad magic
+    with pytest.raises(wire.WireError):
+        wire.decode_context(frame[:-1])               # truncated
+    with pytest.raises(wire.WireError):
+        wire.decode_context(frame + b"x")             # trailing bytes
+    with pytest.raises(wire.WireError):
+        wire.encode_context({"k": 7})                 # non-str value
+    with pytest.raises(wire.WireError):
+        wire.encode_context({"k": "v" * (wire.MAX_CONTEXT + 1)})
+
+
+# ------------------------------------------------ cockpit rendering
+
+
+def test_cockpit_rows_and_render():
+    doc = {"armed": True, "window_s": 1.0, "series": {
+        "w0:serve.completed": {"kind": "counter",
+                               "points": [[0.0, 4.0]]},
+        "w0:serve.latency_ms": {"kind": "hist", "points": [
+            [0.0, {"count": 4, "p50": 10.0, "p95": 20.0}]]},
+        "w0:serve.queue_depth": {"kind": "gauge", "points": [[0.0, 3]]},
+        "w0:serve.breaker.state.cpu": {"kind": "gauge",
+                                       "points": [[0.0, 2]]},
+        "w0:hbm.peak_bytes.d0": {"kind": "gauge",
+                                 "points": [[0.0, float(2 << 20)]]},
+        "serve.queue_depth": {"kind": "gauge", "points": [[0.0, 1]]},
+    }, "anomalies": [{"series": "w0:serve.latency_ms", "value": 50.0,
+                      "baseline": 10.0, "z": 9.0, "window_start": 0.0}]}
+    rows = obs_timeline.cockpit_rows(doc)
+    assert [r["worker"] for r in rows] == ["-", "w0"]
+    w0 = rows[1]
+    assert w0["qps"] == 4.0
+    assert w0["p50"] == 10.0 and w0["p95"] == 20.0
+    assert w0["queue"] == 3 and w0["breaker"] == "OPEN"
+    assert w0["hbm"] == float(2 << 20) and w0["anomalies"] == 1
+    text = obs_timeline.render_cockpit(doc)
+    assert "WORKER" in text and "QPS" in text and "P95ms" in text
+    assert "OPEN" in text and "2.0M" in text
+    assert "! anomaly w0:serve.latency_ms" in text
+    # disarmed doc renders the banner, not a crash
+    off = obs_timeline.render_cockpit({"armed": False, "series": {},
+                                       "anomalies": []})
+    assert "[timeline disarmed]" in off and "(no series yet)" in off
+
+
+# ------------------------------------------------ blackbox context fold
+
+
+def test_blackbox_dump_folds_request_context(tmp_path):
+    """Satellite: dump_current folds the ambient request context
+    (request id, trace id, batch key) into the sealed dump; explicit
+    extra keys win on collision."""
+    from image_analogies_tpu.obs import recorder as obs_recorder
+
+    scope = obs_metrics.ObsScope(scope_id="w7.g0")
+    scope.dump_dir = str(tmp_path)
+    with obs_trace.run_scope(_params()), obs_metrics.scope_active(scope):
+        with obs_trace.request_context(request=7, trace="cafe0123",
+                                       key="k1"):
+            path = obs_recorder.dump_current(
+                "process_death", extra={"batch_size": 2,
+                                        "key": "explicit-wins"})
+    doc = obs_recorder.load_dump(path)
+    assert doc["extra"]["request"] == 7
+    assert doc["extra"]["trace"] == "cafe0123"
+    assert doc["extra"]["batch_size"] == 2
+    assert doc["extra"]["key"] == "explicit-wins"
+
+
+# ------------------------------------------------ serve front end
+
+
+def test_serve_http_timeline_endpoint_and_scrape_counters(tmp_path):
+    """/timeline serves the armed document (tier select via ?window=,
+    400 on garbage, 404 on an unknown tier), and both scrape endpoints
+    self-report under obs.scrape.* — visible in the NEXT /metrics
+    scrape."""
+    from image_analogies_tpu.serve import Server
+    from image_analogies_tpu.serve.http import serve_http
+
+    a, ap, b = make_pair(10, 10, seed=40)
+    tl = obs_timeline.arm()
+    try:
+        with Server(drills.serve_config(workers=1)) as srv:
+            assert srv.request(a, ap, b, timeout=120).status == "ok"
+            srv.refresh_gauges()
+            tl.sample_snapshot(obs_metrics.snapshot() or {}, worker="w0")
+            httpd = serve_http(srv, 0)
+            t = threading.Thread(target=httpd.serve_forever, daemon=True)
+            t.start()
+            try:
+                base = f"http://127.0.0.1:{httpd.server_address[1]}"
+                with urllib.request.urlopen(base + "/timeline") as r:
+                    assert r.headers["Content-Type"] == "application/json"
+                    doc = json.load(r)
+                assert doc["armed"] is True
+                assert "w0:serve.completed" in doc["series"]
+                with urllib.request.urlopen(
+                        base + "/timeline?window=10") as r:
+                    assert json.load(r)["window_s"] == 10.0
+                with pytest.raises(urllib.error.HTTPError) as e404:
+                    urllib.request.urlopen(base + "/timeline?window=7")
+                assert e404.value.code == 404
+                assert json.loads(
+                    e404.value.read())["error"] == "unknown_window"
+                with pytest.raises(urllib.error.HTTPError) as e400:
+                    urllib.request.urlopen(base + "/timeline?window=abc")
+                assert e400.value.code == 400
+                assert json.loads(
+                    e400.value.read())["error"] == "bad_window"
+                # meta-observability: every scrape bumps its own total
+                # BEFORE rendering, so this scrape sees itself
+                urllib.request.urlopen(base + "/metrics").read()
+                text = urllib.request.urlopen(
+                    base + "/metrics").read().decode()
+            finally:
+                httpd.shutdown()
+            # durations land in the handler's finally AFTER the reply is
+            # on the wire, so read them from the registry (with a short
+            # grace for the last handler thread) rather than the body
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                hists = obs_metrics.snapshot()["histograms"]
+                if (hists.get("obs.scrape.metrics.duration_ms",
+                              {}).get("count", 0) >= 2
+                        and hists.get("obs.scrape.timeline.duration_ms",
+                                      {}).get("count", 0) >= 4):
+                    break
+                time.sleep(0.02)
+    finally:
+        obs_timeline.disarm()
+    # 4 timeline GETs above; this is the second /metrics scrape
+    assert "ia_obs_scrape_timeline_total_total 4" in text
+    assert "ia_obs_scrape_metrics_total_total 2" in text
+    assert hists["obs.scrape.metrics.duration_ms"]["count"] == 2
+    assert hists["obs.scrape.timeline.duration_ms"]["count"] == 4
+
+
+def test_live_http_server_timeline_route():
+    """obs/live.py's sidecar exposition server (ia run --metrics-port)
+    grows the same /timeline route + scrape self-report."""
+    tl = obs_timeline.arm()
+    try:
+        tl.sample_snapshot(_snap(counters={"level.steps": 3.0}))
+        # run_scope installs the PROCESS-default scope, so the sidecar's
+        # handler threads resolve it for the obs.scrape.* counters
+        with obs_trace.run_scope(_params()):
+            httpd = obs_live.start_http_server(0)
+            try:
+                base = f"http://127.0.0.1:{httpd.server_address[1]}"
+                with urllib.request.urlopen(base + "/timeline") as r:
+                    doc = json.load(r)
+                assert doc["armed"] is True
+                assert "level.steps" in doc["series"]
+                with pytest.raises(urllib.error.HTTPError) as e400:
+                    urllib.request.urlopen(base + "/timeline?window=abc")
+                assert e400.value.code == 400
+            finally:
+                obs_live.stop_http_server(httpd)
+            counters = obs_metrics.snapshot()["counters"]
+    finally:
+        obs_timeline.disarm()
+    assert counters["obs.scrape.timeline.total"] == 2
+    assert counters["obs.scrape.timeline.errors"] == 1
+    assert counters["obs.scrape.errors"] == 1
+
+
+# ------------------------------------------------ cross-process stitching
+
+
+def test_stitched_trace_across_two_isolated_registries(tmp_path):
+    """Tentpole acceptance: a client-sent X-IA-Trace id survives the
+    HTTP hop, the router, the IAF2 forward, and the worker thread — the
+    fleet's workers write through two ISOLATED ObsScope registries, yet
+    every record of the request carries one trace id, and `ia trace`
+    renders the chain as a single per-trace track."""
+    from image_analogies_tpu.obs import export as obs_export
+    from image_analogies_tpu.obs import report as obs_report
+    from image_analogies_tpu.serve.fleet import Fleet
+    from image_analogies_tpu.serve.http import serve_fleet_http
+    from image_analogies_tpu.serve.types import FleetConfig
+
+    log = str(tmp_path / "fleet.jsonl")
+    scfg = drills.serve_config(workers=1, max_batch=2,
+                               batch_window_ms=5.0)
+    scfg = dataclasses.replace(
+        scfg, params=scfg.params.replace(log_path=log))
+    fcfg = FleetConfig(serve=scfg, size=2, vnodes=16,
+                       journal_root=str(tmp_path / "journals"),
+                       health_interval_s=0.05,
+                       backoff_s=0.01, backoff_cap_s=0.05)
+    a, ap, b = make_pair(8, 8, seed=41)
+    with Fleet(fcfg) as fl:
+        regs = {id(h.scope.registry) for h in fl.workers.values()}
+        assert len(regs) == 2  # the registries really are isolated
+        httpd = serve_fleet_http(fl, 0)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            base = f"http://127.0.0.1:{httpd.server_address[1]}"
+            body = json.dumps({"a": a.tolist(), "ap": ap.tolist(),
+                               "b": b.tolist()}).encode()
+            req = urllib.request.Request(
+                base + "/v1/analogy", data=body,
+                headers={"Content-Type": "application/json",
+                         "X-IA-Trace": "cafe0123/client/r42"})
+            with urllib.request.urlopen(req, timeout=120) as r:
+                echoed = r.headers.get("X-IA-Trace")
+                resp = json.load(r)
+        finally:
+            httpd.shutdown()
+    assert resp["status"] == "ok"
+    # the id is echoed to the client in body and header alike
+    assert resp["trace"] == "cafe0123"
+    assert echoed.split("/")[0] == "cafe0123"
+
+    recs = [json.loads(line) for line in open(log)]
+    chain = [r for r in recs if r.get("trace") == "cafe0123"]
+    events = {r.get("event") for r in chain}
+    span_names = {r.get("name") for r in chain if r.get("event") == "span"}
+    assert "router_route" in events         # router hop stitched
+    assert "serve_request" in events        # worker completion stitched
+    assert "serve_dispatch" in span_names   # worker dispatch stitched
+    assert span_names & {"level", "batch_level"}  # ENGINE spans stitched
+
+    # `ia report` groups the journey under one traces entry
+    an = obs_report.analyze(recs)
+    ours = [t for t in (an["traces"] or [])
+            if t["trace"] == "cafe0123"]
+    assert len(ours) == 1
+    assert ours[0]["spans"] >= 2
+    assert "router_route" in ours[0]["events"]
+    assert "traces:" in obs_report.render(an)
+
+    # `ia trace` re-homes the whole chain onto ONE per-trace track
+    out = str(tmp_path / "trace.json")
+    obs_export.export_trace(log, out)
+    tr = json.load(open(out))
+    track_names = {e["args"]["name"] for e in tr["traceEvents"]
+                   if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert "trace cafe0123" in track_names
+    tids = {e["tid"] for e in tr["traceEvents"] if e["ph"] != "M"
+            and e.get("args", {}).get("trace") == "cafe0123"}
+    assert len(tids) == 1 and tids.pop() >= obs_export.TRACE_TID_BASE
+
+
+# ------------------------------------------------ bench rider
+
+
+def test_bench_check_gates_timeline_overhead():
+    """Satellite: timeline_overhead_pct rides the bench trajectory —
+    extract_headline propagates it, check_regression gates it in
+    absolute percentage points, and legacy archives record-only."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "ia_bench_timeline_test", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    doc = {"parsed": {"value": 7.5, "metric": "1024x1024 north star",
+                      "timeline_overhead_pct": 1.5}}
+    assert bench.extract_headline(doc)["timeline_overhead_pct"] == 1.5
+
+    trajectory = {"points": [
+        {"value": 7.0, "metric_key": "1024x1024", "round": 1,
+         "file": "BENCH_r01.json", "timeline_overhead_pct": 1.0},
+        {"value": 7.2, "metric_key": "1024x1024", "round": 2,
+         "file": "BENCH_r02.json", "timeline_overhead_pct": 2.0},
+    ], "problems": []}
+    ok = bench.check_regression(trajectory, fresh_value=7.1,
+                                fresh_timeline=2.5, threshold_pct=20.0)
+    assert ok["ok"] and ok["timeline_overhead_pct"] == 2.5
+    assert ok["timeline_overhead_floor"] == 1.0
+    assert ok["timeline_overhead_delta_pts"] == 1.5
+    bad = bench.check_regression(trajectory, fresh_value=7.1,
+                                 fresh_timeline=30.0, threshold_pct=20.0)
+    assert not bad["ok"]
+    assert any("timeline_overhead_pct" in p for p in bad["problems"])
+    # archive self-check reads the latest point's own overhead
+    latest = bench.check_regression(trajectory, threshold_pct=20.0)
+    assert latest["timeline_overhead_pct"] == 2.0
+    assert latest["timeline_overhead_floor"] == 1.0
+    # legacy archive (no timeline points): record-only, never a gate
+    legacy = {"points": [
+        {"value": 7.0, "metric_key": "1024x1024", "round": 1,
+         "file": "BENCH_r01.json"}], "problems": []}
+    rec = bench.check_regression(legacy, fresh_value=7.1,
+                                 fresh_timeline=99.0, threshold_pct=20.0)
+    assert rec["ok"] and rec["timeline_overhead_pct"] == 99.0
+    assert rec["timeline_overhead_floor"] is None
+
+
+def test_cli_bench_check_timeline_rider(tmp_path, capsys):
+    from image_analogies_tpu.cli import main
+
+    with open(tmp_path / "BENCH_r01.json", "w") as f:
+        json.dump({"parsed": {"value": 7.0,
+                              "metric": "1024x1024 north star",
+                              "timeline_overhead_pct": 1.0}}, f)
+    res = tmp_path / "result.json"
+    with open(res, "w") as f:
+        json.dump({"value": 7.1, "metric": "1024x1024 north star",
+                   "timeline_overhead_pct": 2.5}, f)
+    rc = main(["bench", "--check", "--result", str(res),
+               "--dir", str(tmp_path)])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["timeline_overhead_pct"] == 2.5
+    assert out["timeline_overhead_floor"] == 1.0
+
+
+# ------------------------------------------------ ia top
+
+
+def test_ia_top_once_renders_live_cockpit(tmp_path, capsys):
+    """Acceptance: `ia top --once` fetches a live server's /timeline and
+    renders the QPS/p50/p95/queue/breaker/HBM/anomaly columns, exit 0."""
+    from image_analogies_tpu.cli import main
+    from image_analogies_tpu.serve import Server
+    from image_analogies_tpu.serve.http import serve_http
+
+    a, ap, b = make_pair(10, 10, seed=42)
+    tl = obs_timeline.arm()
+    try:
+        with Server(drills.serve_config(workers=1)) as srv:
+            assert srv.request(a, ap, b, timeout=120).status == "ok"
+            srv.refresh_gauges()
+            tl.sample_snapshot(obs_metrics.snapshot() or {}, worker="w0")
+            httpd = serve_http(srv, 0)
+            t = threading.Thread(target=httpd.serve_forever, daemon=True)
+            t.start()
+            try:
+                base = f"http://127.0.0.1:{httpd.server_address[1]}"
+                rc = main(["top", "--once", "--url", base])
+            finally:
+                httpd.shutdown()
+    finally:
+        obs_timeline.disarm()
+    out = capsys.readouterr().out
+    assert rc == 0
+    for col in ("WORKER", "QPS", "P50ms", "P95ms", "QUEUE", "BREAKER",
+                "HBM", "ANOM"):
+        assert col in out
+    assert "w0" in out  # the sampled worker's row rendered
+
+
+def test_ia_top_once_unreachable_exits_2(capsys):
+    from image_analogies_tpu.cli import main
+
+    rc = main(["top", "--once", "--url", "http://127.0.0.1:1"])
+    captured = capsys.readouterr()
+    assert rc == 2
+    assert "cannot fetch" in captured.err
