@@ -125,6 +125,12 @@ def extract_headline(doc: dict):
         if obj.get("timeline_overhead_pct") is not None:
             out["timeline_overhead_pct"] = float(
                 obj["timeline_overhead_pct"])
+        # handoff trajectory (PR 15): SIGKILL -> the replacement
+        # subprocess worker answering the stranded request on the SAME
+        # journal dir at 64^2 — the fleet's failover promise in ms
+        if obj.get("handoff_recovery_ms") is not None:
+            out["handoff_recovery_ms"] = float(
+                obj["handoff_recovery_ms"])
         return out
 
     parsed = doc.get("parsed")
@@ -180,7 +186,8 @@ def check_regression(trajectory: dict, fresh_value=None,
                      threshold_pct: float = 20.0,
                      fresh_gap=None, fresh_key=None,
                      fresh_obs=None, fresh_cold=None,
-                     fresh_scale=None, fresh_timeline=None) -> dict:
+                     fresh_scale=None, fresh_timeline=None,
+                     fresh_handoff=None) -> dict:
     """Gate a wall-clock number against the trajectory floor.
 
     With ``fresh_value`` (a just-measured number), it is compared against
@@ -235,6 +242,13 @@ def check_regression(trajectory: dict, fresh_value=None,
     ``fresh_timeline`` with the same ABSOLUTE percentage-points gate
     as ``obs_overhead_pct``; archives from rounds before the timeline
     existed carry no floor, so the first point records without gating.
+
+    ``handoff_recovery_ms`` (SIGKILL a subprocess fleet worker
+    mid-request -> its replacement answering on the same journal dir at
+    64^2 — PR 15's failover promise) rides via ``fresh_handoff``, gated
+    relatively like ``cold_start_ms``.  Archives from rounds before the
+    subprocess transport existed carry no floor, so the first measured
+    point records without gating.
     """
     points = trajectory.get("points") or []
     problems = list(trajectory.get("problems", []))
@@ -261,6 +275,7 @@ def check_regression(trajectory: dict, fresh_value=None,
         cand_cold = fresh_cold
         cand_scale = fresh_scale
         cand_timeline = fresh_timeline
+        cand_handoff = fresh_handoff
         prior = same
         floor = min(p["value"] for p in same)
     else:
@@ -273,6 +288,7 @@ def check_regression(trajectory: dict, fresh_value=None,
         cand_cold = latest.get("cold_start_ms")
         cand_scale = latest.get("exemplar_scale_ratio")
         cand_timeline = latest.get("timeline_overhead_pct")
+        cand_handoff = latest.get("handoff_recovery_ms")
         prior = same[:-1]
         if not prior:
             return {"ok": True, "reason": "single_point",
@@ -388,6 +404,27 @@ def check_regression(trajectory: dict, fresh_value=None,
         # the point without gating, same posture as cold_start_ms
         out["timeline_overhead_pct"] = float(cand_timeline)
         out["timeline_overhead_floor"] = None
+    prior_handoffs = [p["handoff_recovery_ms"] for p in prior
+                      if p.get("handoff_recovery_ms") is not None]
+    if cand_handoff is not None and prior_handoffs:
+        ho_floor = min(prior_handoffs)
+        ho_reg = ((float(cand_handoff) - ho_floor)
+                  / max(ho_floor, 1.0) * 100.0)
+        out["handoff_recovery_ms"] = float(cand_handoff)
+        out["handoff_recovery_floor"] = ho_floor
+        out["handoff_recovery_regression_pct"] = round(ho_reg, 2)
+        if ho_reg > threshold_pct:
+            out["ok"] = False
+            problems.append(
+                f"handoff_recovery_ms regressed {ho_reg:.1f}% past the "
+                f"{ho_floor:.1f} ms floor "
+                f"(candidate {cand_handoff:.1f} ms)")
+    elif cand_handoff is not None:
+        # legacy archives (pre-subprocess-transport rounds) carry no
+        # floor: record the point without gating, same posture as
+        # cold_start_ms
+        out["handoff_recovery_ms"] = float(cand_handoff)
+        out["handoff_recovery_floor"] = None
     return out
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -614,6 +651,88 @@ def measure_cold_start(size=256, levels=3, seed=7):
         "saved_ms": round(cold_ms - warm_ms, 1),
         "bit_identical": bool(np.array_equal(np.asarray(res_cold.bp),
                                              np.asarray(res_warm.bp))),
+        "size": size,
+        "levels": levels,
+    }
+
+
+def measure_handoff_recovery(size=64, levels=2, seed=7):
+    """Fleet handoff-recovery point (`ia bench`'s ``handoff_recovery_ms``).
+
+    A 2-worker SUBPROCESS fleet (each worker a real OS process on its
+    own loopback port — serve/transport.py): one request warms the home
+    worker and lands a ``done`` journal record, a second request for the
+    same exemplar is admitted mid-batch-window, then the home child is
+    SIGKILLed.  The headline is kill -> the REPLACEMENT process (spawned
+    on the SAME journal dir, foreign stale lock swept, incomplete entry
+    replayed) resolving the stranded future — jax import, journal
+    recovery, and the replayed synthesis all inside the measured
+    window, because that IS what failover costs.  The run refuses to
+    report a number whose replayed answer drifted from a direct engine
+    run (``bit_identical`` gates).
+
+    ``size``/``levels`` are parameters so tier-1 can run the identical
+    methodology at toy scale; the bench runs 64^2.
+    """
+    import signal
+    import tempfile
+
+    from image_analogies_tpu.config import AnalogyParams
+    from image_analogies_tpu.models.analogy import create_image_analogy
+    from image_analogies_tpu.serve.fleet import Fleet
+    from image_analogies_tpu.serve.types import FleetConfig, ServeConfig
+
+    a, ap, b = make_structured(size, seed)
+    # second target on the SAME exemplar: identical batch key -> same
+    # home worker as the warm request
+    b2 = np.ascontiguousarray(b[::-1])
+    params = AnalogyParams(levels=levels, kappa=5.0, backend="cpu")
+    baseline = np.asarray(create_image_analogy(a, ap, b2, params).bp)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        scfg = ServeConfig(params=params, queue_depth=8,
+                           batch_window_ms=2000.0, max_batch=2,
+                           workers=1, cost_persist=False,
+                           journal_fsync=False)
+        fcfg = FleetConfig(serve=scfg, size=2, vnodes=16,
+                           journal_root=os.path.join(tmp, "journals"),
+                           transport="subprocess",
+                           health_interval_s=0.05, death_checks=2,
+                           backoff_s=0.01, backoff_cap_s=0.05)
+        with Fleet(fcfg) as fl:
+            # warm the home: computes, journals done, pins which worker
+            # owns the exemplar's batch key
+            fl.submit(a, ap, b, idempotency_key="bench-handoff-warm"
+                      ).result(timeout=600)
+            workers = fl.health()["workers"]
+            home = next(w for w, info in sorted(workers.items())
+                        if (info.get("journal") or {}).get("done", 0))
+            victim_pid = workers[home]["pid"]
+            fut = fl.submit(a, ap, b2,
+                            idempotency_key="bench-handoff-victim")
+            # wait until the victim request is journaled (admitted: the
+            # entry the replacement must replay), then kill
+            end = time.monotonic() + 60.0
+            while time.monotonic() < end:
+                j = (fl.health()["workers"].get(home, {})
+                     .get("journal") or {})
+                if j.get("admitted", 0) >= 2:
+                    break
+                time.sleep(0.01)
+            t0 = time.perf_counter()
+            os.kill(victim_pid, signal.SIGKILL)
+            res = fut.result(timeout=600)
+            recovery_ms = (time.perf_counter() - t0) * 1e3
+            post = fl.health()["workers"].get(home, {})
+    return {
+        "handoff_recovery_ms": round(recovery_ms, 1),
+        "victim_pid": victim_pid,
+        "replacement_pid": post.get("pid"),
+        "replacement_generation": post.get("generation"),
+        "stale_lock_swept": int((post.get("journal") or {})
+                                .get("stale_lock_swept", 0)),
+        "bit_identical": bool(np.array_equal(np.asarray(res.bp),
+                                             baseline)),
         "size": size,
         "levels": levels,
     }
@@ -885,6 +1004,17 @@ def main() -> int:
     exemplar_scale = measure_exemplar_scaling()
     configs["exemplar_scale_64"] = exemplar_scale
 
+    # ---- fleet handoff recovery (PR 15): SIGKILL a subprocess worker
+    # mid-request; the headline is kill -> the replacement answering on
+    # the SAME journal dir at 64^2 (spawn + lock sweep + replay, the
+    # full failover cost); bit-identity of the replayed answer gates
+    handoff = measure_handoff_recovery()
+    configs["handoff_recovery_64"] = handoff
+    if not handoff["bit_identical"]:
+        raise SystemExit("replayed handoff answer drifted from a direct "
+                         "engine run — refusing to record "
+                         "handoff_recovery_ms")
+
     # ---- configs 1/3/5 (BASELINE.json:7-12): texture-by-numbers,
     # super-res kappa sweep, batched video — live oracles at native sizes
     # (round-4 VERDICT item 6: the driver artifact must substantiate all
@@ -1109,6 +1239,7 @@ def main() -> int:
         "exemplar_scale_ratio": exemplar_scale["exemplar_scale_ratio"],
         "timeline_overhead_pct":
             timeline_overhead["timeline_overhead_pct"],
+        "handoff_recovery_ms": handoff["handoff_recovery_ms"],
         "vs_baseline": round(oracle_s / ns_s, 1),
         "ssim_vs_oracle": round(ns_ssim, 4),
         "value_match": round(ns_match, 4),

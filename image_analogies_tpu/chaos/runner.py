@@ -23,6 +23,7 @@ schedule-determinism check (same seed ⇒ same fault schedule).
 from __future__ import annotations
 
 import os
+import signal
 import tempfile
 import time
 from typing import Any, Dict, List, Optional, Sequence
@@ -44,6 +45,10 @@ _KIND_NOTES = {
                      "exactly once after kill+restart",
     "fleet_death": "router hands a dead worker's journal to its "
                    "replacement; spillover + dedupe answer exactly once",
+    "fleet_death_subprocess": "REAL SIGKILL of a subprocess worker "
+                              "mid-batch; replacement sweeps the foreign "
+                              "stale lock, replays, and every request "
+                              "answers exactly once",
     "batch_partial": "one lane faults mid-batch; the other lanes resolve "
                      "bit-identically",
     "devcache_tier": "mid-request catalog tier eviction falls through to "
@@ -59,8 +64,9 @@ _KIND_NOTES = {
 # are drill names rather than members of FAULT_KINDS.
 def _drill_kinds():
     from image_analogies_tpu.chaos import FAULT_KINDS
-    return tuple(FAULT_KINDS) + ("fleet_death", "batch_partial",
-                                 "devcache_tier", "ann_corrupt")
+    return tuple(FAULT_KINDS) + ("fleet_death", "fleet_death_subprocess",
+                                 "batch_partial", "devcache_tier",
+                                 "ann_corrupt")
 
 
 DRILL_KINDS = _drill_kinds()
@@ -109,6 +115,18 @@ def plan_for_kind(kind: str, seed: int = 0) -> ChaosPlan:
                                             schedule=(7,))),
                  ("router.forward", SiteRule(kind="transient",
                                              schedule=(4,))))
+    elif kind == "fleet_death_subprocess":
+        # Subprocess fleet drill geometry: the death is a REAL SIGKILL
+        # delivered by the drill itself (no serve.journal site — chaos
+        # is armed only in the ROUTER process; the child never sees a
+        # plan, which is itself the disarmed-zero-cost contract at
+        # work).  router.forward visits 0..3 are the four original
+        # routed submits; the post-handoff resubmits start at visit 4,
+        # so the FIRST resubmit eats a transient hop fault and must
+        # spill to the ring successor (which computes fresh,
+        # bit-identically, in its own journal).
+        sites = (("router.forward", SiteRule(kind="transient",
+                                             schedule=(4,))),)
     elif kind == "devcache_tier":
         # Catalog-tier drill geometry (2 levels, warmed catalog): the
         # devcache.tier site is visited once per level's tier
@@ -795,6 +813,256 @@ def drill_fleet(plan: ChaosPlan, *, n: int = 4, seed: int = 7
         }
 
 
+def drill_fleet_subprocess(plan: ChaosPlan, *, n: int = 4, seed: int = 7
+                           ) -> Dict[str, Any]:
+    """Fleet death drill against REAL subprocess workers.
+
+    Same exactly-once bar as :func:`drill_fleet`, but the death is a
+    real ``SIGKILL`` delivered to a child pid — no fault plane inside
+    the worker, no python-level unwinding, the kernel just takes it.
+    What that buys over the in-process drill:
+
+    - the journal's advisory lock holds a FOREIGN pid, so the
+      replacement exercises the true stale-lock sweep (dead-pid probe,
+      ``serve.journal.stale_lock_swept``) instead of the same-process
+      shortcut;
+    - the router's in-flight hops die as socket disconnects
+      (``router.hop_disconnects``), leaving futures unresolved for the
+      handoff to re-answer — the wire-level version of the stranded
+      future the in-process drill stages;
+    - recovery replays in a fresh interpreter: bit-identity across the
+      handoff is proven across a process boundary, not a scope swap.
+
+    Flow: wave 1 routes one request to the home worker and waits for
+    its ``done`` record (so the replacement must dedupe against a prior
+    incarnation's segment).  Wave 2 routes n-1 more, waits until the
+    home child's journal shows them admitted (mid-coalesce, wide batch
+    window), then SIGKILLs the home pid.  The health loop declares
+    death, re-spawns generation 1 on the SAME journal dir; recovery
+    sweeps the foreign lock, advances the segment, replays the
+    incomplete entries, and the router's re-forwards join-replay onto
+    them.  Then every request is resubmitted under its original key:
+    the first eats the scheduled ``router.forward`` transient and
+    spills to the ring successor (fresh compute, own journal); the
+    rest dedupe against the replacement's journal.  Ground truth is
+    read twice: live via /healthz (lock pid, segment, sweep counter)
+    and offline via ``RequestJournal.inspect()`` after shutdown.
+
+    One honest difference from the in-process drill: SIGKILL runs no
+    death hook, so there is NO flight-recorder blackbox to assert — the
+    corpse's journal directory is the only evidence, which is exactly
+    the point."""
+    from image_analogies_tpu.obs import trace as obs_trace
+    from image_analogies_tpu.serve import journal as serve_journal
+    from image_analogies_tpu.serve.fleet import Fleet
+    from image_analogies_tpu.serve.types import FleetConfig
+
+    with tempfile.TemporaryDirectory() as tmp:
+        # Wide batch window: wave 2 must still be coalescing when the
+        # SIGKILL lands, so its entries are admitted-not-done and the
+        # replacement has real replay work.
+        cfg = drills.serve_config(workers=1, max_batch=n,
+                                  batch_window_ms=2000.0)
+        fcfg = FleetConfig(serve=cfg, size=2, vnodes=16,
+                           journal_root=os.path.join(tmp, "journals"),
+                           transport="subprocess",
+                           health_interval_s=0.1, death_checks=2,
+                           backoff_s=0.01, backoff_cap_s=0.05)
+        load = drills.make_serve_load(n, seed=seed)
+        baseline = {item["index"]: drills.run_image(
+            item["a"], item["ap"], item["b"], cfg.params)
+            for item in load}
+        ikey = "fleet-kill-{}".format
+
+        problems: List[str] = []
+        with obs_trace.run_scope(cfg.params) as ctx:
+            # Armed in the ROUTER process only: spawned children never
+            # see the plan (nothing propagates a ChaosPlan over the
+            # spawn handshake) — the disarmed-zero-cost contract holds
+            # in every worker while the parent schedules hop faults.
+            inject.arm(plan)
+            try:
+                with Fleet(fcfg) as fl:
+                    futures = {}
+                    # wave 1: one request, answered and journaled done
+                    # before the death (forward visit 0)
+                    item0 = load[0]
+                    futures[item0["index"]] = fl.submit(
+                        item0["a"], item0["ap"], item0["b"],
+                        idempotency_key=ikey(item0["index"]))
+                    futures[item0["index"]].result(timeout=180)
+
+                    def _journal(wid):
+                        w = fl.health()["workers"].get(wid, {})
+                        return w.get("journal") or {}
+
+                    home = next(
+                        (wid for wid in fl.workers
+                         if _journal(wid).get("done", 0) >= 1), None)
+                    if home is None:
+                        raise RuntimeError(
+                            "no worker journaled wave-1 done")
+                    victim_pid = fl.workers[home].pid
+
+                    # wave 2: n-1 requests coalescing in the home
+                    # child's batch window (forward visits 1..n-1)
+                    for item in load[1:]:
+                        futures[item["index"]] = fl.submit(
+                            item["a"], item["ap"], item["b"],
+                            idempotency_key=ikey(item["index"]))
+                    end = time.monotonic() + 60.0
+                    while (_journal(home).get("admitted", 0) < n - 1
+                           and time.monotonic() < end):
+                        time.sleep(0.02)
+                    if _journal(home).get("admitted", 0) < n - 1:
+                        raise RuntimeError(
+                            "wave-2 requests never admitted")
+
+                    # the real death: kernel-level, mid-coalesce
+                    os.kill(victim_pid, signal.SIGKILL)
+
+                    end = time.monotonic() + 120.0
+                    while not fl.handoffs and time.monotonic() < end:
+                        time.sleep(0.02)
+                    handoffs = list(fl.handoffs)
+                    # every ORIGINAL future must still answer — the
+                    # handoff re-forwards join-replay onto the
+                    # replacement's recovery
+                    originals = {i: f.result(timeout=180)
+                                 for i, f in futures.items()}
+                    # resubmit EVERY request under its original key:
+                    # visit n faults -> the first resubmit spills to
+                    # the ring successor; the rest dedupe
+                    replies = {}
+                    for item in load:
+                        replies[item["index"]] = fl.submit(
+                            item["a"], item["ap"], item["b"],
+                            idempotency_key=ikey(item["index"])
+                        ).result(timeout=180)
+                    fleet_health = fl.health()
+                    replacement = fleet_health["workers"].get(home, {})
+                    snap = inject.snapshot()
+            finally:
+                inject.disarm()
+            counters = _counters(ctx)
+
+        if not handoffs:
+            problems.append("no journal handoff happened (dead drill)")
+        else:
+            rec = handoffs[0].get("recovered", {})
+            if handoffs[0].get("worker") != home:
+                problems.append("handoff names wrong worker")
+            if rec.get("entries") != n:
+                problems.append(
+                    f"handoff recovered {rec.get('entries')} entries "
+                    f"!= {n} admitted")
+            if rec.get("poisoned"):
+                problems.append(
+                    f"handoff poisoned {rec.get('poisoned')} entries")
+        # The replacement is a NEW process on the OLD journal dir: its
+        # lock must hold its own (fresh) pid, the dead child's lock
+        # must have been swept as a foreign stale pid, and the segment
+        # must have advanced past the corpse's.
+        rep_pid = replacement.get("pid")
+        rep_journal = replacement.get("journal") or {}
+        if replacement.get("generation") != 1:
+            problems.append(
+                f"replacement generation {replacement.get('generation')}"
+                " != 1")
+        if rep_pid in (None, victim_pid, os.getpid()):
+            problems.append(
+                f"replacement pid {rep_pid} is not a fresh child "
+                f"(victim {victim_pid}, parent {os.getpid()})")
+        if rep_journal.get("lock_pid") != rep_pid:
+            problems.append(
+                f"journal lock_pid {rep_journal.get('lock_pid')} != "
+                f"replacement pid {rep_pid}")
+        if rep_journal.get("segment") != 2:
+            problems.append(
+                f"journal segment {rep_journal.get('segment')} != 2 "
+                "(did not advance past the corpse's)")
+        if rep_journal.get("stale_lock_swept", 0) < 1:
+            problems.append("foreign stale lock was not swept")
+        identical = all(
+            np.array_equal(originals[i].bp, baseline[i])
+            for i in originals)
+        identical = identical and all(
+            np.array_equal(replies[i].bp, baseline[i]) for i in replies)
+        if not identical:
+            problems.append("fleet output differs from clean run")
+        # Router-side ledger (journal counters live in the CHILDREN —
+        # asserted via /healthz above and disk below, not here).
+        for name, expect in (("router.deaths", 1),
+                             ("router.handoffs", 1),
+                             ("router.spills", 1),
+                             ("router.resubmitted", n - 1),
+                             ("router.hop_disconnects", n - 1),
+                             ("router.crash_loops", 0)):
+            got = counters.get(name, 0)
+            if got != expect:
+                problems.append(f"{name}={got} != expected {expect}")
+        problems += _reconcile(plan, counters)
+        injected = sum(st["injected"] for st in snap.values())
+        if injected != 1:
+            problems.append(
+                f"expected exactly the hop transient, got {injected}")
+        # Offline ground truth: both children are gone (SIGTERM drain on
+        # fleet exit), so read the journals straight off disk.
+        home_dir = os.path.join(fcfg.journal_root, home)
+        disk = serve_journal.RequestJournal(home_dir).inspect()
+        if disk.get("requests") != n:
+            problems.append(
+                f"home journal holds {disk.get('requests')} requests "
+                f"!= {n}")
+        if disk.get("states", {}).get("done", 0) != n:
+            problems.append(
+                f"home journal done states {disk.get('states')} != "
+                f"all-{n}-done")
+        if disk.get("segments") != 2:
+            problems.append(
+                f"home journal has {disk.get('segments')} segments "
+                "!= 2 (one per incarnation)")
+        if disk.get("incomplete") or disk.get("poisoned"):
+            problems.append("home journal left incomplete/poisoned work")
+        succ = next((w for w in fleet_health["workers"] if w != home),
+                    None)
+        sdisk = (serve_journal.RequestJournal(
+            os.path.join(fcfg.journal_root, succ)).inspect()
+            if succ else {})
+        if sdisk.get("states", {}).get("done", 0) != 1:
+            problems.append(
+                f"successor journal {sdisk.get('states')} != exactly "
+                "the one spilled request done")
+        return {
+            "workload": "fleet_subprocess",
+            "plan": plan.to_dict(),
+            "injected": injected,
+            "sites": snap,
+            "handoffs": handoffs,
+            "victim_pid": victim_pid,
+            "replacement": {"pid": rep_pid,
+                            "generation": replacement.get("generation"),
+                            "journal": rep_journal},
+            "disk": {"home": disk, "successor": sdisk},
+            "fleet": {"pending": fleet_health.get("pending"),
+                      "ring": fleet_health.get("ring"),
+                      "transport": fleet_health.get("transport")},
+            "outcomes": {
+                "answered": len(originals),
+                "resubmitted": int(counters.get("router.resubmitted", 0)),
+                "hop_disconnects": int(
+                    counters.get("router.hop_disconnects", 0)),
+                "stale_lock_swept": int(
+                    rep_journal.get("stale_lock_swept", 0)),
+            },
+            "counters": {k: v for k, v in counters.items()
+                         if k.startswith(("chaos.", "serve.", "router."))},
+            "identical": identical,
+            "ok": not problems,
+            "problems": problems,
+        }
+
+
 def drill_batch_partial(plan: ChaosPlan, *, k: int = 3, seed: int = 7
                         ) -> Dict[str, Any]:
     """Batched-engine lane-fault drill: k targets dispatch as ONE engine
@@ -862,6 +1130,8 @@ def run_drill(plan: ChaosPlan, **kw) -> Dict[str, Any]:
     if any(name == "engine.batch" for name, _ in plan.sites):
         return drill_batch_partial(plan, **kw)
     if any(name == "router.forward" for name, _ in plan.sites):
+        if "subprocess" in (plan.name or ""):
+            return drill_fleet_subprocess(plan, **kw)
         return drill_fleet(plan, **kw)
     if any(name == "serve.journal" for name, _ in plan.sites):
         return drill_kill_restart(plan, **kw)

@@ -479,6 +479,7 @@ def cmd_fleet(args) -> int:
         size=args.size,
         journal_root=args.journal,
         wire=args.wire,
+        transport=args.transport,
     )
 
     if args.selftest is not None:
@@ -790,6 +791,7 @@ def cmd_bench(args) -> int:
     fresh_cold = None
     fresh_scale = None
     fresh_timeline = None
+    fresh_handoff = None
     fresh_key = args.metric_key
     if args.value is not None:
         fresh = args.value
@@ -813,6 +815,8 @@ def cmd_bench(args) -> int:
                 fresh_scale = float(doc["exemplar_scale_ratio"])
             if doc.get("timeline_overhead_pct") is not None:
                 fresh_timeline = float(doc["timeline_overhead_pct"])
+            if doc.get("handoff_recovery_ms") is not None:
+                fresh_handoff = float(doc["handoff_recovery_ms"])
         else:
             head = bench.extract_headline(doc if isinstance(doc, dict)
                                           else {})
@@ -826,6 +830,7 @@ def cmd_bench(args) -> int:
             fresh_cold = head.get("cold_start_ms")
             fresh_scale = head.get("exemplar_scale_ratio")
             fresh_timeline = head.get("timeline_overhead_pct")
+            fresh_handoff = head.get("handoff_recovery_ms")
             if fresh_key is None:
                 fresh_key = head.get("metric_key")
     verdict = bench.check_regression(trajectory, fresh_value=fresh,
@@ -835,7 +840,8 @@ def cmd_bench(args) -> int:
                                      fresh_obs=fresh_obs,
                                      fresh_cold=fresh_cold,
                                      fresh_scale=fresh_scale,
-                                     fresh_timeline=fresh_timeline)
+                                     fresh_timeline=fresh_timeline,
+                                     fresh_handoff=fresh_handoff)
     print(json.dumps(verdict, sort_keys=True))
     for problem in verdict.get("problems", []):
         print(f"bench: warning: {problem}", file=sys.stderr)
@@ -1178,6 +1184,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="router<->worker hop encoding: auto/binary "
                          "negotiate the IAF2 raw-f32 frame, json forces "
                          "the fallback list transport")
+    fp.add_argument("--transport", choices=("inproc", "subprocess"),
+                    default="inproc",
+                    help="worker isolation: inproc keeps each worker an "
+                         "in-process Server (zero-copy hops); subprocess "
+                         "spawns each as a real OS process on a loopback "
+                         "port — SIGKILL-able, journal lock holds a real "
+                         "foreign pid, hops speak IAF2 over HTTP")
     fp.add_argument("--journal", default=None, metavar="DIR",
                     help="journal ROOT: each worker journals under "
                          "DIR/<wid>; a dead worker's directory is handed "

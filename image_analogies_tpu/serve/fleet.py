@@ -1,33 +1,47 @@
-"""In-process worker fleet: N Servers behind one consistent-hash Router.
+"""Worker fleet: N workers behind one consistent-hash Router.
 
 The management half of ROADMAP direction 1.  Each worker is a full
 :class:`serve.server.Server` (own queue, batcher, breaker, journal
-directory) with a STABLE identity ``w0..w{size-1}``: the wid owns the
-ring slot and the journal directory, so a replacement worker inherits
-both — affinity for untouched keys is preserved trivially and the
-dead worker's write-ahead journal is recovered by whoever takes the
-wid next (the handoff the PR 7 roadmap note promised).
+directory) reached through a :class:`serve.transport.Transport` — in
+the same process by default, or as a real child process
+(``transport="subprocess"``) on its own loopback HTTP port.  Either
+way the worker has a STABLE identity ``w0..w{size-1}``: the wid owns
+the ring slot and the journal directory, so a replacement worker
+inherits both — affinity for untouched keys is preserved trivially and
+the dead worker's write-ahead journal is recovered by whoever takes
+the wid next (the handoff the PR 7 roadmap note promised).
 
 Health gate loop (daemon thread, ``health_interval_s`` cadence):
 
-- ``Server.health()`` raising, or reporting not-accepting / zero alive
+- ``handle.health()`` raising, or reporting not-accepting / zero alive
   worker threads, counts a MISS; ``death_checks`` consecutive misses
   declare the worker dead and trigger :meth:`_replace` — kill the old
-  incarnation (releasing the journal lock), start a replacement on the
-  SAME journal dir (``Server.start`` runs ``recover()`` before
-  traffic: done-dedupe, admit-order replay, poison preserved), then
-  hand the router every stranded in-flight future to re-answer by
-  idempotency key.
+  incarnation (SIGKILL for a subprocess: the journal lock is left on
+  disk holding a real foreign pid, swept by the replacement's open()),
+  start a replacement on the SAME journal dir (``Server.start`` runs
+  ``recover()`` before traffic: done-dedupe, admit-order replay,
+  poison preserved), then hand the router every stranded in-flight
+  future to re-answer by idempotency key.
+- A worker that is ALIVE but replaying its journal reports
+  ``recovering: true`` — liveness without readiness.  The death
+  verdict is gated on liveness only: a long recovery must not look
+  like a corpse and trigger a spurious second handoff.
 - An open breaker or a queue at ``spill_queue_frac`` of depth GATES the
   worker: the router spills its keys to the next ring successor until
   the gate clears.  Gating is advisory and reversible; death is not.
+- Every death consults the :class:`transport.CrashLoopSupervisor`:
+  rapid deaths (within ``crash_loop_window_s`` of their own spawn)
+  back off before respawn, and ``crash_loop_threshold`` consecutive
+  rapid deaths park the slot (gate ``"crash_loop"``,
+  ``router.crash_loops``) instead of burning spawns forever — an
+  operator ``ungate_worker`` re-arms it.
 
 Wire negotiation (satellite of the IAF2 work in serve/wire.py): every
 router->worker hop round-trips the three request planes (and the
 response planes) through the negotiated codec — IAF2 binary frames by
-default, JSON lists on fallback — so the in-process fleet exercises the
-exact encode/decode path a remote fleet would, and the bit-identity
-gates prove both codecs are exact for f32.
+default, JSON lists on fallback.  In-process that rehearses the exact
+encode/decode path; over the subprocess transport the same frames
+actually cross the process boundary as HTTP bodies.
 
 Host-side only: no jax imports, no jit (serve grep-lock scans this
 file).  Device work happens inside each worker's engine.
@@ -37,93 +51,24 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
-import json as _json
 import os
 import threading
 import time
-from concurrent.futures import Future
 from typing import Any, Dict, List, Optional
-
-import numpy as np
 
 from image_analogies_tpu.obs import fleet as obs_fleet
 from image_analogies_tpu.obs import live as obs_live
 from image_analogies_tpu.obs import metrics as obs_metrics
 from image_analogies_tpu.obs import timeline as obs_timeline
 from image_analogies_tpu.obs import trace as obs_trace
-from image_analogies_tpu.serve import wire
+from image_analogies_tpu.serve import transport as serve_transport
+# Re-exported for embedders/tests that import the handle machinery from
+# its historical home (the seam moved it to serve/transport.py).
+from image_analogies_tpu.serve.transport import (  # noqa: F401
+    CrashLoopSupervisor, WorkerHandle, _roundtrip_iaf2, _roundtrip_json,
+    _wrap_response)
 from image_analogies_tpu.serve.router import Router
-from image_analogies_tpu.serve.server import Server
-from image_analogies_tpu.serve.types import FleetConfig, Response
-
-
-def _roundtrip_iaf2(arrays: List[np.ndarray]) -> List[np.ndarray]:
-    return wire.decode_planes(wire.encode_planes(arrays))
-
-
-def _roundtrip_json(arrays: List[np.ndarray]) -> List[np.ndarray]:
-    # Exact for f32: tolist() yields doubles holding each f32 exactly;
-    # JSON repr round-trips doubles; nearest-f32 of that double is the
-    # original value.  The bit-identity gates re-verify, not assume.
-    return [np.asarray(_json.loads(_json.dumps(
-        np.asarray(a, np.float32).tolist())), dtype=np.float32)
-        for a in arrays]
-
-
-class WorkerHandle:
-    """One fleet slot: stable wid + the current Server incarnation."""
-
-    # What a worker advertises to codec negotiation.  In-process
-    # workers always speak both; a remote worker would advertise its
-    # own set here.
-    wire_formats = ("iaf2", "json")
-
-    def __init__(self, wid: str, server: Server, generation: int,
-                 codec: str,
-                 scope: Optional[obs_metrics.ObsScope] = None):
-        self.wid = wid
-        self.server = server
-        self.generation = generation
-        self.codec = codec
-        self.scope = scope
-
-    def recovery_future(self, idem: str) -> Optional["Future[Response]"]:
-        """The replay future recover() registered for ``idem`` (already
-        codec-wrapped), or None if the journal had no incomplete entry."""
-        src = self.server.recovery.get(idem)
-        if src is None:
-            return None
-        return _wrap_response(src, self.codec)
-
-
-def _wrap_response(src: "Future[Response]", codec: str
-                   ) -> "Future[Response]":
-    """Chain a worker future through the response-side wire codec."""
-    out: "Future[Response]" = Future()
-
-    def _done(f: "Future[Response]") -> None:
-        if out.done():
-            return
-        exc = f.exception()
-        if exc is not None:
-            out.set_exception(exc)
-            return
-        resp = f.result()
-        try:
-            if codec == "iaf2":
-                frame = wire.encode_planes(
-                    [np.asarray(resp.bp, np.float32),
-                     np.asarray(resp.bp_y, np.float32)])
-                obs_metrics.inc("router.wire_bytes", len(frame))
-                bp, bp_y = wire.decode_planes(frame)
-            else:
-                bp, bp_y = _roundtrip_json([resp.bp, resp.bp_y])
-            out.set_result(dataclasses.replace(resp, bp=bp, bp_y=bp_y))
-        except Exception as wexc:  # noqa: BLE001 - protocol error
-            out.set_exception(wexc)
-
-    src.add_done_callback(_done)
-    return out
+from image_analogies_tpu.serve.types import FleetConfig, Rejected, Response
 
 
 class Fleet:
@@ -131,7 +76,11 @@ class Fleet:
 
     def __init__(self, cfg: FleetConfig):
         self.cfg = cfg
-        self.workers: Dict[str, WorkerHandle] = {}
+        self.workers: Dict[str, Any] = {}
+        self.transport = serve_transport.make_transport(cfg.transport)
+        self.supervisor = serve_transport.CrashLoopSupervisor(
+            cfg.crash_loop_window_s, cfg.crash_loop_threshold,
+            cfg.backoff_s, cfg.backoff_cap_s)
         self.router = Router(self, vnodes=cfg.vnodes,
                              spill_retries=cfg.spill_retries,
                              backoff_s=cfg.backoff_s,
@@ -143,8 +92,9 @@ class Fleet:
         self._stop = threading.Event()
         self._health_thread: Optional[threading.Thread] = None
         self._started = False
-        # Fleet-level obs scope (parent of every worker scope) + the
-        # health loop's scrape cache: wid -> {scope, t, snapshot}.
+        # Fleet-level obs scope (parent of every in-process worker
+        # scope) + the health loop's scrape cache:
+        # wid -> {scope, t, snapshot}.
         self._scope: Optional[obs_metrics.ObsScope] = None
         self._scope_exit = contextlib.ExitStack()
         self._scrapes: Dict[str, Dict[str, Any]] = {}
@@ -164,15 +114,12 @@ class Fleet:
             return "iaf2"
         return "json"
 
-    def _spawn(self, wid: str, generation: int) -> WorkerHandle:
-        # Per-worker obs scope: the worker's counters/spans land in its
-        # OWN registry (isolated view for /metrics?worker=) and chain to
-        # the fleet scope, so fleet-wide snapshots keep summing.
-        scope = obs_metrics.ObsScope(
-            scope_id="{}.g{}".format(wid, generation), parent=self._scope)
-        server = Server(self._worker_cfg(wid), obs_scope=scope).start()
-        codec = self._negotiate(WorkerHandle.wire_formats)
-        handle = WorkerHandle(wid, server, generation, codec, scope=scope)
+    def _spawn(self, wid: str, generation: int):
+        codec = self._negotiate(self.transport.handle_cls.wire_formats)
+        handle = self.transport.spawn(
+            wid, generation, self._worker_cfg(wid), codec,
+            scope_parent=self._scope,
+            spawn_timeout_s=self.cfg.spawn_timeout_s)
         with self._lock:
             self.workers[wid] = handle
             self._misses[wid] = 0
@@ -191,7 +138,8 @@ class Fleet:
             self.cfg.serve.params.replace(metrics=True),
             manifest_extra={"fleet": {"size": self.cfg.size,
                                       "wire": self.cfg.wire,
-                                      "vnodes": self.cfg.vnodes}}))
+                                      "vnodes": self.cfg.vnodes,
+                                      "transport": self.cfg.transport}}))
         self._scope = obs_metrics.current_scope()
         # Temporal plane: the health loop below is the fleet's sampling
         # cadence — arm the process timeline for the fleet's lifetime so
@@ -225,7 +173,7 @@ class Fleet:
         if self._health_thread is not None:
             self._health_thread.join(5.0)
         for handle in list(self.workers.values()):
-            handle.server.shutdown()
+            handle.shutdown()
         obs_timeline.disarm()
         self._scope_exit.close()
         self._started = False
@@ -254,41 +202,16 @@ class Fleet:
     def ungate_worker(self, wid: str) -> None:
         with self._lock:
             self._gates.pop(wid, None)
+        self.supervisor.reset(wid)
 
     def forward(self, wid: str, a, ap, b, params,
                 deadline_s: Optional[float], idem: Optional[str]
                 ) -> "Future[Response]":
-        """One router->worker hop: request planes AND the trace context
-        through the negotiated codec, submit, response planes back
-        through the codec."""
-        handle = self.workers[wid]
-        ctx = obs_trace.capture_trace()
-        if handle.codec == "iaf2":
-            planes = [np.asarray(x, np.float32) for x in (a, ap, b)]
-            frame = wire.encode_planes(planes)
-            obs_metrics.inc("router.wire_bytes", len(frame))
-            a, ap, b = wire.decode_planes(frame)
-            if ctx:
-                # The IAT1 side frame rides next to the plane frame; the
-                # roundtrip is the same process-boundary rehearsal the
-                # planes get.
-                cframe = wire.encode_context(ctx)
-                obs_metrics.inc("router.wire_bytes", len(cframe))
-                ctx = wire.decode_context(cframe)
-        else:
-            a, ap, b = _roundtrip_json([a, ap, b])
-            if ctx:
-                ctx = _json.loads(_json.dumps(ctx))
-        obs_metrics.inc("router.wire.{}".format(handle.codec))
-        # Submit under the DECODED context: the worker-side Request
-        # carries exactly what survived the wire, so the stitched trace
-        # proves cross-codec propagation, not thread-local leakage.
-        with obs_trace.request_context(**ctx) if ctx \
-                else contextlib.nullcontext():
-            src = handle.server.submit(a, ap, b, params=params,
-                                       deadline_s=deadline_s,
-                                       idempotency_key=idem)
-        return _wrap_response(src, handle.codec)
+        """One router->worker hop through the transport handle: request
+        planes AND the trace context through the negotiated codec,
+        submit, response planes back through the codec."""
+        return self.workers[wid].forward(a, ap, b, params, deadline_s,
+                                         idem)
 
     def submit(self, a, ap, b, params=None, deadline_s=None,
                idempotency_key=None) -> "Future[Response]":
@@ -300,15 +223,21 @@ class Fleet:
     # ------------------------------------------------------------------
     # health gate loop
 
-    def _judge(self, handle: WorkerHandle) -> Optional[str]:
+    def _judge(self, handle) -> Optional[str]:
         """None = healthy; "dead" = missed; else a gate reason."""
         try:
-            h = handle.server.health()
+            h = handle.health()
         except Exception:  # noqa: BLE001 - unresponsive counts as dead
             return "dead"
         workers = h.get("workers") or {}
         if not h.get("accepting") or workers.get("alive", 0) == 0:
             return "dead"
+        if h.get("recovering"):
+            # Alive but not READY: journal replay in flight.  Liveness
+            # gates the death verdict, and no advisory gate either —
+            # spilling keys whose replay is about to answer them would
+            # double-compute work the journal already holds.
+            return None
         breakers = h.get("breakers") or {}
         if any(state == "open" for state in breakers.values()):
             return "breaker_open"
@@ -317,19 +246,22 @@ class Fleet:
             return "saturated"
         return None
 
-    def _scrape_locked(self, wid: str, handle: WorkerHandle) -> None:
-        """Cache a metrics snapshot of the worker's obs scope (lock held).
+    def _scrape_locked(self, wid: str, handle) -> None:
+        """Cache a metrics snapshot of the worker's registry (lock held).
 
         The health loop is the fleet's scrape cadence: each pass stores
         the worker's isolated registry snapshot plus when it was taken,
         so /healthz can report scrape freshness per worker and a merged
         view is available even for a worker that dies mid-interval.
+        In-process that reads the chained scope registry; over the
+        subprocess transport it is a /metrics.json fetch (None while
+        the child is unreachable — keep the last good scrape).
         """
-        if handle.scope is None:
+        snap = handle.snapshot()
+        if snap is None:
             return
-        snap = handle.scope.registry.snapshot()
         self._scrapes[wid] = {
-            "scope": handle.scope.scope_id,
+            "scope": handle.scope_id,
             "t": time.monotonic(),
             "snapshot": snap,
         }
@@ -354,6 +286,10 @@ class Fleet:
                 if handle is None:
                     continue
                 with self._lock:
+                    if self._gates.get(wid) == "crash_loop":
+                        # Parked by the supervisor: no polls, no
+                        # respawns, until an operator ungates.
+                        continue
                     self._scrape_locked(wid, handle)
                 verdict = self._judge(handle)
                 if verdict == "dead":
@@ -376,20 +312,47 @@ class Fleet:
     # ------------------------------------------------------------------
     # death + journal handoff
 
-    def _replace(self, wid: str) -> WorkerHandle:
+    def _replace(self, wid: str):
         """Declare ``wid`` dead, hand its journal dir to a replacement,
-        and let the router re-answer stranded futures."""
+        and let the router re-answer stranded futures.  Returns the
+        replacement handle, or None when the crash-loop supervisor
+        parked the slot instead."""
         old = self.workers[wid]
+        uptime_s = time.monotonic() - getattr(old, "spawned_at", 0.0)
         with self._lock:
             self._gates[wid] = "dead"
         obs_metrics.inc("router.deaths")
         obs_trace.emit_record({"event": "router_death", "worker": wid,
                                "generation": old.generation})
-        # kill() releases the journal lock; the replacement's open()
-        # starts a fresh segment and recover() replays what's left.
-        old.server.kill()
+        # kill() releases the journal lock (in-process) or abandons it
+        # on disk (subprocess SIGKILL — a real foreign stale lock); the
+        # replacement's open() sweeps it, starts a fresh segment, and
+        # recover() replays what's left.
+        old.kill()
+        verdict = self.supervisor.on_death(wid, uptime_s)
+        if verdict["rapid"]:
+            obs_metrics.inc("router.crash_loop_rapid")
+        if verdict["gate"]:
+            # Crash loop: park the slot instead of respawning forever.
+            # Stranded futures get a terminal verdict — with no
+            # replacement coming, hanging them would strand clients.
+            obs_metrics.inc("router.crash_loops")
+            obs_trace.emit_record({"event": "router_crash_loop",
+                                   "worker": wid,
+                                   "rapid": verdict["rapid"]})
+            with self._lock:
+                self._gates[wid] = "crash_loop"
+                self._misses[wid] = 0
+            self.router.fail_pending(wid, Rejected("crash_loop"))
+            return None
+        if verdict["delay_s"]:
+            obs_trace.emit_record({"event": "router_respawn_backoff",
+                                   "worker": wid,
+                                   "delay_s": verdict["delay_s"]})
+            if self._stop.wait(verdict["delay_s"]):
+                return None  # fleet shutting down mid-backoff
         handle = self._spawn(wid, generation=old.generation + 1)
-        recovered = handle.server.recovery_stats or {}
+        recovered = handle.recovery_stats()
         obs_metrics.inc("router.handoffs")
         obs_trace.emit_record({"event": "router_handoff", "worker": wid,
                                "generation": handle.generation,
@@ -406,13 +369,13 @@ class Fleet:
     # ------------------------------------------------------------------
     # observability
 
-    def _worker_obs(self, wid: str, handle: WorkerHandle) -> Dict[str, Any]:
+    def _worker_obs(self, wid: str, handle) -> Dict[str, Any]:
         """Obs identity for /healthz: which scope serves this wid and how
         stale the health loop's last scrape of it is."""
         with self._lock:
             scrape = self._scrapes.get(wid)
         obs: Dict[str, Any] = {
-            "scope": handle.scope.scope_id if handle.scope else None,
+            "scope": handle.scope_id,
         }
         if scrape is not None:
             obs["last_scrape_age_s"] = round(
@@ -423,21 +386,28 @@ class Fleet:
 
     def metrics_snapshots(self) -> Dict[str, Dict[str, Any]]:
         """Fresh per-worker registry snapshots keyed by wid (the
-        federation input: each is the worker's ISOLATED view)."""
-        return {wid: handle.scope.registry.snapshot()
-                for wid, handle in sorted(self.workers.items())
-                if handle.scope is not None}
+        federation input: each is the worker's ISOLATED view — chained
+        scope registry in-process, /metrics.json over the subprocess
+        transport)."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for wid, handle in sorted(self.workers.items()):
+            snap = handle.snapshot()
+            if snap is not None:
+                out[wid] = snap
+        return out
 
     def metrics_text(self, worker: Optional[str] = None) -> Optional[str]:
         """Prometheus exposition: merged fleet view with ``worker=<wid>``
         labeled series, or one worker's isolated view (``worker=``
-        selector).  Returns None for an unknown wid."""
+        selector).  Returns None for an unknown (or unreachable) wid."""
         if worker is not None:
             handle = self.workers.get(worker)
-            if handle is None or handle.scope is None:
+            if handle is None:
                 return None
-            return obs_live.render_prometheus(
-                handle.scope.registry.snapshot())
+            snap = handle.snapshot()
+            if snap is None:
+                return None
+            return obs_live.render_prometheus(snap)
         extra = None
         if self._scope is not None:
             # Fleet-scope families the workers do not chain into
@@ -448,14 +418,18 @@ class Fleet:
         return obs_fleet.render_fleet(self.metrics_snapshots(), extra=extra)
 
     def health(self) -> Dict[str, Any]:
-        """Fleet /healthz view: per-worker liveness + ring membership."""
+        """Fleet /healthz view: per-worker liveness + readiness + ring
+        membership."""
         workers: Dict[str, Any] = {}
         for wid, handle in sorted(self.workers.items()):
             try:
-                h = handle.server.health()
+                h = handle.health()
                 workers[wid] = {
                     "ok": h.get("ok", False),
+                    "ready": bool(h.get("ready", h.get("ok", False))),
+                    "recovering": bool(h.get("recovering", False)),
                     "generation": handle.generation,
+                    "pid": handle.pid,
                     "codec": handle.codec,
                     "queue_depth": h.get("queue_depth", 0),
                     "breakers": h.get("breakers", {}),
@@ -464,14 +438,17 @@ class Fleet:
                     "obs": self._worker_obs(wid, handle),
                 }
             except Exception as exc:  # noqa: BLE001 - report, not raise
-                workers[wid] = {"ok": False, "error": str(exc),
+                workers[wid] = {"ok": False, "ready": False,
+                                "error": str(exc),
                                 "generation": handle.generation,
+                                "pid": handle.pid,
                                 "gate": self._gates.get(wid),
                                 "obs": self._worker_obs(wid, handle)}
         return {
             "ok": all(w.get("ok") for w in workers.values()),
             "size": self.cfg.size,
             "wire": self.cfg.wire,
+            "transport": self.cfg.transport,
             "ring": {"members": self.router.ring.members(),
                      "vnodes": self.cfg.vnodes},
             "pending": self.router.pending_count(),

@@ -67,13 +67,16 @@ def _make_handler(server: Server):
 
 
 def _make_handler_from(health_fn, submit_fn, refresh_fn, metrics_fn=None,
-                       timeline_fn=None):
+                       timeline_fn=None, snapshot_fn=None):
     # metrics_fn(worker: Optional[str]) -> Optional[str]: override for
     # the /metrics exposition (the fleet's federated view, with
     # ?worker=<wid> selecting one worker's isolated registry).  None
     # keeps the default ambient-scope exposition.
     # timeline_fn(window_s: Optional[float]) -> dict: override for the
     # /timeline document; None uses the armed process timeline.
+    # snapshot_fn() -> dict: when set, GET /metrics.json answers the raw
+    # registry snapshot (subprocess workers export it so the fleet can
+    # federate their isolated registries without scope chaining).
     class Handler(BaseHTTPRequestHandler):
         # Silence per-request stderr chatter; obs records cover it.
         def log_message(self, fmt, *args):  # noqa: A003
@@ -104,6 +107,11 @@ def _make_handler_from(health_fn, submit_fn, refresh_fn, metrics_fn=None,
                 self._reply(200, health_fn())
             elif parts.path == "/metrics":
                 self._scrape("metrics", self._get_metrics, parts)
+            elif parts.path == "/metrics.json":
+                if snapshot_fn is None:
+                    self._reply(404, {"error": "not_found"})
+                else:
+                    self._scrape("metrics", self._get_metrics_json, parts)
             elif parts.path == "/timeline":
                 self._scrape("timeline", self._get_timeline, parts)
             else:
@@ -145,6 +153,10 @@ def _make_handler_from(health_fn, submit_fn, refresh_fn, metrics_fn=None,
                 obs_live.render_prometheus(obs_live.snapshot_or_none()),
                 obs_live.CONTENT_TYPE)
 
+        def _get_metrics_json(self, parts) -> None:
+            refresh_fn()
+            self._reply(200, snapshot_fn())
+
         def _get_timeline(self, parts) -> None:
             query = urllib.parse.parse_qs(parts.query)
             window = (query.get("window") or [None])[0]
@@ -168,6 +180,11 @@ def _make_handler_from(health_fn, submit_fn, refresh_fn, metrics_fn=None,
                 return
             ctype = (self.headers.get("Content-Type") or "").split(";")[0]
             binary_in = ctype.strip().lower() == wire.CONTENT_TYPE
+            # A router->worker hop (serve/transport.py SubprocessHandle)
+            # flags itself so the reply carries the full Response —
+            # both planes plus stats/degraded detail — instead of the
+            # client-facing single-plane shape.
+            worker_hop = self.headers.get("X-IA-Worker-Hop") == "1"
             try:
                 length = int(self.headers.get("Content-Length", "0"))
                 body = self.rfile.read(length)
@@ -182,6 +199,9 @@ def _make_handler_from(health_fn, submit_fn, refresh_fn, metrics_fn=None,
                     if deadline_ms is not None:
                         deadline_ms = float(deadline_ms)
                     idem = self.headers.get("X-IA-Idempotency-Key")
+                    params_doc = self.headers.get("X-IA-Params")
+                    params_doc = json.loads(params_doc) \
+                        if params_doc else None
                 else:
                     req = json.loads(body or b"{}")
                     a = np.asarray(req["a"], dtype=np.float32)
@@ -189,7 +209,14 @@ def _make_handler_from(health_fn, submit_fn, refresh_fn, metrics_fn=None,
                     b = np.asarray(req["b"], dtype=np.float32)
                     deadline_ms = req.get("deadline_ms")
                     idem = req.get("idempotency_key")
-            except (KeyError, ValueError, json.JSONDecodeError) as exc:
+                    params_doc = req.get("params")
+                params = None
+                if params_doc is not None:
+                    from image_analogies_tpu.serve import transport \
+                        as serve_transport
+                    params = serve_transport.params_from_json(params_doc)
+            except (KeyError, TypeError, ValueError,
+                    json.JSONDecodeError) as exc:
                 self._reply(400, {"error": "bad_request", "detail": str(exc)})
                 return
             if idem is not None:
@@ -217,7 +244,7 @@ def _make_handler_from(health_fn, submit_fn, refresh_fn, metrics_fn=None,
             try:
                 with obs_trace.request_context(**ctx):
                     resp = submit_fn(
-                        a, ap, b,
+                        a, ap, b, params=params,
                         deadline_s=None if deadline_ms is None
                         else float(deadline_ms) / 1e3,
                         idempotency_key=idem).result()
@@ -239,8 +266,10 @@ def _make_handler_from(health_fn, submit_fn, refresh_fn, metrics_fn=None,
                        "total_ms": round(resp.total_ms, 3)}
             accept = (self.headers.get("Accept") or "")
             if wire.CONTENT_TYPE in accept.lower():
-                frame = wire.encode_planes([np.asarray(resp.bp,
-                                                       np.float32)])
+                out_planes = [np.asarray(resp.bp, np.float32)]
+                if worker_hop:
+                    out_planes.append(np.asarray(resp.bp_y, np.float32))
+                frame = wire.encode_planes(out_planes)
                 self.send_response(200)
                 self.send_header("Content-Type", wire.CONTENT_TYPE)
                 self.send_header("Content-Length", str(len(frame)))
@@ -250,12 +279,18 @@ def _make_handler_from(health_fn, submit_fn, refresh_fn, metrics_fn=None,
                                  "1" if resp.degraded else "0")
                 self.send_header("X-IA-Batch-Size", str(resp.batch_size))
                 self.send_header("X-IA-Timings", json.dumps(timings))
+                if worker_hop:
+                    self.send_header(
+                        "X-IA-Stats", json.dumps(resp.stats, default=str))
+                    self.send_header(
+                        "X-IA-Degraded-Detail",
+                        json.dumps(resp.degraded, default=str))
                 if trace_hdr:
                     self.send_header(obs_trace.TRACE_HEADER, trace_hdr)
                 self.end_headers()
                 self.wfile.write(frame)
                 return
-            self._reply(200, {
+            doc = {
                 "request": resp.request_id,
                 "status": resp.status,
                 "degraded": resp.degraded,
@@ -263,7 +298,15 @@ def _make_handler_from(health_fn, submit_fn, refresh_fn, metrics_fn=None,
                 "timings": timings,
                 "trace": ctx["trace"],
                 "bp": resp.bp.tolist(),
-            }, headers=trace_headers)
+            }
+            if worker_hop:
+                doc["bp_y"] = np.asarray(resp.bp_y,
+                                         np.float32).tolist()
+                doc["stats"] = json.loads(
+                    json.dumps(resp.stats, default=str))
+                doc["degraded"] = json.loads(
+                    json.dumps(resp.degraded, default=str))
+            self._reply(200, doc, headers=trace_headers)
 
     return Handler
 
@@ -285,7 +328,7 @@ def serve_fleet_http(fleet, port: int) -> ThreadingHTTPServer:
     def _refresh():
         for handle in list(fleet.workers.values()):
             try:
-                handle.server.refresh_gauges()
+                handle.refresh_gauges()
             except Exception:  # noqa: BLE001 - a dying worker is fine
                 pass
 

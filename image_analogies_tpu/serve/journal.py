@@ -70,6 +70,19 @@ _OPS = ("admitted", "dispatched", "done", "rejected", "poisoned")
 _IDEM_RE = re.compile(r"[A-Za-z0-9_-]{1,64}\Z")
 
 
+class JournalLocked(RuntimeError):
+    """The journal directory is owned by a LIVE foreign process.  Raised
+    by :meth:`RequestJournal.open` so two live workers can never append
+    to one journal — the single-writer invariant every replay guarantee
+    rests on.  A dead owner's lock is swept, never raises."""
+
+    def __init__(self, path: str, pid: int):
+        super().__init__(
+            f"journal at {path} is owned by live pid {pid}")
+        self.path = path
+        self.pid = pid
+
+
 def valid_idem(idem: str) -> bool:
     """True when *idem* is safe to embed in journal lines and spill
     filenames.  Keys name files under the journal directory, so
@@ -217,6 +230,14 @@ class RequestJournal:
         with self._lock:
             if self._fh is not None:
                 return self
+            # Single-writer gate: a lock held by a LIVE foreign process
+            # refuses this opener (two appenders would tear the replay
+            # history); a dead owner's lock is stale and active_pid()
+            # sweeps it — the real-SIGKILL handoff path, where the
+            # replacement inherits the corpse's directory.
+            owner = self.active_pid()
+            if owner is not None and owner != os.getpid():
+                raise JournalLocked(self.path, owner)
             segs = self._segments()
             last = int(os.path.basename(segs[-1])[8:-6]) if segs else 0
             self._segment = last + 1
@@ -270,8 +291,12 @@ class RequestJournal:
         try:
             os.kill(pid, 0)
         except ProcessLookupError:
+            # Stale: the recorded owner is a corpse.  Sweep the lock
+            # (counted — the subprocess handoff drill reconciles this
+            # against the real SIGKILL it delivered).
             try:
                 os.remove(self._lock_path)
+                obs_metrics.inc("serve.journal.stale_lock_swept")
             except OSError:
                 pass
             return None

@@ -247,6 +247,7 @@ def fleet_selftest(fcfg: "Any", n: int, *, seed: int = 0,
         "n": n,
         "fleet_size": fcfg.size,
         "wire": fcfg.wire,
+        "transport": getattr(fcfg, "transport", "inproc"),
         "shapes": [list(s) for s in shapes],
         "sequential_s": round(seq_s, 3),
         "served_s": round(srv_s, 3),
@@ -276,7 +277,8 @@ def fleet_selftest(fcfg: "Any", n: int, *, seed: int = 0,
 def render_fleet(summary: Dict[str, Any]) -> str:
     lines = [
         f"fleet selftest: {summary['n']} requests over "
-        f"{summary['fleet_size']} workers (wire={summary['wire']})",
+        f"{summary['fleet_size']} workers (wire={summary['wire']}, "
+        f"transport={summary.get('transport', 'inproc')})",
         f"  sequential: {summary['sequential_s']}s "
         f"({summary['sequential_rps']} req/s)",
         f"  routed:     {summary['served_s']}s "

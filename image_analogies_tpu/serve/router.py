@@ -300,6 +300,25 @@ class Router:
                 continue
             self._chain(src, ent)
 
+    def fail_pending(self, wid: str, exc: BaseException) -> int:
+        """Terminal verdict for every request stranded on ``wid`` when
+        NO replacement is coming (crash-loop parked slot): hanging the
+        futures would strand clients forever.  Returns how many were
+        failed."""
+        failed = 0
+        for ent in self.pending_for(wid):
+            try:
+                ent.future.set_exception(exc)
+                failed += 1
+            except InvalidStateError:
+                pass
+            with self._lock:
+                if self._pending.get(ent.idem) is ent:
+                    del self._pending[ent.idem]
+        if failed:
+            obs_metrics.inc("router.failed_pending", failed)
+        return failed
+
     def pending_count(self) -> int:
         with self._lock:
             return len(self._pending)

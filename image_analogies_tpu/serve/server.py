@@ -333,6 +333,32 @@ class Server:
                 fut: "Future[Response]" = Future()
                 fut.set_result(cached)
                 return fut
+            rec = self.recovery.get(idem)
+            if rec is not None and not rec.done():
+                # Join-replay: this key is ALREADY being recomputed by
+                # recover()'s replay — a duplicate submission (e.g. a
+                # router re-forward after a cross-process handoff, where
+                # no in-process future exists to re-chain) joins the
+                # in-flight replayed request instead of re-admitting it,
+                # keeping recovery exactly-once-compute across the
+                # process boundary.
+                obs_metrics.inc("serve.journal.join_replay")
+                obs_trace.emit_record({"event": "serve_join_replay",
+                                       "idem": idem})
+                joined: "Future[Response]" = Future()
+
+                def _chain(f: "Future[Response]",
+                           out: "Future[Response]" = joined) -> None:
+                    if out.done():
+                        return
+                    exc = f.exception()
+                    if exc is not None:
+                        out.set_exception(exc)
+                    else:
+                        out.set_result(f.result())
+
+                rec.add_done_callback(_chain)
+                return joined
         if self._pool.breaker.admission_open():
             # Breaker-aware admission: the dispatch breaker is open, so
             # an accepted request would only sit in the queue to be
@@ -415,9 +441,18 @@ class Server:
         gauges = snap.get("gauges", {})
         breaker = self._pool.breaker
         workers_ok = all(live.values()) if live else True
+        # Liveness vs readiness split: a worker still working through
+        # its journal replay backlog is ALIVE (accepting, threads up)
+        # but not READY — the fleet health daemon gates its death
+        # verdict on liveness only, so a long recovery never triggers a
+        # spurious handoff.
+        recovering = any(not f.done() for f in self.recovery.values())
         return {
             "ok": bool(self._started and self._accepting and workers_ok),
             "accepting": self._accepting,
+            "ready": bool(self._accepting and not recovering),
+            "recovering": recovering,
+            "recovery": self.recovery_stats,
             "uptime_s": (round(time.monotonic() - self._t_start, 3)
                          if self._t_start is not None else 0.0),
             "queue_depth": len(self._queue),

@@ -146,6 +146,25 @@ class FleetConfig:
     size: int = 2                  # number of in-process Server workers
     journal_root: Optional[str] = None
     vnodes: int = 32               # virtual nodes per worker on the ring
+    # Worker transport (serve/transport.py): "inproc" keeps today's
+    # in-process Server workers; "subprocess" spawns each worker as a
+    # `python -m image_analogies_tpu.serve.worker_main` child on its own
+    # loopback HTTP port — same wire frames, same journal handoff, but
+    # kill/replace is a real SIGKILL + re-spawn on the same journal dir.
+    transport: str = "inproc"
+    # Subprocess readiness handshake deadline: the child must report
+    # {pid, port} over its startup pipe within this many seconds
+    # (jax import + warmup + journal replay all happen before ready).
+    spawn_timeout_s: float = 120.0
+    # Crash-loop supervisor (transport.CrashLoopSupervisor): a worker
+    # death within ``crash_loop_window_s`` of its own spawn counts as
+    # RAPID; respawns after rapid deaths back off (capped jittered,
+    # utils.failure.backoff_delay over backoff_s/backoff_cap_s below),
+    # and ``crash_loop_threshold`` consecutive rapid deaths gate the
+    # worker ("crash_loop") instead of respawning forever.  0 disables
+    # the gate (respawn always).
+    crash_loop_window_s: float = 1.0
+    crash_loop_threshold: int = 3
     # Router<->worker hop encoding: "auto"/"binary" negotiate the IAF2
     # frame (serve/wire.py) when the worker advertises it, "json" forces
     # the list transport (the fallback both sides always speak).
@@ -167,6 +186,13 @@ class FleetConfig:
             raise ValueError("vnodes must be >= 1")
         if self.wire not in ("auto", "binary", "json"):
             raise ValueError("wire must be auto|binary|json")
+        if self.transport not in ("inproc", "subprocess"):
+            raise ValueError("transport must be inproc|subprocess")
+        if self.spawn_timeout_s <= 0:
+            raise ValueError("spawn_timeout_s must be > 0")
+        if self.crash_loop_window_s < 0 or self.crash_loop_threshold < 0:
+            raise ValueError(
+                "crash_loop_window_s/crash_loop_threshold must be >= 0")
         if self.health_interval_s <= 0:
             raise ValueError("health_interval_s must be > 0")
         if self.death_checks < 1:

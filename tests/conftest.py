@@ -43,6 +43,23 @@ def _disarm_fault_planes():
     chaos.disarm()
 
 
+@pytest.fixture(autouse=True)
+def _reap_worker_children():
+    """SIGKILL any subprocess fleet worker a test left behind.
+
+    The subprocess transport keeps a live-children registry
+    (serve.transport._LIVE); a test that fails mid-fleet would otherwise
+    orphan real OS processes that outlive the whole pytest run.  Checked
+    via sys.modules so tests that never import the transport pay
+    nothing."""
+    import sys as _sys
+
+    yield
+    mod = _sys.modules.get("image_analogies_tpu.serve.transport")
+    if mod is not None:
+        mod.reap_orphans()
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(1234)

@@ -109,6 +109,37 @@ def test_fleet_config_validation():
         FleetConfig(serve=cfg, spill_queue_frac=0.0)
     with pytest.raises(ValueError):
         FleetConfig(serve=cfg, backoff_s=0.5, backoff_cap_s=0.1)
+    with pytest.raises(ValueError):
+        FleetConfig(serve=cfg, transport="carrier_pigeon")
+
+
+def test_judge_liveness_vs_readiness():
+    """The death verdict is gated on LIVENESS only: a worker mid-replay
+    reports ready=False / recovering=True and must be neither declared
+    dead nor advisorily gated — spilling keys whose replay is about to
+    answer them would double-compute work the journal already holds."""
+
+    class H:
+        def __init__(self, doc):
+            self._doc = doc
+
+        def health(self):
+            if isinstance(self._doc, Exception):
+                raise self._doc
+            return self._doc
+
+    fl = Fleet.__new__(Fleet)  # _judge only touches self.cfg
+    fl.cfg = _fleet_cfg()
+    alive = {"ok": True, "accepting": True, "ready": True,
+             "recovering": False, "workers": {"alive": 1},
+             "breakers": {}, "queue_depth": 0}
+    assert fl._judge(H(alive)) is None
+    recovering = dict(alive, ok=False, ready=False, recovering=True)
+    assert fl._judge(H(recovering)) is None
+    assert fl._judge(H(dict(alive, accepting=False))) == "dead"
+    assert fl._judge(H(RuntimeError("unreachable"))) == "dead"
+    tripped = dict(alive, breakers={"cpu": "open"})
+    assert fl._judge(H(tripped)) == "breaker_open"
 
 
 # ------------------------------------------------------ routed serving
@@ -251,11 +282,17 @@ def test_fleet_healthz_obs_identity_and_federated_metrics(tmp_path):
         time.sleep(4 * fcfg.health_interval_s)  # let the scrape loop run
 
         health = fl.health()
+        assert health["transport"] == "inproc"
         for wid, wh in health["workers"].items():
             obs = wh["obs"]
             assert obs["scope"] == f"{wid}.g0"
             assert obs["last_scrape_age_s"] >= 0.0
             assert "stale_scope" not in obs
+            # liveness/readiness split: every worker entry carries the
+            # schema the health daemon and operators key on (an
+            # in-process worker shares the router's pid)
+            assert wh["ready"] is True and wh["recovering"] is False
+            assert wh["pid"] == os.getpid()
 
         merged = fl.metrics_text()
         solo = fl.metrics_text("w0")

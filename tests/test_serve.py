@@ -598,5 +598,9 @@ def test_serve_never_calls_jit_directly():
         assert not toplevel_jax.findall(src), (
             f"serve/{name} imports jax at module scope")
     # the fleet plane must stay under this lock — a rename that moves
-    # router/fleet out of serve/ must move the jax-free guarantee with it
-    assert {"router.py", "fleet.py"} <= scanned
+    # router/fleet out of serve/ must move the jax-free guarantee with
+    # it; transport/worker_main are the subprocess spawn path, where a
+    # module-scope jax import would bill every child ~seconds before
+    # the readiness handshake even starts
+    assert {"router.py", "fleet.py",
+            "transport.py", "worker_main.py"} <= scanned

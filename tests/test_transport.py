@@ -1,0 +1,214 @@
+"""Subprocess worker transport (serve/transport.py, serve/worker_main.py).
+
+Locked here:
+
+- crash-loop supervisor semantics: rapid deaths (uptime under the
+  window) escalate a capped jittered respawn backoff and gate the slot
+  at the threshold; a slow death resets the streak; threshold 0
+  disables the gate;
+- ServeConfig/AnalogyParams JSON codec roundtrip (the spawn handshake's
+  stdin document survives a real json encode/decode);
+- `ia fleet --transport` flag parses and rejects unknown transports;
+- REAL advisory-lock semantics against foreign pids: a live child's
+  journal lock refuses a second opener (JournalLocked), a SIGKILLed
+  child's lock is swept by the next opener — the exact handoff path the
+  fleet drill rides;
+- `ia fleet --selftest` methodology over the subprocess transport:
+  routed children answer bit-identical to the sequential baseline
+  through the IAF2 HTTP hop.
+
+Every test runs under a hard SIGALRM budget and the conftest
+_reap_worker_children fixture SIGKILLs anything left behind — a wedged
+child must fail ONE test loudly, never hang the suite.
+
+The chaos-armed SIGKILL handoff drill itself (exactly-once, lock sweep,
+segment advance, spill) rides the per-kind tier-1 parametrization in
+test_chaos.py (kind="fleet_death_subprocess").
+"""
+
+import json
+import os
+import signal
+
+import pytest
+
+from image_analogies_tpu.chaos import drills
+from image_analogies_tpu.serve import journal as serve_journal
+from image_analogies_tpu.serve import transport as serve_transport
+from image_analogies_tpu.serve.types import FleetConfig
+
+
+@pytest.fixture(autouse=True)
+def _hard_timeout():
+    """Per-test wall-clock ceiling for everything in this module: a lost
+    readiness handshake or a wedged child raises here (and the orphan
+    reaper cleans up) instead of eating the tier-1 budget."""
+
+    def _boom(signum, frame):  # noqa: ARG001 - signal API
+        serve_transport.reap_orphans()
+        raise TimeoutError("transport test exceeded its 180 s budget")
+
+    old = signal.signal(signal.SIGALRM, _boom)
+    signal.alarm(180)
+    yield
+    signal.alarm(0)
+    signal.signal(signal.SIGALRM, old)
+
+
+def test_crash_loop_supervisor_semantics():
+    sup = serve_transport.CrashLoopSupervisor(
+        window_s=1.0, threshold=3, backoff_s=0.05, backoff_cap_s=0.4)
+    # rapid death: backoff armed, no gate yet
+    v = sup.on_death("w0", uptime_s=0.1)
+    assert v["rapid"] == 1 and not v["gate"]
+    assert 0.0 < v["delay_s"] <= 0.4
+    v = sup.on_death("w0", uptime_s=0.2)
+    assert v["rapid"] == 2 and not v["gate"]
+    # third rapid death in a row: gate, and no pointless delay
+    v = sup.on_death("w0", uptime_s=0.0)
+    assert v["rapid"] == 3 and v["gate"] and v["delay_s"] == 0.0
+    # a slow death (lived past the window) resets the streak
+    sup.reset("w0")
+    v = sup.on_death("w0", uptime_s=5.0)
+    assert v == {"rapid": 0, "delay_s": 0.0, "gate": False}
+    # per-wid isolation, and the same wid always jitters the same
+    d1 = sup.on_death("w1", uptime_s=0.0)["delay_s"]
+    sup.reset("w1")
+    assert sup.on_death("w1", uptime_s=0.0)["delay_s"] == d1
+    # threshold 0 disables the gate entirely (respawn forever)
+    sup0 = serve_transport.CrashLoopSupervisor(
+        window_s=1.0, threshold=0, backoff_s=0.05, backoff_cap_s=0.4)
+    for _ in range(5):
+        assert not sup0.on_death("w0", uptime_s=0.0)["gate"]
+
+
+def test_config_json_roundtrip():
+    """The spawn handshake ships ServeConfig as JSON on the child's
+    stdin: a real encode/decode roundtrip must reproduce the dataclass
+    exactly (tuples re-tupled, params rebuilt)."""
+    import dataclasses
+
+    cfg = drills.serve_config(workers=2, max_batch=3,
+                              journal_dir="/tmp/jdir")
+    cfg = dataclasses.replace(cfg, warmup_sizes=((8, 8), (16, 16)))
+    doc = json.loads(json.dumps(serve_transport.config_to_json(cfg)))
+    assert serve_transport.config_from_json(doc) == cfg
+    p = cfg.params
+    pdoc = json.loads(json.dumps(serve_transport.params_to_json(p)))
+    assert serve_transport.params_from_json(pdoc) == p
+
+
+def test_cli_fleet_transport_flag():
+    from image_analogies_tpu import cli
+
+    args = cli.build_parser().parse_args(
+        ["fleet", "--selftest", "2", "--transport", "subprocess"])
+    assert args.transport == "subprocess"
+    assert cli.build_parser().parse_args(["fleet"]).transport == "inproc"
+    with pytest.raises(SystemExit):
+        cli.build_parser().parse_args(["fleet", "--transport", "smoke"])
+    with pytest.raises(ValueError):
+        serve_transport.make_transport("smoke")
+
+
+def test_live_lock_refuses_and_dead_lock_sweeps(tmp_path):
+    """Advisory-lock truth against REAL foreign pids: while the child
+    lives, its journal lock refuses this process (JournalLocked, the
+    single-writer invariant); after SIGKILL the same lock is stale and
+    the next open() sweeps it — the handoff path's first step."""
+    jdir = str(tmp_path / "w0")
+    cfg = drills.serve_config(workers=1, max_batch=2,
+                              batch_window_ms=5.0, journal_dir=jdir)
+    handle = serve_transport.SubprocessTransport().spawn(
+        "w0", 0, cfg, "iaf2", spawn_timeout_s=120.0)
+    try:
+        assert handle.pid != os.getpid()
+        h = handle.health()
+        # the lock holds the CHILD's pid — a real foreign owner, visible
+        # through the worker's own /healthz
+        assert h["ok"] and h["journal"]["lock_pid"] == handle.pid
+        with pytest.raises(serve_journal.JournalLocked) as exc:
+            serve_journal.RequestJournal(jdir).open()
+        assert exc.value.pid == handle.pid
+    finally:
+        handle.kill()
+    # owner is a corpse now: open() sweeps the stale lock and takes over
+    j = serve_journal.RequestJournal(jdir).open()
+    try:
+        assert j.info()["lock_pid"] == os.getpid()
+    finally:
+        j.close()
+
+
+def test_bench_handoff_recovery_toy_scale():
+    """`ia bench`'s ``handoff_recovery_ms`` methodology at toy scale:
+    SIGKILL the home subprocess worker mid-request, and the headline
+    times kill -> the replacement (same journal dir, foreign lock
+    swept) resolving the stranded future bit-identically."""
+    import bench
+
+    out = bench.measure_handoff_recovery(size=16, levels=1)
+    assert out["bit_identical"]
+    assert out["handoff_recovery_ms"] > 0
+    assert out["replacement_pid"] not in (out["victim_pid"], os.getpid())
+    assert out["replacement_generation"] == 1
+    assert out["stale_lock_swept"] >= 1
+
+
+def test_bench_check_gates_handoff_with_no_floor_path():
+    """handoff_recovery_ms rides `ia bench --check`: a floored archive
+    gates regressions; legacy archives (pre-subprocess-transport
+    rounds) record the number without gating."""
+    import bench
+
+    floored = {"points": [
+        {"value": 6.0, "metric_key": "1024x1024",
+         "handoff_recovery_ms": 4000.0,
+         "round": 1, "file": "BENCH_r01.json", "source": "parsed"}]}
+    ok = bench.check_regression(floored, fresh_value=6.0,
+                                fresh_key="1024x1024",
+                                fresh_handoff=4100.0)
+    assert ok["ok"] and ok["handoff_recovery_floor"] == 4000.0
+    bad = bench.check_regression(floored, fresh_value=6.0,
+                                 fresh_key="1024x1024",
+                                 fresh_handoff=9000.0)
+    assert not bad["ok"]
+    assert any("handoff_recovery_ms" in s for s in bad["problems"])
+
+    legacy = {"points": [
+        {"value": 6.0, "metric_key": "1024x1024",
+         "round": 1, "file": "BENCH_r01.json", "source": "parsed"}]}
+    rec = bench.check_regression(legacy, fresh_value=6.0,
+                                 fresh_key="1024x1024",
+                                 fresh_handoff=9000.0)
+    assert rec["ok"]
+    assert rec["handoff_recovery_ms"] == 9000.0
+    assert rec["handoff_recovery_floor"] is None
+
+    # the headline extractor carries the rider out of an archive doc
+    head = bench.extract_headline(
+        {"parsed": {"value": 6.0, "metric": "1024x1024 wall",
+                    "handoff_recovery_ms": 1234.0}})
+    assert head["handoff_recovery_ms"] == 1234.0
+
+
+def test_subprocess_fleet_selftest_bit_identity(tmp_path):
+    """`ia fleet --selftest` methodology over --transport subprocess:
+    requests routed to real child processes over the IAF2 HTTP hop come
+    back bit-identical to the sequential in-process baseline."""
+    from image_analogies_tpu.obs import trace as obs_trace
+    from image_analogies_tpu.serve import loadgen
+
+    fcfg = FleetConfig(
+        serve=drills.serve_config(workers=1, max_batch=4,
+                                  batch_window_ms=20.0),
+        size=2, vnodes=16, transport="subprocess",
+        journal_root=str(tmp_path / "journals"),
+        health_interval_s=0.1, death_checks=2,
+        backoff_s=0.01, backoff_cap_s=0.05)
+    with obs_trace.run_scope(fcfg.serve.params):
+        summary = loadgen.fleet_selftest(fcfg, 3, seed=3)
+    assert summary["transport"] == "subprocess"
+    assert summary["errors"] == 0 and summary["rejected"] == 0
+    assert summary["bit_identical"] is True
+    assert summary["codecs"].get("iaf2", 0) >= 3
