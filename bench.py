@@ -131,6 +131,13 @@ def extract_headline(doc: dict):
         if obj.get("handoff_recovery_ms") is not None:
             out["handoff_recovery_ms"] = float(
                 obj["handoff_recovery_ms"])
+        # ledger trajectory (PR 16): armed tenant metering plane (cost
+        # vectors + space-saving heavy hitters) vs disarmed at 256^2 —
+        # per-request attribution only stays always-on if this stays
+        # small
+        if obj.get("ledger_overhead_pct") is not None:
+            out["ledger_overhead_pct"] = float(
+                obj["ledger_overhead_pct"])
         return out
 
     parsed = doc.get("parsed")
@@ -187,7 +194,7 @@ def check_regression(trajectory: dict, fresh_value=None,
                      fresh_gap=None, fresh_key=None,
                      fresh_obs=None, fresh_cold=None,
                      fresh_scale=None, fresh_timeline=None,
-                     fresh_handoff=None) -> dict:
+                     fresh_handoff=None, fresh_ledger=None) -> dict:
     """Gate a wall-clock number against the trajectory floor.
 
     With ``fresh_value`` (a just-measured number), it is compared against
@@ -249,6 +256,13 @@ def check_regression(trajectory: dict, fresh_value=None,
     relatively like ``cold_start_ms``.  Archives from rounds before the
     subprocess transport existed carry no floor, so the first measured
     point records without gating.
+
+    ``ledger_overhead_pct`` (armed tenant metering plane — cost vectors
+    + space-saving heavy hitters — vs disarmed at 256^2, PR 16) rides
+    via ``fresh_ledger`` with the same ABSOLUTE percentage-points gate
+    as ``timeline_overhead_pct``; archives from rounds before the
+    ledger existed carry no floor, so the first point records without
+    gating.
     """
     points = trajectory.get("points") or []
     problems = list(trajectory.get("problems", []))
@@ -276,6 +290,7 @@ def check_regression(trajectory: dict, fresh_value=None,
         cand_scale = fresh_scale
         cand_timeline = fresh_timeline
         cand_handoff = fresh_handoff
+        cand_ledger = fresh_ledger
         prior = same
         floor = min(p["value"] for p in same)
     else:
@@ -289,6 +304,7 @@ def check_regression(trajectory: dict, fresh_value=None,
         cand_scale = latest.get("exemplar_scale_ratio")
         cand_timeline = latest.get("timeline_overhead_pct")
         cand_handoff = latest.get("handoff_recovery_ms")
+        cand_ledger = latest.get("ledger_overhead_pct")
         prior = same[:-1]
         if not prior:
             return {"ok": True, "reason": "single_point",
@@ -425,6 +441,26 @@ def check_regression(trajectory: dict, fresh_value=None,
         # cold_start_ms
         out["handoff_recovery_ms"] = float(cand_handoff)
         out["handoff_recovery_floor"] = None
+    prior_ledgers = [p["ledger_overhead_pct"] for p in prior
+                     if p.get("ledger_overhead_pct") is not None]
+    if cand_ledger is not None and prior_ledgers:
+        lg_floor = min(prior_ledgers)
+        # already a percentage — absolute points, like the timeline gate
+        lg_delta = float(cand_ledger) - lg_floor
+        out["ledger_overhead_pct"] = float(cand_ledger)
+        out["ledger_overhead_floor"] = lg_floor
+        out["ledger_overhead_delta_pts"] = round(lg_delta, 2)
+        if lg_delta > threshold_pct:
+            out["ok"] = False
+            problems.append(
+                f"ledger_overhead_pct grew {lg_delta:.1f} points past "
+                f"the {lg_floor:.1f}% floor "
+                f"(candidate {cand_ledger:.1f}%)")
+    elif cand_ledger is not None:
+        # legacy archives (pre-ledger rounds) carry no floor: record
+        # the point without gating, same posture as timeline_overhead
+        out["ledger_overhead_pct"] = float(cand_ledger)
+        out["ledger_overhead_floor"] = None
     return out
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -601,6 +637,39 @@ def _measure_timeline_overhead(a, ap, b, p, reps=3):
             (armed - disarmed) / disarmed * 100.0, 2),
         "armed_s": round(armed, 3),
         "disarmed_s": round(disarmed, 3),
+        "reps": reps,
+    }
+
+
+def _measure_ledger_overhead(a, ap, b, p, reps=3):
+    """Wall-clock cost of the ARMED tenant metering plane at one 256^2
+    served request.  The ledger lives on the serve dispatch path (cost
+    vectors + space-saving tenant tracking per completion), so both
+    arms go through a real :class:`Server` — ``cfg.ledger`` is the only
+    difference.  Headline ``ledger_overhead_pct`` rides the archive and
+    ``ia bench --check`` gates it in percentage points (legacy archives
+    carry no floor, so the first point records only)."""
+    from image_analogies_tpu.serve.server import Server
+    from image_analogies_tpu.serve.types import ServeConfig
+
+    p_srv = p.replace(metrics=False, log_path=None)
+    best = {}
+    for armed in (False, True):
+        cfg = ServeConfig(params=p_srv, workers=1, ledger=armed,
+                          cost_persist=False)
+        t_best = float("inf")
+        with Server(cfg) as srv:
+            srv.submit(a, ap, b).result(timeout=600)  # compile warm-up
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                srv.submit(a, ap, b).result(timeout=600)
+                t_best = min(t_best, time.perf_counter() - t0)
+        best[armed] = t_best
+    return {
+        "ledger_overhead_pct": round(
+            (best[True] - best[False]) / best[False] * 100.0, 2),
+        "armed_s": round(best[True], 3),
+        "disarmed_s": round(best[False], 3),
         "reps": reps,
     }
 
@@ -989,6 +1058,12 @@ def main() -> int:
     timeline_overhead = _measure_timeline_overhead(a, ap, b, p)
     configs["timeline_overhead_256"] = timeline_overhead
 
+    # ---- ledger overhead (PR 16): armed tenant metering plane (cost
+    # vectors + heavy-hitter tracking) vs disarmed through a real
+    # Server — what per-request attribution costs at 256^2
+    ledger_overhead = _measure_ledger_overhead(a, ap, b, p)
+    configs["ledger_overhead_256"] = ledger_overhead
+
     # ---- catalog cold start (PR 12): first-request wall at 256^2 with
     # a warm exemplar catalog vs an empty one, on the CPU path the
     # catalog serves; bit-identity between the two runs gates the number
@@ -1240,6 +1315,7 @@ def main() -> int:
         "timeline_overhead_pct":
             timeline_overhead["timeline_overhead_pct"],
         "handoff_recovery_ms": handoff["handoff_recovery_ms"],
+        "ledger_overhead_pct": ledger_overhead["ledger_overhead_pct"],
         "vs_baseline": round(oracle_s / ns_s, 1),
         "ssim_vs_oracle": round(ns_ssim, 4),
         "value_match": round(ns_match, 4),

@@ -415,6 +415,7 @@ def cmd_serve(args) -> int:
         slo_slow_window_s=args.slo_slow_window_s,
         journal_dir=args.journal,
         batch_engine=not args.no_batch_engine,
+        ledger=not args.no_ledger,
     )
 
     if args.selftest is not None:
@@ -422,7 +423,8 @@ def cmd_serve(args) -> int:
 
         with _maybe_metrics_server(args):
             summary = loadgen.selftest(cfg, args.selftest, seed=args.seed,
-                                       deadline_ms=deadline_ms)
+                                       deadline_ms=deadline_ms,
+                                       zipf=args.zipf, styles=args.styles)
         print(loadgen.render(summary))
         print(json.dumps(summary, sort_keys=True), file=sys.stderr)
         return 0 if (summary["errors"] == 0
@@ -444,7 +446,7 @@ def cmd_serve(args) -> int:
         httpd = serve_http(srv, args.http)
         print(f"serving on http://127.0.0.1:{args.http} "
               f"(POST /v1/analogy, GET /healthz, GET /metrics, "
-              f"GET /timeline); Ctrl-C to drain+exit")
+              f"GET /timeline, GET /tenants); Ctrl-C to drain+exit")
         try:
             httpd.serve_forever()
         except KeyboardInterrupt:
@@ -486,7 +488,9 @@ def cmd_fleet(args) -> int:
         from image_analogies_tpu.serve import loadgen
 
         summary = loadgen.fleet_selftest(fcfg, args.selftest,
-                                         seed=args.seed)
+                                         seed=args.seed,
+                                         zipf=args.zipf,
+                                         styles=args.styles)
         print(loadgen.render_fleet(summary))
         print(json.dumps(summary, sort_keys=True), file=sys.stderr)
         return 0 if (summary["errors"] == 0
@@ -591,6 +595,27 @@ def cmd_journal(args) -> int:
         return 0
     print(f"journal: unknown action {args.action}", file=sys.stderr)
     return 2
+
+
+def cmd_why(args) -> int:
+    """Request forensics (``ia why <idem-key>``): merge the write-ahead
+    journal(s) under --root — a single ``ia serve --journal`` dir or an
+    ``ia fleet --journal`` root with per-worker subdirs — with the
+    sealed decision log into one ordered causal chain for a single
+    request: which worker admitted it, every control-plane verdict
+    (degrade, shed, spill, requeue, poison, handoff re-chain) with its
+    cause, the cost vector, and the terminal state."""
+    from image_analogies_tpu.serve import journal as serve_journal
+
+    if not os.path.isdir(args.root):
+        print(f"why: no such directory {args.root}", file=sys.stderr)
+        return 2
+    doc = serve_journal.reconstruct(args.idem, args.root)
+    if args.json:
+        print(json.dumps(doc, indent=2, sort_keys=True, default=str))
+    else:
+        sys.stdout.write(serve_journal.render_why(doc))
+    return 0 if doc.get("found") else 2
 
 
 def cmd_blackbox(args) -> int:
@@ -792,6 +817,7 @@ def cmd_bench(args) -> int:
     fresh_scale = None
     fresh_timeline = None
     fresh_handoff = None
+    fresh_ledger = None
     fresh_key = args.metric_key
     if args.value is not None:
         fresh = args.value
@@ -817,6 +843,8 @@ def cmd_bench(args) -> int:
                 fresh_timeline = float(doc["timeline_overhead_pct"])
             if doc.get("handoff_recovery_ms") is not None:
                 fresh_handoff = float(doc["handoff_recovery_ms"])
+            if doc.get("ledger_overhead_pct") is not None:
+                fresh_ledger = float(doc["ledger_overhead_pct"])
         else:
             head = bench.extract_headline(doc if isinstance(doc, dict)
                                           else {})
@@ -831,6 +859,7 @@ def cmd_bench(args) -> int:
             fresh_scale = head.get("exemplar_scale_ratio")
             fresh_timeline = head.get("timeline_overhead_pct")
             fresh_handoff = head.get("handoff_recovery_ms")
+            fresh_ledger = head.get("ledger_overhead_pct")
             if fresh_key is None:
                 fresh_key = head.get("metric_key")
     verdict = bench.check_regression(trajectory, fresh_value=fresh,
@@ -841,7 +870,8 @@ def cmd_bench(args) -> int:
                                      fresh_cold=fresh_cold,
                                      fresh_scale=fresh_scale,
                                      fresh_timeline=fresh_timeline,
-                                     fresh_handoff=fresh_handoff)
+                                     fresh_handoff=fresh_handoff,
+                                     fresh_ledger=fresh_ledger)
     print(json.dumps(verdict, sort_keys=True))
     for problem in verdict.get("problems", []):
         print(f"bench: warning: {problem}", file=sys.stderr)
@@ -854,12 +884,45 @@ def cmd_top(args) -> int:
     peak, and anomaly flags per worker (obs/timeline.py renders; this
     command only fetches and redraws).  ``--once`` prints a single
     frame and exits — the CI-friendly mode tier-1 drives against a
-    live selftest server."""
+    live selftest server.  ``--tenants`` switches to the per-style
+    view over ``/tenants``: top-K tenants by request count with QPS,
+    p95, cost share, and degrade/retry burden (obs/ledger.py)."""
     import time as _time
     import urllib.error
     import urllib.request
 
     from image_analogies_tpu.obs import timeline as obs_timeline
+
+    if args.tenants:
+        from image_analogies_tpu.obs import ledger as obs_ledger
+
+        t_url = args.url.rstrip("/") + "/tenants"
+
+        def fetch_tenants():
+            with urllib.request.urlopen(t_url, timeout=5) as resp:
+                return json.loads(resp.read().decode())
+
+        if args.once:
+            try:
+                doc = fetch_tenants()
+            except (OSError, ValueError, urllib.error.URLError) as exc:
+                print(f"top: cannot fetch {t_url}: {exc}",
+                      file=sys.stderr)
+                return 2
+            sys.stdout.write(obs_ledger.render_tenants(doc))
+            return 0
+        try:
+            while True:
+                try:
+                    frame = obs_ledger.render_tenants(fetch_tenants())
+                except (OSError, ValueError,
+                        urllib.error.URLError) as exc:
+                    frame = f"top: cannot fetch {t_url}: {exc}\n"
+                sys.stdout.write("\x1b[2J\x1b[H" + frame)
+                sys.stdout.flush()
+                _time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
 
     url = args.url.rstrip("/") + "/timeline"
     if args.window is not None:
@@ -994,6 +1057,11 @@ def build_parser() -> argparse.ArgumentParser:
                          "default: the finest)")
     tp.add_argument("--once", action="store_true",
                     help="print one frame and exit (CI mode)")
+    tp.add_argument("--tenants", action="store_true",
+                    help="per-style view over /tenants instead of the "
+                         "worker cockpit: top-K tenants by request "
+                         "count with QPS, p95, cost share, and degrade/"
+                         "retry burden (space-saving heavy hitters)")
     tp.set_defaults(fn=cmd_top)
 
     mx = sub.add_parser("metrics",
@@ -1158,6 +1226,17 @@ def build_parser() -> argparse.ArgumentParser:
                          "batches into one batched B-axis launch "
                          "(batch/engine.py); outputs are bit-identical "
                          "either way")
+    sv.add_argument("--no-ledger", action="store_true",
+                    help="disarm the tenant metering plane (per-request "
+                         "cost vectors, /tenants heavy hitters); the "
+                         "disarmed path costs one bool check per request")
+    sv.add_argument("--zipf", type=float, default=None, metavar="S",
+                    help="selftest load: draw requests over --styles "
+                         "synthetic styles with Zipf(S)-skewed frequency "
+                         "(rank r picked with p ~ r**-S; S~1 = one viral "
+                         "style dominating) instead of cycling shapes")
+    sv.add_argument("--styles", type=int, default=0,
+                    help="style count for --zipf (default 8)")
     sv.add_argument("--seed", type=int, default=0)
     _add_engine_flags(sv)
     sv.set_defaults(fn=cmd_serve)
@@ -1201,6 +1280,12 @@ def build_parser() -> argparse.ArgumentParser:
     fp.add_argument("--workers", type=int, default=1,
                     help="worker THREADS per server (the fleet dimension "
                          "is --size)")
+    fp.add_argument("--zipf", type=float, default=None, metavar="S",
+                    help="selftest load: Zipf(S)-skewed per-style "
+                         "frequency over --styles synthetic styles "
+                         "(see ia serve --zipf)")
+    fp.add_argument("--styles", type=int, default=0,
+                    help="style count for --zipf (default 8)")
     fp.add_argument("--seed", type=int, default=0)
     _add_engine_flags(fp)
     fp.set_defaults(fn=cmd_fleet)
@@ -1301,6 +1386,25 @@ def build_parser() -> argparse.ArgumentParser:
     jr.add_argument("--json", action="store_true",
                     help="machine-readable output")
     jr.set_defaults(fn=cmd_journal)
+
+    wy = sub.add_parser("why",
+                        help="request forensics: replay the journal(s) + "
+                             "decision log into one ordered causal chain "
+                             "for a single idempotency key (admit -> "
+                             "verdicts with causes -> cost vector -> "
+                             "terminal state)")
+    wy.add_argument("idem", help="idempotency key (the journal key; "
+                                 "derived content keys appear in "
+                                 "`ia journal inspect`)")
+    wy.add_argument("--root", required=True, metavar="DIR",
+                    help="journal directory (ia serve --journal) or "
+                         "fleet journal ROOT (ia fleet --journal) — "
+                         "worker subdirs and decisions.jsonl are "
+                         "discovered automatically")
+    wy.add_argument("--json", action="store_true",
+                    help="machine-readable reconstruction (events with "
+                         "ts/worker/op, decisions, cost vectors, chain)")
+    wy.set_defaults(fn=cmd_why)
 
     bb = sub.add_parser("blackbox",
                         help="render sealed flight-recorder dumps from a "

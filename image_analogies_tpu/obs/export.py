@@ -100,6 +100,21 @@ def _classify(rec: Dict[str, Any]) -> Tuple[str, int, str, Optional[float]]:
             name = (f"recovery replayed={rec.get('replayed', 0)} "
                     f"done={rec.get('done', 0)}")
         return "i", SERVE_TID, name, None
+    if ev == "serve_decision":
+        # decision-attribution instants on the serve track: every
+        # control-plane verdict (degrade, shed, spill, poison, dedupe,
+        # re-chain) that shaped a request's fate, with site + cause in
+        # args.  Trace-stamped ones re-home to their per-trace track, so
+        # a request's verdicts line up under its own request chain.
+        name = (f"{rec.get('site', '?')} {rec.get('verdict', '?')}"
+                + (f" ({rec['cause']})" if rec.get("cause") else ""))
+        return "i", SERVE_TID, name, None
+    if ev == "serve_cost":
+        # cost-vector instants close each request's chain on the serve
+        # track: tenant + queue/dispatch split + lanes in args
+        return ("i", SERVE_TID,
+                f"cost {str(rec.get('tenant', '?'))[:8]} "
+                f"{rec.get('dispatch_ms', 0)}ms", None)
     if ev in ("router_route", "router_spill", "router_rechain",
               "router_resubmit"):
         # routing-plane instants share the serve track: a request's hop

@@ -99,6 +99,20 @@ def merge_snapshots(by_worker: Dict[str, Dict[str, dict]]
     }
 
 
+# --- tenant federation -------------------------------------------------------
+
+def merge_tenant_docs(docs: List[Dict[str, Any]],
+                      k: Optional[int] = None) -> Dict[str, Any]:
+    """Federate per-worker ``/tenants`` documents into one fleet-level
+    top-K.  Thin re-export of :func:`obs.tenants.merge_docs` so the
+    fleet-federation surface lives beside the metrics merge; the sketch
+    math (mergeable space-saving, honest error intervals) is documented
+    on the tenants module."""
+    from image_analogies_tpu.obs import tenants as _tenants
+
+    return _tenants.merge_docs(docs, k=k)
+
+
 # --- labeled exposition -----------------------------------------------------
 
 def render_fleet(by_worker: Dict[str, Dict[str, dict]],

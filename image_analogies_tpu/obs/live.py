@@ -254,6 +254,22 @@ def start_http_server(port: int,
                 finally:
                     _metrics.observe("obs.scrape.timeline.duration_ms",
                                      (time.perf_counter() - t0) * 1e3)
+            elif parts.path == "/tenants":
+                from image_analogies_tpu.obs import ledger as _ledger
+
+                t0 = time.perf_counter()
+                _metrics.inc("obs.scrape.tenants.total")
+                try:
+                    self._reply(200,
+                                json.dumps(_ledger.tenants_doc()).encode(),
+                                "application/json")
+                except Exception:  # noqa: BLE001 - counted, then raised
+                    _metrics.inc("obs.scrape.errors")
+                    _metrics.inc("obs.scrape.tenants.errors")
+                    raise
+                finally:
+                    _metrics.observe("obs.scrape.tenants.duration_ms",
+                                     (time.perf_counter() - t0) * 1e3)
             elif parts.path == "/healthz":
                 self._reply(200, json.dumps(hz_fn()).encode(),
                             "application/json")

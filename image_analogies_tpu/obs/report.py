@@ -221,6 +221,54 @@ def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                                 for k, v in sorted(batch_hist.items())},
         }
 
+    # --- tenant metering section (serve_cost records) ---------------------
+    # One row per tenant (style == batcher exemplar sha1): request count,
+    # dispatch-cost share, degrade/retry burden.  Built from the streamed
+    # cost vectors so it works post-hoc on any journal-less run log.
+    cost_recs = [r for r in records if r.get("event") == "serve_cost"]
+    tenants_info: Optional[Dict[str, Any]] = None
+    if cost_recs:
+        by_tenant: Dict[str, Dict[str, Any]] = {}
+        for cr in cost_recs:
+            t = str(cr.get("tenant") or "?")
+            row_t = by_tenant.setdefault(t, {
+                "tenant": t, "requests": 0, "dispatch_ms": 0.0,
+                "queue_ms": 0.0, "degraded": 0, "retries": 0,
+                "wire_bytes": 0})
+            row_t["requests"] += 1
+            row_t["dispatch_ms"] += float(cr.get("dispatch_ms") or 0.0)
+            row_t["queue_ms"] += float(cr.get("queue_ms") or 0.0)
+            row_t["degraded"] += 1 if cr.get("degrade_levels") else 0
+            row_t["retries"] += int(cr.get("retries") or 0)
+            row_t["wire_bytes"] += int(cr.get("wire_bytes") or 0)
+        total_cost_ms = sum(r["dispatch_ms"]
+                            for r in by_tenant.values()) or 0.0
+        rows_t = sorted(by_tenant.values(),
+                        key=lambda r: (-r["dispatch_ms"], r["tenant"]))
+        for r in rows_t:
+            r["cost_share"] = (r["dispatch_ms"] / total_cost_ms
+                               if total_cost_ms else 0.0)
+        tenants_info = {"vectors": len(cost_recs),
+                        "tenants": rows_t}
+
+    # --- decision-attribution section (serve_decision + counters) ---------
+    decision_recs = [r for r in records
+                     if r.get("event") == "serve_decision"]
+    decisions_info: Optional[Dict[str, Any]] = None
+    if decision_recs or any(k.startswith("serve.decision.")
+                            for k in counters):
+        by_sv: Dict[str, int] = {}
+        for dr in decision_recs:
+            key = (f"{dr.get('site', '?')}:{dr.get('verdict', '?')}"
+                   + (f"({dr['cause']})" if dr.get("cause") else ""))
+            by_sv[key] = by_sv.get(key, 0) + 1
+        by_verdict = {k.split("serve.decision.", 1)[1]: int(v)
+                      for k, v in counters.items()
+                      if k.startswith("serve.decision.")}
+        decisions_info = {"records": len(decision_recs),
+                          "by_site_verdict": by_sv,
+                          "by_verdict": by_verdict}
+
     # --- catalog section (catalog.* counters + prefetch records) ----------
     # The exemplar catalog's tier ledger: per-tier hit/miss funnel
     # (HBM -> host -> disk -> cold build), quarantine + chaos-eviction
@@ -513,6 +561,8 @@ def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "tune": tune_info,
         "pipeline": pipeline_info,
         "serve": serve_info,
+        "tenants": tenants_info,
+        "decisions": decisions_info,
         "batch": batch_info,
         "ann": ann_info,
         "catalog": catalog_info,
@@ -669,6 +719,27 @@ def render(an: Dict[str, Any], run_id: Optional[str] = None) -> str:
             hist = ", ".join(f"{k}x{v}" for k, v in
                              srv["batch_size_hist"].items())
             w(f"    batch sizes   {hist}  (size x count)")
+
+    tn = an.get("tenants")
+    if tn:
+        w("  tenants:")
+        w(f"    cost vectors  {tn['vectors']} recorded")
+        for r in tn["tenants"][:12]:
+            w(f"    {str(r['tenant'])[:12]:<13} {r['requests']:>5} reqs  "
+              f"{100 * r['cost_share']:>5.1f}% cost  "
+              f"{r['dispatch_ms']:>8.1f} ms dispatch  "
+              f"{r['degraded']} degraded / {r['retries']} retries")
+        if len(tn["tenants"]) > 12:
+            w(f"    ... {len(tn['tenants']) - 12} more tenants")
+
+    dec = an.get("decisions")
+    if dec:
+        w("  decisions:")
+        verdicts = ", ".join(f"{k}x{v}" for k, v in
+                             sorted(dec["by_verdict"].items()))
+        w(f"    verdicts      {verdicts or '-'}  (verdict x count)")
+        for key in sorted(dec["by_site_verdict"]):
+            w(f"    {key:<36} {dec['by_site_verdict'][key]}")
 
     be = an.get("batch")
     if be:

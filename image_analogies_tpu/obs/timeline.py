@@ -356,6 +356,11 @@ class Timeline:
                     self.sample_snapshot(fn(), worker=worker)
                 except Exception:
                     _metrics.inc("obs.timeline.sampler_errors")
+                for feeder in list(_FEEDERS):
+                    try:
+                        feeder()
+                    except Exception:
+                        _metrics.inc("obs.timeline.sampler_errors")
 
         self._sampler = threading.Thread(
             target=_loop, name="ia-timeline-sampler", daemon=True)
@@ -367,6 +372,28 @@ class Timeline:
         self._sampler_stop.set()
         self._sampler.join(timeout=5.0)
         self._sampler = None
+
+
+# --- sampler feeders ---------------------------------------------------------
+#
+# Other armed planes (obs/ledger.py's per-tenant series) register a
+# zero-arg feeder here; a running sampler calls each after its own
+# sample, so tenant-labeled series ride whichever sampler exists
+# (standalone `ia serve --http` — the fleet health loop feeds directly).
+
+_FEEDERS: List[Callable[[], None]] = []
+
+
+def register_feeder(fn: Callable[[], None]) -> None:
+    if fn not in _FEEDERS:
+        _FEEDERS.append(fn)
+
+
+def unregister_feeder(fn: Callable[[], None]) -> None:
+    try:
+        _FEEDERS.remove(fn)
+    except ValueError:
+        pass
 
 
 # --- module-level armed plane ------------------------------------------------

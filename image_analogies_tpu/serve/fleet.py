@@ -57,10 +57,13 @@ import time
 from typing import Any, Dict, List, Optional
 
 from image_analogies_tpu.obs import fleet as obs_fleet
+from image_analogies_tpu.obs import ledger as obs_ledger
 from image_analogies_tpu.obs import live as obs_live
 from image_analogies_tpu.obs import metrics as obs_metrics
+from image_analogies_tpu.obs import tenants as obs_tenants
 from image_analogies_tpu.obs import timeline as obs_timeline
 from image_analogies_tpu.obs import trace as obs_trace
+from image_analogies_tpu.serve import journal as serve_journal
 from image_analogies_tpu.serve import transport as serve_transport
 # Re-exported for embedders/tests that import the handle machinery from
 # its historical home (the seam moved it to serve/transport.py).
@@ -81,10 +84,18 @@ class Fleet:
         self.supervisor = serve_transport.CrashLoopSupervisor(
             cfg.crash_loop_window_s, cfg.crash_loop_threshold,
             cfg.backoff_s, cfg.backoff_cap_s)
+        # Router/fleet verdicts persist in a sealed DecisionLog at the
+        # fleet journal root (they can't land in any worker journal —
+        # single-writer, often another process); `ia why` merges it
+        # with the per-worker journals into one causal chain.
+        self.decisions = (serve_journal.DecisionLog(
+            os.path.join(cfg.journal_root, serve_journal.DecisionLog.NAME))
+            if cfg.journal_root else None)
         self.router = Router(self, vnodes=cfg.vnodes,
                              spill_retries=cfg.spill_retries,
                              backoff_s=cfg.backoff_s,
-                             backoff_cap_s=cfg.backoff_cap_s)
+                             backoff_cap_s=cfg.backoff_cap_s,
+                             decision_log=self.decisions)
         self.handoffs: List[Dict[str, Any]] = []
         self._gates: Dict[str, str] = {}   # wid -> reason
         self._misses: Dict[str, int] = {}
@@ -174,6 +185,8 @@ class Fleet:
             self._health_thread.join(5.0)
         for handle in list(self.workers.values()):
             handle.shutdown()
+        if self.decisions is not None:
+            self.decisions.close()
         obs_timeline.disarm()
         self._scope_exit.close()
         self._started = False
@@ -214,8 +227,13 @@ class Fleet:
                                          idem)
 
     def submit(self, a, ap, b, params=None, deadline_s=None,
-               idempotency_key=None) -> "Future[Response]":
-        """Client entry point — delegates to the router."""
+               idempotency_key=None,
+               wire_bytes: int = 0) -> "Future[Response]":
+        """Client entry point — delegates to the router.  ``wire_bytes``
+        (the fleet HTTP front end's body size) is accepted for submit_fn
+        signature parity; the router->worker hop measures its own frame
+        and that is what the worker-side cost vector records."""
+        del wire_bytes
         return self.router.submit(a, ap, b, params=params,
                                   deadline_s=deadline_s,
                                   idempotency_key=idempotency_key)
@@ -279,6 +297,11 @@ class Fleet:
                 # Fleet-level series (router.* live only here) sampled
                 # unlabeled, alongside the worker-labeled ones below.
                 obs_timeline.sample_snapshot(self._scope.registry.snapshot())
+            # Tenant metering plane: mirror the local ledger's tracked
+            # tenants into tenant:<sha1[:8]>-labeled timeline series at
+            # the same cadence (no-op when the plane is disarmed — e.g.
+            # subprocess transport, where children sample their own).
+            obs_ledger.sample_timeline()
             for wid in list(self.workers):
                 if self._stop.is_set():
                     return
@@ -324,6 +347,12 @@ class Fleet:
         obs_metrics.inc("router.deaths")
         obs_trace.emit_record({"event": "router_death", "worker": wid,
                                "generation": old.generation})
+        # Fleet verdicts are worker-scope (no idem): they feed counters,
+        # `ia report`, and the decisions journal, but never a per-idem
+        # chain — those steps come from the router's spill/rechain sites.
+        if self.decisions is not None:
+            self.decisions.record(None, "fleet", "death", "health_misses",
+                                  worker_id=wid, generation=old.generation)
         # kill() releases the journal lock (in-process) or abandons it
         # on disk (subprocess SIGKILL — a real foreign stale lock); the
         # replacement's open() sweeps it, starts a fresh segment, and
@@ -340,6 +369,9 @@ class Fleet:
             obs_trace.emit_record({"event": "router_crash_loop",
                                    "worker": wid,
                                    "rapid": verdict["rapid"]})
+            if self.decisions is not None:
+                self.decisions.record(None, "fleet", "crash_loop",
+                                      "rapid_deaths", worker_id=wid)
             with self._lock:
                 self._gates[wid] = "crash_loop"
                 self._misses[wid] = 0
@@ -349,6 +381,10 @@ class Fleet:
             obs_trace.emit_record({"event": "router_respawn_backoff",
                                    "worker": wid,
                                    "delay_s": verdict["delay_s"]})
+            if self.decisions is not None:
+                self.decisions.record(None, "fleet", "respawn_backoff",
+                                      "recent_death", worker_id=wid,
+                                      delay_s=verdict["delay_s"])
             if self._stop.wait(verdict["delay_s"]):
                 return None  # fleet shutting down mid-backoff
         handle = self._spawn(wid, generation=old.generation + 1)
@@ -357,6 +393,10 @@ class Fleet:
         obs_trace.emit_record({"event": "router_handoff", "worker": wid,
                                "generation": handle.generation,
                                "recovered": recovered})
+        if self.decisions is not None:
+            self.decisions.record(None, "fleet", "handoff",
+                                  "journal_inherited", worker_id=wid,
+                                  generation=handle.generation)
         self.handoffs.append({"worker": wid,
                               "generation": handle.generation,
                               "recovered": recovered})
@@ -395,6 +435,30 @@ class Fleet:
             if snap is not None:
                 out[wid] = snap
         return out
+
+    def tenants_doc(self) -> Dict[str, Any]:
+        """Fleet-level ``/tenants``: the local ledger (in-process
+        transport shares one module plane, so this is the whole fleet)
+        merged with whatever each handle can scrape (subprocess children
+        serve their own ``/tenants``).  Mergeable space-saving keeps the
+        federated top-K an honest interval."""
+        local = obs_ledger.tenants_doc()
+        docs = [local]
+        for _wid, handle in sorted(self.workers.items()):
+            doc = handle.tenants()
+            if doc is not None:
+                docs.append(doc)
+        merged = obs_tenants.merge_docs(docs)
+        merged["armed"] = any(d.get("armed") for d in docs)
+        merged["recorded"] = sum(int(d.get("recorded") or 0)
+                                 for d in docs)
+        uptime = max((float(d.get("uptime_s") or 0.0) for d in docs),
+                     default=0.0)
+        if uptime:
+            merged["uptime_s"] = uptime
+            for row in merged["tenants"]:
+                row["qps"] = round(row.get("requests", 0) / uptime, 4)
+        return merged
 
     def metrics_text(self, worker: Optional[str] = None) -> Optional[str]:
         """Prometheus exposition: merged fleet view with ``worker=<wid>``

@@ -16,6 +16,11 @@ API:
                         (?window=10 selects a downsampling tier);
                         both scrape endpoints self-report duration and
                         errors under obs.scrape.*
+  GET  /tenants      -> per-tenant heavy-hitter document
+                        (obs/ledger.py): top-K styles by request count
+                        with cost share, p95, degrade/retry tallies;
+                        {"armed": false, "tenants": []} when the
+                        metering plane is off
   POST /v1/analogy   -> body {"a": [[...]], "ap": [[...]], "b": [[...]],
                         "deadline_ms": optional float,
                         "idempotency_key": optional str (journal dedupe;
@@ -63,11 +68,13 @@ from image_analogies_tpu.serve.types import DeadlineExceeded, Rejected
 
 def _make_handler(server: Server):
     return _make_handler_from(server.health, server.submit,
-                              server.refresh_gauges)
+                              server.refresh_gauges,
+                              tenants_fn=server.tenants_doc)
 
 
 def _make_handler_from(health_fn, submit_fn, refresh_fn, metrics_fn=None,
-                       timeline_fn=None, snapshot_fn=None):
+                       timeline_fn=None, snapshot_fn=None,
+                       tenants_fn=None):
     # metrics_fn(worker: Optional[str]) -> Optional[str]: override for
     # the /metrics exposition (the fleet's federated view, with
     # ?worker=<wid> selecting one worker's isolated registry).  None
@@ -114,6 +121,8 @@ def _make_handler_from(health_fn, submit_fn, refresh_fn, metrics_fn=None,
                     self._scrape("metrics", self._get_metrics_json, parts)
             elif parts.path == "/timeline":
                 self._scrape("timeline", self._get_timeline, parts)
+            elif parts.path == "/tenants":
+                self._scrape("tenants", self._get_tenants, parts)
             else:
                 self._reply(404, {"error": "not_found"})
 
@@ -156,6 +165,13 @@ def _make_handler_from(health_fn, submit_fn, refresh_fn, metrics_fn=None,
         def _get_metrics_json(self, parts) -> None:
             refresh_fn()
             self._reply(200, snapshot_fn())
+
+        def _get_tenants(self, parts) -> None:
+            if tenants_fn is not None:
+                self._reply(200, tenants_fn())
+                return
+            from image_analogies_tpu.obs import ledger as obs_ledger
+            self._reply(200, obs_ledger.tenants_doc())
 
         def _get_timeline(self, parts) -> None:
             query = urllib.parse.parse_qs(parts.query)
@@ -247,7 +263,8 @@ def _make_handler_from(health_fn, submit_fn, refresh_fn, metrics_fn=None,
                         a, ap, b, params=params,
                         deadline_s=None if deadline_ms is None
                         else float(deadline_ms) / 1e3,
-                        idempotency_key=idem).result()
+                        idempotency_key=idem,
+                        wire_bytes=len(body)).result()
             except Rejected as exc:
                 self._reply(429, {"error": "rejected", "reason": exc.reason},
                             headers=trace_headers)
@@ -335,4 +352,5 @@ def serve_fleet_http(fleet, port: int) -> ThreadingHTTPServer:
     return ThreadingHTTPServer(
         ("127.0.0.1", port),
         _make_handler_from(fleet.health, fleet.submit, _refresh,
-                           metrics_fn=fleet.metrics_text))
+                           metrics_fn=fleet.metrics_text,
+                           tenants_fn=fleet.tenants_doc))

@@ -53,8 +53,9 @@ import json
 import os
 import re
 import threading
+import time
 import zipfile
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -66,7 +67,13 @@ from image_analogies_tpu.utils import checkpoint as ckpt
 
 _SEGMENT_FMT = "segment-%06d.jsonl"
 _LOCK_NAME = "journal.lock"
-_OPS = ("admitted", "dispatched", "done", "rejected", "poisoned")
+# State transitions (folded by replay) plus two attribution ops that
+# ride alongside without shaping replay: ``cost`` (the per-request cost
+# vector from obs/ledger.py) and ``decision`` (a control-plane verdict —
+# degrade, shed, spill, poison, dedupe...).  `ia why` merges all of them
+# into one causal chain.
+_OPS = ("admitted", "dispatched", "done", "rejected", "poisoned",
+        "cost", "decision")
 _IDEM_RE = re.compile(r"[A-Za-z0-9_-]{1,64}\Z")
 
 
@@ -153,6 +160,10 @@ class Replay:
     order: List[str]                      # idems in original admit order
     quarantined: int = 0                  # segments moved to .corrupt
     lines: int = 0                        # valid sealed lines read
+    # cost/decision attribution lines per idem — not state, but compact
+    # preserves them for still-incomplete work so `ia why` survives it.
+    aux: Dict[str, List[Dict[str, Any]]] = dataclasses.field(
+        default_factory=dict)
 
     @property
     def incomplete(self) -> List[JournalEntry]:
@@ -309,6 +320,11 @@ class RequestJournal:
         # here models the process dying with this transition unrecorded —
         # exactly the torn-history case replay must absorb.
         chaos.site("serve.journal", op=record.get("op", "?"))
+        # Wall-clock stamp on every line so `ia why` can merge-order
+        # events across worker journals and the router's DecisionLog
+        # (pre-stamp journals sort by file order, which is still causal
+        # within one journal).
+        record.setdefault("ts", round(time.time(), 6))
         line = json.dumps({"seal": _seal(record), **record},
                           sort_keys=True, separators=(",", ":"))
         with self._lock:
@@ -372,6 +388,25 @@ class RequestJournal:
         self._append({"op": "poisoned", "idem": idem})
         with self._lock:
             self._poisoned.add(idem)
+
+    def record_cost(self, idem: str, vec: Dict[str, Any]) -> None:
+        """Persist the per-request cost vector (obs/ledger.py) beside
+        the request's own transitions — `ia why`'s timing evidence."""
+        self._append({"op": "cost", "idem": idem, "vec": vec})
+
+    def record_decision(self, idem: str, site: str, verdict: str,
+                        cause: Optional[str] = None,
+                        **extra: Any) -> None:
+        """Persist one control-plane verdict for this key.  Callers
+        pair this with obs/ledger.emit_decision (counters + trace);
+        this line is the durable half `ia why` replays."""
+        rec = {"op": "decision", "idem": idem, "site": site,
+               "verdict": verdict}
+        if cause is not None:
+            rec["cause"] = cause
+        if extra:
+            rec.update(extra)
+        self._append(rec)
 
     # -- dedupe / poison lookups (request path) ----------------------------
 
@@ -496,6 +531,7 @@ class RequestJournal:
         recovery re-enqueues by."""
         entries: Dict[str, JournalEntry] = {}
         order: List[str] = []
+        aux: Dict[str, List[Dict[str, Any]]] = {}
         quarantined_before = _corrupt_count(self.path)
         lines = 0
         for seg in self._segments():
@@ -508,6 +544,12 @@ class RequestJournal:
                     # skip it so replay never turns it into a path.
                     continue
                 op = rec["op"]
+                if op in ("cost", "decision"):
+                    # Attribution, not state: collected for compact but
+                    # never folded — a cost line alone must not
+                    # synthesize a replayable entry.
+                    aux.setdefault(idem, []).append(rec)
+                    continue
                 if op == "admitted":
                     if idem not in entries:
                         entries[idem] = JournalEntry(idem=idem, admit=rec)
@@ -539,7 +581,18 @@ class RequestJournal:
         return Replay(entries=entries, order=order,
                       quarantined=_corrupt_count(self.path)
                       - quarantined_before,
-                      lines=lines)
+                      lines=lines, aux=aux)
+
+    def history(self, idem: str) -> List[Dict[str, Any]]:
+        """Every sealed line for *idem* (all ops, including cost and
+        decision attribution) in file order — `ia why`'s raw evidence
+        from one journal."""
+        out: List[Dict[str, Any]] = []
+        for seg in self._segments():
+            for rec in self._read_segment(seg):
+                if str(rec.get("idem")) == idem:
+                    out.append(rec)
+        return out
 
     # -- tooling (`ia journal`) --------------------------------------------
 
@@ -606,6 +659,11 @@ class RequestJournal:
                     put(ent.admit)
                     for _ in range(ent.dispatched):
                         put({"op": "dispatched", "idem": idem})
+                    # Keep attribution for still-open work so a post-
+                    # compact `ia why` sees the partial chain; finished
+                    # keys drop theirs with the other intermediates.
+                    for rec in rep.aux.get(idem, ()):
+                        put(rec)
             for idem, ent in sorted(rep.entries.items()):
                 if ent.poisoned:
                     put({"op": "poisoned", "idem": idem})
@@ -642,6 +700,231 @@ class RequestJournal:
         router (or operator) checks before handing the directory to a
         replacement worker."""
         return {"lock_pid": self.active_pid(), "segment": self._segment}
+
+
+class DecisionLog:
+    """Sealed JSONL decision trail for verdicts rendered OUTSIDE any
+    worker journal — the router/fleet control plane (spill off home,
+    death, crash-loop gate, handoff re-chain).  Worker journals are
+    single-writer per process, so cross-process verdicts land here
+    instead, at the fleet journal root, and `ia why` merges both.
+
+    Unlike :meth:`RequestJournal.record_decision` (persist-only, paired
+    with obs/ledger.emit_decision by the caller), :meth:`record` is the
+    whole funnel for its sites: counter + trace record + sealed line."""
+
+    NAME = "decisions.jsonl"
+
+    def __init__(self, path: str, fsync: bool = False):
+        self.path = path
+        self.fsync = fsync
+        self._lock = threading.Lock()
+        self._fh = None
+
+    def record(self, idem: Optional[str], site: str, verdict: str,
+               cause: Optional[str] = None, **extra: Any) -> None:
+        rec: Dict[str, Any] = {"op": "decision", "site": site,
+                               "verdict": verdict,
+                               "ts": round(time.time(), 6)}
+        if idem is not None:
+            rec["idem"] = idem
+        if cause is not None:
+            rec["cause"] = cause
+        if extra:
+            rec.update(extra)
+        line = json.dumps({"seal": _seal(rec), **rec},
+                          sort_keys=True, separators=(",", ":"))
+        with self._lock:
+            if self._fh is None:
+                os.makedirs(os.path.dirname(self.path) or ".",
+                            exist_ok=True)
+                self._fh = open(self.path, "a")
+            self._fh.write(line + "\n")
+            self._fh.flush()
+            if self.fsync:
+                os.fsync(self._fh.fileno())
+        obs_metrics.inc(f"serve.decision.{verdict}")
+        trace_rec = {"event": "serve_decision", "site": site,
+                     "verdict": verdict}
+        if cause is not None:
+            trace_rec["cause"] = cause
+        if idem is not None:
+            trace_rec["idem"] = idem
+        obs_trace.emit_record(trace_rec)
+
+    def read(self, idem: Optional[str] = None) -> List[Dict[str, Any]]:
+        """Sealed decision lines in file order; a torn tail or flipped
+        bit drops that line only (evidence log, not replay state)."""
+        out: List[Dict[str, Any]] = []
+        try:
+            with open(self.path) as f:
+                lines = f.readlines()
+        except OSError:
+            return out
+        for line in lines:
+            stripped = line.strip()
+            if not stripped:
+                continue
+            try:
+                rec = json.loads(stripped)
+                seal = rec.pop("seal")
+                if seal != _seal(rec) or rec.get("op") != "decision":
+                    raise ValueError("bad seal")
+            except (json.JSONDecodeError, KeyError, ValueError,
+                    AttributeError, TypeError):
+                obs_metrics.inc("serve.decision_log.skipped")
+                continue
+            if idem is None or rec.get("idem") == idem:
+                out.append(rec)
+        return out
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                finally:
+                    self._fh = None
+
+
+# -- request forensics (`ia why`) ---------------------------------------------
+
+def _journal_dirs(root: str) -> List[Tuple[str, str]]:
+    """``(label, path)`` of every journal under *root*: either *root*
+    itself (single-server layout, segments at top level) or each child
+    directory holding segments (fleet layout, one subdir per worker)."""
+
+    def has_segments(path: str) -> bool:
+        try:
+            return any(n.startswith("segment-") and n.endswith(".jsonl")
+                       for n in os.listdir(path))
+        except OSError:
+            return False
+
+    if has_segments(root):
+        return [(os.path.basename(os.path.normpath(root)) or "journal",
+                 root)]
+    out: List[Tuple[str, str]] = []
+    try:
+        names = sorted(os.listdir(root))
+    except OSError:
+        return out
+    for name in names:
+        sub = os.path.join(root, name)
+        if os.path.isdir(sub) and has_segments(sub):
+            out.append((name, sub))
+    return out
+
+
+def _chain_step(e: Dict[str, Any]) -> str:
+    op = e.get("op")
+    if op == "admitted":
+        return f"admitted[{e.get('worker', '?')}]"
+    if op == "dispatched":
+        return "dispatched"
+    if op == "done":
+        return "done"
+    if op == "poisoned":
+        return "poisoned"
+    if op == "rejected":
+        return f"rejected({e.get('reason', '?')})"
+    if op == "cost":
+        vec = e.get("vec") or {}
+        q = float(vec.get("queue_ms") or 0.0)
+        d = float(vec.get("dispatch_ms") or 0.0)
+        step = f"queued {q:.0f}ms, ran {d:.0f}ms"
+        lanes = int(vec.get("lanes") or 1)
+        if lanes > 1:
+            step += f" ({lanes} lanes)"
+        retries = int(vec.get("retries") or 0)
+        if retries:
+            step += f", {retries} retries"
+        return step
+    if op == "decision":
+        details = []
+        if e.get("cause"):
+            details.append(str(e["cause"]))
+        for key in ("levels", "home", "to", "worker_id", "pid"):
+            if e.get(key) is not None:
+                details.append(f"{key}={e[key]}")
+        verdict = e.get("verdict", "?")
+        return f"{verdict}({', '.join(details)})" if details else verdict
+    return str(op)
+
+
+def reconstruct(idem: str, root: str) -> Dict[str, Any]:
+    """Replay journal + ledger + decision evidence for one idempotency
+    key into a single ordered causal chain — the `ia why` engine.
+
+    *root* is either one journal directory (segments at top level) or a
+    fleet journal root (per-worker subdirectories plus the router's
+    ``decisions.jsonl``).  Events merge across sources ordered by their
+    ``ts`` stamp (stable on ties; stamp-less legacy lines keep file
+    order at the front)."""
+    events: List[Dict[str, Any]] = []
+    workers: List[str] = []
+    for wid, jdir in _journal_dirs(root):
+        jr = RequestJournal(jdir)
+        hist = jr.history(idem)
+        if hist:
+            workers.append(wid)
+        for rec in hist:
+            events.append(dict(rec, worker=wid))
+    dpath = os.path.join(root, DecisionLog.NAME)
+    if os.path.exists(dpath):
+        for rec in DecisionLog(dpath).read(idem):
+            events.append(dict(rec, worker=str(rec.get("site",
+                                                       "router"))))
+    for i, e in enumerate(events):
+        e["_seq"] = i
+    events.sort(key=lambda e: (
+        float(e["ts"]) if isinstance(e.get("ts"), (int, float))
+        else float("-inf"), e["_seq"]))
+    for e in events:
+        e.pop("_seq", None)
+    tenant = None
+    traces = []
+    for e in events:
+        vec = e.get("vec") if e.get("op") == "cost" else None
+        if tenant is None and isinstance(vec, dict) and vec.get("tenant"):
+            tenant = vec["tenant"]
+        for t in (e.get("trace"),
+                  (vec or {}).get("trace") if isinstance(vec, dict)
+                  else None):
+            if t and t not in traces:
+                traces.append(t)
+    return {"idem": idem, "found": bool(events), "root": root,
+            "workers": workers, "tenant": tenant, "traces": traces,
+            "events": events,
+            "chain": [_chain_step(e) for e in events]}
+
+
+def render_why(doc: Dict[str, Any]) -> str:
+    """Human-readable rendering of :func:`reconstruct`'s document."""
+    idem = doc.get("idem", "?")
+    if not doc.get("found"):
+        return (f"ia why {idem}: no journal, ledger, or decision "
+                f"records under {doc.get('root', '?')}\n")
+    lines = [f"ia why {idem}"]
+    if doc.get("tenant"):
+        lines.append(f"  tenant: {doc['tenant']}")
+    if doc.get("traces"):
+        lines.append(f"  traces: {', '.join(doc['traces'])}")
+    if doc.get("workers"):
+        lines.append(f"  journals: {', '.join(doc['workers'])}")
+    t0 = None
+    for e in doc.get("events", []):
+        ts = e.get("ts")
+        if isinstance(ts, (int, float)):
+            if t0 is None:
+                t0 = ts
+            stamp = f"+{ts - t0:8.3f}s"
+        else:
+            stamp = " " * 10
+        lines.append(f"  {stamp} [{e.get('worker', '?'):>10}] "
+                     f"{_chain_step(e)}")
+    lines.append("  chain: " + " → ".join(doc.get("chain", [])))
+    return "\n".join(lines) + "\n"
 
 
 def _corrupt_count(path: str) -> int:

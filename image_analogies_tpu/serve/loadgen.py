@@ -30,13 +30,40 @@ def percentile(values: Sequence[float], q: float) -> float:
     return xs[idx]
 
 
-def make_load(n: int, shapes: Sequence[Tuple[int, int]], seed: int
+def make_load(n: int, shapes: Sequence[Tuple[int, int]], seed: int, *,
+              zipf: Optional[float] = None, styles: int = 0
               ) -> List[Dict[str, Any]]:
     """N requests cycling through shape classes.  Exemplars are shared
     per class (the realistic serving pattern: one style, many targets)
     so same-class requests are batch-compatible; targets differ per
-    request."""
+    request.
+
+    With ``zipf=S`` the load is drawn over ``styles`` synthetic styles
+    (distinct exemplar pairs == distinct tenants) with Zipf-skewed
+    frequency: style of rank r is picked with probability proportional
+    to ``r**-S``.  S=0 is uniform; S~1 is the classic heavy-hitter
+    shape where one viral style dominates — the load the tenant
+    metering plane (obs/ledger.py) exists to attribute.  Deterministic
+    for a given (n, shapes, seed, zipf, styles)."""
     rng = np.random.RandomState(seed)
+    if zipf is not None:
+        n_styles = max(1, int(styles) or 8)
+        ranks = np.arange(1, n_styles + 1, dtype=np.float64)
+        probs = ranks ** -float(zipf)
+        probs /= probs.sum()
+        style_shapes = [shapes[s % len(shapes)] for s in range(n_styles)]
+        exemplars_z = [(rng.rand(h, w).astype(np.float32),
+                        rng.rand(h, w).astype(np.float32))
+                       for h, w in style_shapes]
+        picks = rng.choice(n_styles, size=n, p=probs)
+        load = []
+        for i in range(n):
+            s = int(picks[i])
+            h, w = style_shapes[s]
+            a, ap = exemplars_z[s]
+            load.append({"index": i, "style": s, "a": a, "ap": ap,
+                         "b": rng.rand(h, w).astype(np.float32)})
+        return load
     exemplars = {}
     for h, w in shapes:
         exemplars[(h, w)] = (rng.rand(h, w).astype(np.float32),
@@ -50,9 +77,21 @@ def make_load(n: int, shapes: Sequence[Tuple[int, int]], seed: int
     return load
 
 
+def style_hist(load: List[Dict[str, Any]]) -> Optional[Dict[str, int]]:
+    """Per-style request counts of a zipf load (None for classic loads)."""
+    if not load or "style" not in load[0]:
+        return None
+    hist: Dict[str, int] = {}
+    for item in load:
+        k = f"s{item['style']}"
+        hist[k] = hist.get(k, 0) + 1
+    return dict(sorted(hist.items(), key=lambda kv: (-kv[1], kv[0])))
+
+
 def selftest(cfg: ServeConfig, n: int, *, seed: int = 0,
              deadline_ms: Optional[Any] = None,
-             shapes: Sequence[Tuple[int, int]] = DEFAULT_SHAPES
+             shapes: Sequence[Tuple[int, int]] = DEFAULT_SHAPES,
+             zipf: Optional[float] = None, styles: int = 0
              ) -> Dict[str, Any]:
     """Run the synthetic load end-to-end; returns the summary dict.
 
@@ -64,7 +103,7 @@ def selftest(cfg: ServeConfig, n: int, *, seed: int = 0,
     from image_analogies_tpu.models.analogy import create_image_analogy
     from image_analogies_tpu.obs import metrics as obs_metrics
 
-    load = make_load(n, shapes, seed)
+    load = make_load(n, shapes, seed, zipf=zipf, styles=styles)
 
     def deadline_s(i: int) -> Optional[float]:
         if deadline_ms is None:
@@ -172,12 +211,15 @@ def selftest(cfg: ServeConfig, n: int, *, seed: int = 0,
         "batch_engine": batch_ledger,
         "bit_identical": bool(identical),
         "journal": journal_stats,
+        "zipf": zipf,
+        "style_hist": style_hist(load),
     }
 
 
 def fleet_selftest(fcfg: "Any", n: int, *, seed: int = 0,
                    deadline_ms: Optional[Any] = None,
-                   shapes: Sequence[Tuple[int, int]] = DEFAULT_SHAPES
+                   shapes: Sequence[Tuple[int, int]] = DEFAULT_SHAPES,
+                   zipf: Optional[float] = None, styles: int = 0
                    ) -> Dict[str, Any]:
     """``ia fleet --selftest N``: the synthetic load routed through the
     consistent-hash Router over a worker fleet, against the same
@@ -189,7 +231,7 @@ def fleet_selftest(fcfg: "Any", n: int, *, seed: int = 0,
     from image_analogies_tpu.obs import metrics as obs_metrics
     from image_analogies_tpu.serve.fleet import Fleet
 
-    load = make_load(n, shapes, seed)
+    load = make_load(n, shapes, seed, zipf=zipf, styles=styles)
 
     def deadline_s(i: int) -> Optional[float]:
         if deadline_ms is None:
@@ -271,6 +313,8 @@ def fleet_selftest(fcfg: "Any", n: int, *, seed: int = 0,
         "handoffs": health.get("handoffs", 0),
         "ring": health.get("ring", {}),
         "bit_identical": bool(identical),
+        "zipf": zipf,
+        "style_hist": style_hist(load),
     }
 
 
@@ -297,6 +341,9 @@ def render_fleet(summary: Dict[str, Any]) -> str:
         f"  bit-identical to singleton dispatch: "
         f"{summary['bit_identical']}",
     ]
+    if summary.get("style_hist"):
+        lines.insert(-1, f"  styles:     zipf S={summary['zipf']} -> "
+                     f"{summary['style_hist']}")
     return "\n".join(lines)
 
 
@@ -324,6 +371,9 @@ def render(summary: Dict[str, Any]) -> str:
                      f"completions, {be['lane_faults']} lane faults"
                      + (f", fallbacks {be['fallbacks']}"
                         if be["fallbacks"] else ""))
+    if summary.get("style_hist"):
+        lines.insert(-1, f"  styles:     zipf S={summary['zipf']} -> "
+                     f"{summary['style_hist']}")
     jn = summary.get("journal")
     if jn:
         lines.append(

@@ -40,6 +40,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from image_analogies_tpu import chaos
+from image_analogies_tpu.obs import ledger as obs_ledger
 from image_analogies_tpu.obs import metrics as obs_metrics
 from image_analogies_tpu.obs import trace as obs_trace
 from image_analogies_tpu.serve import batcher
@@ -134,7 +135,7 @@ class Router:
 
     def __init__(self, fleet: "Any", *, vnodes: int = 32,
                  spill_retries: int = 3, backoff_s: float = 0.05,
-                 backoff_cap_s: float = 1.0):
+                 backoff_cap_s: float = 1.0, decision_log=None):
         self._fleet = fleet
         self.ring = Ring(vnodes)
         self._spill_retries = int(spill_retries)
@@ -142,6 +143,19 @@ class Router:
         self._backoff_cap_s = float(backoff_cap_s)
         self._pending: Dict[str, _Pending] = {}
         self._lock = threading.Lock()
+        # Router verdicts can't land in any worker journal (single-
+        # writer, often another process) — they persist in the fleet's
+        # DecisionLog (serve/journal.py) when one is configured, so
+        # `ia why` can attribute spills and re-chains cross-process.
+        self._dlog = decision_log
+
+    def _decide(self, idem: Optional[str], verdict: str, cause: str,
+                **extra) -> None:
+        if self._dlog is not None:
+            self._dlog.record(idem, "router", verdict, cause, **extra)
+        else:
+            obs_ledger.emit_decision("router", verdict, cause,
+                                     idem=idem, **extra)
 
     # ------------------------------------------------------------------
     # submit path
@@ -219,6 +233,10 @@ class Router:
                 obs_trace.emit_record({"event": "router_spill",
                                        "idem": idem, "home": order[0],
                                        "to": wid, "attempt": attempt})
+                self._decide(idem, "spill",
+                             "home_gated" if order[0] not in ungated
+                             else "hop_fault",
+                             home=order[0], to=wid)
             try:
                 chaos.site("router.forward", worker=wid, key=kstr)
                 src = self._fleet.forward(wid, a, ap, b, p,
@@ -279,11 +297,15 @@ class Router:
                 obs_metrics.inc("router.rechained")
                 obs_trace.emit_record({"event": "router_rechain",
                                        "idem": ent.idem, "worker": wid})
+                self._decide(ent.idem, "rechain", "handoff_recovery",
+                             worker_id=wid)
                 self._chain(rec, ent)
                 continue
             obs_metrics.inc("router.resubmitted")
             obs_trace.emit_record({"event": "router_resubmit",
                                    "idem": ent.idem, "worker": wid})
+            self._decide(ent.idem, "resubmit", "handoff_not_replayed",
+                         worker_id=wid)
             a, ap, b, p = ent.payload
             try:
                 src = self._fleet.forward(wid, a, ap, b, p,
@@ -310,6 +332,8 @@ class Router:
             try:
                 ent.future.set_exception(exc)
                 failed += 1
+                self._decide(ent.idem, "fail_pending", "crash_loop_gate",
+                             worker_id=wid)
             except InvalidStateError:
                 pass
             with self._lock:

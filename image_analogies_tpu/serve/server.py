@@ -38,6 +38,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.obs import ledger as obs_ledger
 from image_analogies_tpu.obs import metrics as obs_metrics
 from image_analogies_tpu.obs import trace as obs_trace
 from image_analogies_tpu.obs.slo import SloTracker
@@ -113,6 +114,7 @@ class Server:
         self._exit = contextlib.ExitStack()
         self._accepting = False
         self._started = False
+        self._ledger_armed = False
         self._next_id = 0
         self._id_lock = threading.Lock()
         self._t_start: Optional[float] = None
@@ -142,7 +144,16 @@ class Server:
                 "cost_prior": self.cost_prior_source,
                 "slo_target": self.cfg.slo_target,
                 "journal": self.cfg.journal_dir,
+                "ledger": self.cfg.ledger,
             }}))
+        if self.cfg.ledger:
+            # Tenant metering plane: arm (or join) the process ledger
+            # for the server's lifetime.  arm() nests, so a fleet of
+            # in-process workers shares one plane and the last shutdown
+            # disarms it.
+            obs_ledger.arm(capacity=self.cfg.ledger_capacity,
+                           tenant_k=self.cfg.tenant_k)
+            self._ledger_armed = True
         if self.obs_scope is None and self.cfg.journal_dir:
             # standalone journaled server: the run scope's flight
             # recorder dumps into this journal dir on a death path
@@ -184,8 +195,14 @@ class Server:
                 pass
         if self._journal is not None:
             self._journal.close()
+        self._disarm_ledger()
         self._started = False
         self._exit.close()
+
+    def _disarm_ledger(self) -> None:
+        if self._ledger_armed:
+            self._ledger_armed = False
+            obs_ledger.disarm()
 
     @_scoped
     def kill(self) -> None:
@@ -203,6 +220,7 @@ class Server:
         self._pool.join(2.0)
         if self._journal is not None:
             self._journal.close()
+        self._disarm_ledger()
         self._started = False
         self._exit.close()
 
@@ -227,6 +245,13 @@ class Server:
         restored = []
         for ent in rep.incomplete:
             if ent.dispatched > self.cfg.crash_requeues:
+                obs_ledger.emit_decision("server", "poison",
+                                         "replay_dispatch_exhausted",
+                                         idem=ent.idem)
+                self._journal.record_decision(
+                    ent.idem, "server", "poison",
+                    "replay_dispatch_exhausted",
+                    dispatched=ent.dispatched)
                 self._journal.record_poisoned(ent.idem)
                 stats["poisoned"] += 1
                 obs_trace.emit_record({"event": "serve_replay",
@@ -236,6 +261,8 @@ class Server:
                 continue
             payload = self._journal.load_payload(ent.idem)
             if payload is None:  # spill damaged: quarantined, not re-run
+                obs_ledger.emit_decision("server", "reject",
+                                         "payload_corrupt", idem=ent.idem)
                 self._journal.record_rejected(ent.idem, "payload_corrupt")
                 stats["unrecoverable"] += 1
                 obs_trace.emit_record({"event": "serve_replay",
@@ -254,6 +281,12 @@ class Server:
             restored.append(req)
             self.recovery[ent.idem] = fut
             stats["replayed"] += 1
+            obs_ledger.emit_decision("server", "replay",
+                                     "incomplete_after_restart",
+                                     idem=ent.idem)
+            self._journal.record_decision(ent.idem, "server", "replay",
+                                          "incomplete_after_restart",
+                                          dispatched=ent.dispatched)
             obs_metrics.inc("serve.journal.replayed")
             obs_trace.emit_record({"event": "serve_replay",
                                    "idem": ent.idem, "request": rid,
@@ -293,7 +326,8 @@ class Server:
     def submit(self, a: np.ndarray, ap: np.ndarray, b: np.ndarray,
                params: Optional[AnalogyParams] = None,
                deadline_s: Optional[float] = None,
-               idempotency_key: Optional[str] = None) -> "Future[Response]":
+               idempotency_key: Optional[str] = None,
+               wire_bytes: int = 0) -> "Future[Response]":
         """Enqueue one request; returns a Future resolving to a Response
         (or raising DeadlineExceeded / the dispatch error).  Raises
         :class:`Rejected` when the server is full or shutting down.
@@ -323,6 +357,8 @@ class Server:
             if self._journal.is_poisoned(idem):
                 obs_metrics.inc("serve.rejected")
                 obs_metrics.inc("serve.poisoned")
+                obs_ledger.emit_decision("server", "shed", "poison",
+                                         idem=idem)
                 raise Rejected("poison")
             cached = self._journal.lookup_done(idem)
             if cached is not None:
@@ -330,6 +366,13 @@ class Server:
                 obs_trace.emit_record({"event": "serve_dedupe",
                                        "request": cached.request_id,
                                        "idem": idem})
+                # The dedupe verdict is part of this key's causal chain
+                # ("done, bit-exact dedupe on retry") — journal it so
+                # `ia why` shows the retry was answered, not re-run.
+                obs_ledger.emit_decision("server", "dedupe",
+                                         "journal_done", idem=idem)
+                self._journal.record_decision(idem, "server", "dedupe",
+                                              "journal_done")
                 fut: "Future[Response]" = Future()
                 fut.set_result(cached)
                 return fut
@@ -345,6 +388,11 @@ class Server:
                 obs_metrics.inc("serve.journal.join_replay")
                 obs_trace.emit_record({"event": "serve_join_replay",
                                        "idem": idem})
+                obs_ledger.emit_decision("server", "join_replay",
+                                         "replay_in_flight", idem=idem)
+                self._journal.record_decision(idem, "server",
+                                              "join_replay",
+                                              "replay_in_flight")
                 joined: "Future[Response]" = Future()
 
                 def _chain(f: "Future[Response]",
@@ -367,6 +415,8 @@ class Server:
             # is non-claiming, so the half-open probe still flows.
             obs_metrics.inc("serve.rejected")
             obs_metrics.inc("serve.rejected.breaker_open")
+            obs_ledger.emit_decision("server", "shed", "breaker_open",
+                                     idem=idem)
             raise Rejected("breaker_open")
         if deadline_s is None:
             deadline_s = self.cfg.default_deadline_s
@@ -381,6 +431,7 @@ class Server:
             key=key if key is not None else batcher.batch_key(a, ap, b, p),
             future=fut,
             idem=idem,
+            wire_bytes=wire_bytes,
             # Submit runs on the caller's thread; the worker thread that
             # dispatches is a different one — the trace context crosses
             # via the request itself.
@@ -431,6 +482,13 @@ class Server:
                                   round(time.monotonic() - self._t_start, 3))
         obs_metrics.set_gauge("serve.queue_depth", len(self._queue))
         self._pool.breaker.export_state()
+
+    @_scoped
+    def tenants_doc(self) -> Dict[str, Any]:
+        """JSON-ready /tenants payload: the metering plane's per-tenant
+        heavy-hitter document (obs/ledger.py).  ``armed: false`` with an
+        empty list when the ledger is off."""
+        return obs_ledger.tenants_doc()
 
     @_scoped
     def health(self) -> Dict[str, Any]:

@@ -226,6 +226,11 @@ class WorkerHandle:
     def refresh_gauges(self) -> None:
         self.server.refresh_gauges()
 
+    def tenants(self) -> None:
+        # In-process workers share the module ledger plane — the fleet
+        # reads it once locally; per-handle reads would K-count it.
+        return None
+
     def recovery_stats(self) -> Dict[str, Any]:
         return self.server.recovery_stats or {}
 
@@ -251,10 +256,12 @@ class WorkerHandle:
         through the negotiated codec, submit, response planes back
         through the codec."""
         ctx = obs_trace.capture_trace()
+        hop_bytes = 0
         if self.codec == "iaf2":
             planes = [np.asarray(x, np.float32) for x in (a, ap, b)]
             frame = wire.encode_planes(planes)
             obs_metrics.inc("router.wire_bytes", len(frame))
+            hop_bytes = len(frame)
             a, ap, b = wire.decode_planes(frame)
             if ctx:
                 # The IAT1 side frame rides next to the plane frame; the
@@ -262,6 +269,7 @@ class WorkerHandle:
                 # planes get.
                 cframe = wire.encode_context(ctx)
                 obs_metrics.inc("router.wire_bytes", len(cframe))
+                hop_bytes += len(cframe)
                 ctx = wire.decode_context(cframe)
         else:
             a, ap, b = _roundtrip_json([a, ap, b])
@@ -275,7 +283,8 @@ class WorkerHandle:
                 else contextlib.nullcontext():
             src = self.server.submit(a, ap, b, params=params,
                                      deadline_s=deadline_s,
-                                     idempotency_key=idem)
+                                     idempotency_key=idem,
+                                     wire_bytes=hop_bytes)
         return _wrap_response(src, self.codec)
 
 
@@ -429,6 +438,14 @@ class SubprocessHandle:
         # The child refreshes its own gauges on every /metrics scrape;
         # nothing to do parent-side.
         pass
+
+    def tenants(self) -> Optional[Dict[str, Any]]:
+        """The child's /tenants document (its own armed ledger plane);
+        None when the child is unreachable."""
+        try:
+            return self._get_json("/tenants")
+        except Exception:  # noqa: BLE001 - dead/dying child
+            return None
 
     def recovery_stats(self) -> Dict[str, Any]:
         try:

@@ -113,8 +113,22 @@ class ServeConfig:
     # with the reason on batch.fallback_sequential.<reason>.  Outputs
     # are bit-identical either way (the loadgen selftest gates it).
     batch_engine: bool = True
+    # Tenant metering plane (obs/ledger.py): arm the per-request cost
+    # ledger + space-saving heavy-hitter tracker for the server's
+    # lifetime.  One style (= batcher exemplar sha1) is one tenant;
+    # /tenants and `ia top --tenants` read the resulting document.
+    # Disarming makes the cost path one bool check (zero-alloc,
+    # tracemalloc-locked in tests) — what bench.py's
+    # ledger_overhead_pct measures.
+    ledger: bool = True
+    ledger_capacity: int = 512     # bounded in-memory cost vectors
+    tenant_k: int = 16             # heavy-hitter slots (O(K) memory)
 
     def __post_init__(self):
+        if self.ledger_capacity < 1:
+            raise ValueError("ledger_capacity must be >= 1")
+        if self.tenant_k < 1:
+            raise ValueError("tenant_k must be >= 1")
         if self.queue_depth < 1:
             raise ValueError("queue_depth must be >= 1")
         if self.max_batch < 1:
@@ -232,6 +246,9 @@ class Request:
     # request — worker threads are NOT the submit thread, so the trace
     # must travel in the request, not in a thread-local.
     trace: Optional[Dict[str, str]] = None
+    # Encoded request size as it crossed the HTTP boundary (0 for
+    # in-process submissions) — part of the cost vector (obs/ledger.py).
+    wire_bytes: int = 0
 
     def remaining(self, now: Optional[float] = None) -> Optional[float]:
         if self.deadline is None:

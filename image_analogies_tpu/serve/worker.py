@@ -35,6 +35,7 @@ import time
 from typing import List, Optional
 
 from image_analogies_tpu import chaos
+from image_analogies_tpu.obs import ledger as obs_ledger
 from image_analogies_tpu.obs import metrics as obs_metrics
 from image_analogies_tpu.obs import recorder as obs_recorder
 from image_analogies_tpu.obs import trace as obs_trace
@@ -149,6 +150,8 @@ class WorkerPool:
                 continue
             if req.requeues < self._cfg.crash_requeues:
                 req.requeues += 1
+                self._decide(req, "requeue", "worker_crash",
+                             requeues=req.requeues)
                 self._queue.requeue(req)
             else:
                 # Requeue budget exhausted: this request deterministically
@@ -156,6 +159,7 @@ class WorkerPool:
                 # RESUBMISSION of the same idempotency key sheds at
                 # admission with Rejected("poison") instead of crashing
                 # the fleet again.
+                self._decide(req, "poison", "crash_requeues_exhausted")
                 if self._journal is not None and req.idem:
                     self._journal.record_poisoned(req.idem)
                 obs_metrics.inc("serve.rejected")
@@ -299,10 +303,52 @@ class WorkerPool:
                 self._emit_request_record(req, resp.status,
                                           batch_size=len(batch),
                                           dispatch_ms=resp.dispatch_ms)
+                self._emit_cost(req, resp, params)
                 if self._journal is not None and req.idem:
                     self._journal.record_done(req.idem, resp)
                 req.future.set_result(resp)
         return True
+
+    def _decide(self, req: Request, verdict: str, cause: str,
+                **extra) -> None:
+        """One control-plane verdict on this request's fate: counter +
+        trace record (obs/ledger funnel) and, when journaled, a sealed
+        ``decision`` line `ia why` replays."""
+        obs_ledger.emit_decision("worker", verdict, cause,
+                                 idem=req.idem, request=req.request_id,
+                                 **extra)
+        if self._journal is not None and req.idem:
+            self._journal.record_decision(req.idem, "worker", verdict,
+                                          cause, **extra)
+
+    def _emit_cost(self, req: Request, resp: Response, params, *,
+                   retries: int = 0) -> None:
+        """Assemble this request's cost vector at dispatch completion.
+        Fast-exits before building anything when both sinks (ledger
+        plane, journal) are off — the disarmed path allocates nothing."""
+        if not obs_ledger.armed() and self._journal is None:
+            return
+        degraded = resp.degraded or {}
+        vec = {
+            "tenant": str(req.key[-1]) if req.key else None,
+            "trace": (req.trace or {}).get("trace"),
+            "rid": resp.request_id,
+            "status": resp.status,
+            "queue_ms": round(resp.queue_ms, 3),
+            "dispatch_ms": round(resp.dispatch_ms, 3),
+            "total_ms": round(resp.total_ms, 3),
+            "lanes": resp.batch_size,
+            "degrade_levels": degraded.get("levels"),
+            "retries": retries,
+            "requeues": req.requeues,
+            "ann": bool(getattr(params, "ann_prefilter", False)),
+            "catalog": bool(getattr(params, "catalog_dir", None)),
+            "wire_bytes": req.wire_bytes,
+        }
+        obs_ledger.record(vec)
+        obs_trace.emit_record({"event": "serve_cost", **vec})
+        if self._journal is not None and req.idem:
+            self._journal.record_cost(req.idem, vec)
 
     def _emit_request_record(self, req: Request, status: str, *,
                              batch_size: int, dispatch_ms: float = 0.0,
@@ -362,6 +408,7 @@ class WorkerPool:
             obs_metrics.inc("serve.timeouts")
             self._record_slo(req, False)
             self._emit_request_record(req, "timeout", batch_size=batch_size)
+            self._decide(req, "timeout", "deadline_expired")
             self._journal_rejected(req, "deadline")
             req.future.set_exception(
                 DeadlineExceeded(req.request_id, -(req.remaining() or 0.0)))
@@ -373,12 +420,17 @@ class WorkerPool:
             obs_trace.emit_record({"event": "serve_degrade_decision",
                                    "request": req.request_id,
                                    "degraded": degraded})
+            self._decide(req, "degrade",
+                         "best_effort" if degraded.get("best_effort")
+                         else "ewma_over_budget",
+                         levels=degraded.get("levels"))
 
         if not self.breaker.allow():
             # circuit open: fail fast, no dispatch, no retry burn
             obs_metrics.inc("serve.rejected")
             self._record_slo(req, False)
             self._emit_request_record(req, "rejected", batch_size=batch_size)
+            self._decide(req, "shed", "breaker_open")
             self._journal_rejected(req, "circuit_open")
             req.future.set_exception(Rejected("circuit_open"))
             return backend
@@ -399,14 +451,22 @@ class WorkerPool:
             self._journal.record_dispatched(req.idem)
 
         t0 = time.monotonic()
+        # Per-request attempt count for the cost vector: run_with_retry
+        # absorbs transient faults invisibly, so the closure is the only
+        # honest witness of how many engine calls this request burned.
+        attempts = {"n": 0}
+
+        def _invoke():
+            attempts["n"] += 1
+            return create_image_analogy(req.a, req.ap, req.b, params,
+                                        backend=dispatch_backend)
+
         try:
             with obs_trace.span("serve_dispatch", request=req.request_id,
                                 batch_size=batch_size,
                                 degraded=bool(degraded)):
                 result = failure.run_with_retry(
-                    lambda: create_image_analogy(
-                        req.a, req.ap, req.b, params,
-                        backend=dispatch_backend),
+                    _invoke,
                     retries=self._cfg.request_retries,
                     context={"scope": "serve", "request": req.request_id},
                     log_path=self._cfg.params.log_path,
@@ -450,6 +510,8 @@ class WorkerPool:
         self._emit_request_record(req, resp.status, batch_size=batch_size,
                                   dispatch_ms=resp.dispatch_ms,
                                   degraded=degraded)
+        self._emit_cost(req, resp, params,
+                        retries=max(attempts["n"] - 1, 0))
         # WAL transition: done is appended (response spilled + digest
         # sealed) BEFORE the future resolves.  If the process dies between
         # the two, the client never saw the answer and replay serves the

@@ -13,7 +13,8 @@ One fleet slot as a real OS process (spawned by
   readiness means "answering", not "forked".
 - Serves the standard surface: ``GET /healthz`` (liveness + readiness),
   ``GET /metrics`` (Prometheus) and ``/metrics.json`` (the registry
-  snapshot the fleet federates), ``POST /v1/analogy`` (IAF2 or JSON,
+  snapshot the fleet federates), ``GET /tenants`` (the per-style cost
+  document the fleet merges), ``POST /v1/analogy`` (IAF2 or JSON,
   ``X-IA-Trace`` adopted per hop).
 - SIGTERM drains and exits 0 (graceful replace); SIGKILL is the death
   the fleet drills — journal lock left on disk, swept by the
@@ -70,7 +71,7 @@ def main(argv: Optional[list] = None) -> int:
 
         handler = serve_http._make_handler_from(
             server.health, server.submit, server.refresh_gauges,
-            snapshot_fn=_snapshot)
+            snapshot_fn=_snapshot, tenants_fn=server.tenants_doc)
         httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         bound_port = httpd.server_address[1]
 

@@ -1,0 +1,611 @@
+"""Tenant-scoped metering & decision attribution (ISSUE 16): per-style
+cost ledger, fixed-memory heavy hitters, and `ia why` request forensics.
+
+Tier-1 invariants locked here:
+
+- the space-saving sketch is provably fixed-memory: under a 10k-style
+  synthetic load it tracks at most K keys, guarantees every key with
+  true frequency > N/K a slot, and every reported count is an honest
+  interval ``[count - error, count]``;
+- sketches and tenant documents MERGE (the PR 11 federation path):
+  shared keys sum, foreign keys enter at the local floor, the union
+  re-trims to K, and latency histograms fold via from_summary;
+- the DISARMED ledger plane allocates nothing (tracemalloc, same
+  contract as obs/timeline.py) and arm() nests across owners;
+- arming mirrors tracked tenants into ``tenant:<sha1[:8]>``-labeled
+  timeline series via the feeder registry;
+- `ia why <idem>` replays journal + decision evidence into one ordered
+  causal chain — locked on a live journaled server AND across a real
+  degrade + SIGKILL handoff + spill drill on the subprocess fleet,
+  cross-checked against the journals' raw history and the router's
+  counters;
+- `ia top --tenants --once` renders the per-style view from a live
+  ``/tenants`` endpoint and exits 0 (2 when unreachable);
+- `ia bench --check` gates ledger_overhead_pct in absolute points
+  (legacy archives record-only);
+- the loadgen's ``--zipf`` mode draws a deterministic, skewed per-style
+  load whose same-style requests share exemplars (one tenant key);
+- obs/ledger.py and obs/tenants.py never import jax (grep lock).
+"""
+
+import gc
+import json
+import os
+import re
+import signal
+import threading
+import time
+import tracemalloc
+import urllib.request
+
+import numpy as np
+import pytest
+
+from image_analogies_tpu.chaos import drills, inject
+from image_analogies_tpu.obs import ledger as obs_ledger
+from image_analogies_tpu.obs import metrics as obs_metrics
+from image_analogies_tpu.obs import tenants as obs_tenants
+from image_analogies_tpu.obs import timeline as obs_timeline
+from image_analogies_tpu.obs import trace as obs_trace
+from image_analogies_tpu.serve import journal as serve_journal
+from image_analogies_tpu.serve import loadgen
+from image_analogies_tpu.serve.server import Server
+from tests.conftest import make_pair
+
+
+@pytest.fixture(autouse=True)
+def _clean_planes():
+    yield
+    inject.disarm()
+    while obs_ledger.armed():
+        obs_ledger.disarm()
+
+
+# ------------------------------------------------ space-saving sketch
+
+
+def test_sketch_fixed_memory_under_10k_styles():
+    """Acceptance: K slots, 10k+ distinct styles — memory stays O(K),
+    every >N/K heavy hitter is tracked, and each reported count is an
+    honest interval around the true frequency."""
+    k = 16
+    ss = obs_tenants.SpaceSaving(k)
+    truth = {}
+    stream = [f"hh{i % 4}" for i in range(4000)]
+    stream += [f"tail{i}" for i in range(10000)]
+    rng = np.random.RandomState(0)
+    rng.shuffle(stream)
+    for key in stream:
+        truth[key] = truth.get(key, 0) + 1
+        ss.offer(key)
+    assert len(ss) <= k
+    assert ss.offered == len(stream)
+    items = ss.items()
+    # the guarantee: true frequency > N/K (= 875) cannot be evicted
+    tracked = {key for key, _, _ in items}
+    assert {"hh0", "hh1", "hh2", "hh3"} <= tracked
+    for key, count, err in items:
+        assert count - err <= truth[key] <= count
+    # sorted by count desc: the heavy hitters lead
+    assert all(key.startswith("hh") for key, _, _ in items[:4])
+
+
+def test_sketch_merge_is_honest_and_bounded():
+    a, b = obs_tenants.SpaceSaving(4), obs_tenants.SpaceSaving(4)
+    for _ in range(10):
+        a.offer("x")
+    for _ in range(3):
+        a.offer("y")
+    for _ in range(7):
+        b.offer("x")
+    for _ in range(5):
+        b.offer("z")
+    a.merge(b)
+    assert len(a) <= 4
+    assert a.offered == 25
+    counts = {key: (c, e) for key, c, e in a.items()}
+    # shared key: exact sum (both sides tracked it exactly)
+    assert counts["x"] == (17.0, 0.0)
+    assert counts["z"][0] >= 5.0  # foreign key enters >= its remote count
+
+
+def test_tenant_tracker_is_bounded_and_aggregates():
+    t = obs_tenants.TenantTracker(k=8)
+    for i in range(10000):
+        t.observe(f"style{i}", latency_ms=1.0)
+    for _ in range(500):
+        t.observe("viral", latency_ms=20.0, dispatch_ms=5.0,
+                  degraded=True, retries=1, wire_bytes=100, lanes=2)
+    doc = t.snapshot()
+    assert doc["tracked"] <= 8 and len(t._stats) <= 8
+    assert doc["offered"] == 10500
+    top = doc["tenants"][0]
+    assert top["tenant"] == "viral"
+    assert top["requests"] == 500 and top["degraded"] == 500
+    assert top["retries"] == 500 and top["wire_bytes"] == 50000
+    assert top["cost_share"] == pytest.approx(1.0, abs=0.01)
+    assert top["p95_ms"] == pytest.approx(20.0, rel=0.2)
+
+
+def test_merge_docs_federates_worker_snapshots():
+    t1, t2 = obs_tenants.TenantTracker(k=4), obs_tenants.TenantTracker(k=4)
+    for _ in range(6):
+        t1.observe("shared", latency_ms=10.0, dispatch_ms=2.0)
+    for _ in range(4):
+        t2.observe("shared", latency_ms=100.0, dispatch_ms=1.0)
+    t2.observe("only2", latency_ms=5.0, dispatch_ms=7.0)
+    merged = obs_tenants.merge_docs([t1.snapshot(), t2.snapshot()])
+    assert merged["offered"] == 11 and merged["tracked"] == 2
+    rows = {r["tenant"]: r for r in merged["tenants"]}
+    assert rows["shared"]["requests"] == 10
+    assert rows["shared"]["count"] == 10
+    assert rows["shared"]["dispatch_ms"] == pytest.approx(16.0)
+    # histograms fold via from_summary: p95 reflects BOTH sides' samples
+    assert rows["shared"]["p95_ms"] >= 90.0
+    total = sum(r["cost_share"] for r in merged["tenants"])
+    assert total == pytest.approx(1.0, abs=0.01)
+    # the obs/fleet re-export is the same function
+    from image_analogies_tpu.obs import fleet as obs_fleet
+
+    again = obs_fleet.merge_tenant_docs([t1.snapshot(), t2.snapshot()])
+    assert again["offered"] == merged["offered"]
+
+
+# ------------------------------------------------ module plane
+
+
+def test_ledger_arm_record_disarm_roundtrip():
+    led = obs_ledger.arm(capacity=4, tenant_k=4)
+    try:
+        for i in range(6):
+            obs_ledger.record({"tenant": f"t{i % 2}", "status": "ok",
+                               "total_ms": 10.0, "queue_ms": 1.0,
+                               "dispatch_ms": 4.0, "lanes": 1,
+                               "wire_bytes": 64})
+        assert obs_ledger.current() is led
+        assert len(led.recent()) == 4  # capacity bound holds
+        doc = obs_ledger.tenants_doc()
+        assert doc["armed"] is True and doc["recorded"] == 6
+        rows = {r["tenant"]: r for r in doc["tenants"]}
+        assert rows["t0"]["requests"] == 3 and rows["t1"]["requests"] == 3
+        assert all("qps" in r for r in doc["tenants"])
+        # nested arm joins the same ledger; inner disarm keeps it
+        assert obs_ledger.arm() is led
+        obs_ledger.disarm()
+        assert obs_ledger.current() is led
+    finally:
+        obs_ledger.disarm()
+    assert obs_ledger.current() is None
+    assert obs_ledger.tenants_doc() == {
+        "armed": False, "k": 0, "tracked": 0, "offered": 0,
+        "recorded": 0, "tenants": []}
+
+
+def test_disarmed_ledger_plane_allocates_nothing():
+    """Acceptance: disarmed, the producer path is one module-bool read —
+    no steady-state allocations attributable to obs/ (same tracemalloc
+    lock as obs/timeline.py's)."""
+    assert obs_ledger.current() is None
+    vec = {"tenant": "abc", "status": "ok", "total_ms": 1.0}
+    gc.collect()
+    gc.disable()
+    tracemalloc.start()
+    try:
+        for _ in range(2000):
+            obs_ledger.record(vec)
+            obs_ledger.sample_timeline()
+        taken = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+        gc.enable()
+    obs_allocs = [t for t in taken.traces
+                  if any("image_analogies_tpu/obs/" in fr.filename
+                         for fr in t.traceback)]
+    assert len(obs_allocs) <= 8
+    assert sum(t.size for t in obs_allocs) <= 1024
+
+
+def test_armed_ledger_feeds_tenant_labeled_timeline_series():
+    """Arming registers the feeder; sample_timeline mirrors tracked
+    tenants into ``tenant:<sha1[:8]>``-labeled series the cockpit and
+    per-worker anomaly detector already understand."""
+    tl = obs_timeline.arm()
+    led = obs_ledger.arm(tenant_k=4)
+    try:
+        assert obs_ledger.sample_timeline in obs_timeline._FEEDERS
+        for _ in range(3):
+            led.record({"tenant": "cafe0123deadbeef", "status": "ok",
+                        "total_ms": 12.0, "queue_ms": 1.0,
+                        "dispatch_ms": 5.0, "lanes": 1})
+        obs_ledger.sample_timeline()
+        pts = tl.range("tenant:cafe0123:serve.completed")
+        assert pts and pts[-1][1] == 3.0
+        hpts = tl.range("tenant:cafe0123:serve.latency_ms")
+        assert hpts and hpts[-1][1]["count"] == 3
+    finally:
+        obs_ledger.disarm()
+        obs_timeline.disarm()
+    assert obs_ledger.sample_timeline not in obs_timeline._FEEDERS
+
+
+def test_emit_decision_counts_and_traces():
+    scope = obs_metrics.ObsScope(scope_id="dec")
+    with obs_metrics.scope_active(scope):
+        obs_ledger.emit_decision("worker", "degrade", "ewma_over_budget",
+                                 idem="k1", levels=2)
+        snap = scope.registry.snapshot()
+    assert snap["counters"].get("serve.decision.degrade") == 1
+
+
+# ------------------------------------------------ ia why (live server)
+
+
+def test_ia_why_reconstructs_journaled_server_chain(tmp_path, capsys):
+    """Acceptance: a degrade-planned request on a journaled server leaves
+    admit/decision/cost/done evidence that `ia why` replays into one
+    ordered chain, exit 0; a missing key exits 2."""
+    from image_analogies_tpu.cli import main
+
+    jdir = str(tmp_path / "j")
+    cfg = drills.serve_config(workers=1, journal_dir=jdir)
+    a, ap, b = make_pair(12, 12, seed=9)
+    with obs_trace.run_scope(cfg.params):
+        with Server(cfg) as srv:
+            # Pessimistic observation (1000 s/unit): even blended into a
+            # store-seeded prior the full-fidelity estimate dwarfs the
+            # 30s deadline, so the degrade verdict fires deterministically.
+            srv.cost_model.observe(1.0, 1000.0)
+            resp = srv.submit(a, ap, b, deadline_s=30.0,
+                              idempotency_key="why-key").result(timeout=180)
+    assert resp.status == "degraded"
+
+    doc = serve_journal.reconstruct("why-key", jdir)
+    assert doc["found"]
+    ops = [e["op"] for e in doc["events"]]
+    assert ops[0] == "admitted" and ops[-1] == "done"
+    assert "cost" in ops and "decision" in ops
+    # the cost vector carries the tenant key (= batcher exemplar digest)
+    assert doc["tenant"] and len(doc["tenant"]) == 12
+    chain = " ".join(doc["chain"])
+    assert "degrade" in chain
+
+    rc = main(["why", "why-key", "--root", jdir])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for token in ("ia why why-key", "admitted", "degrade", "done",
+                  "chain:"):
+        assert token in out
+
+    rc = main(["why", "missing-key", "--root", jdir])
+    captured = capsys.readouterr()
+    assert rc == 2 and "no journal" in captured.out
+
+    rc = main(["why", "why-key", "--root", jdir, "--json"])
+    jdoc = json.loads(capsys.readouterr().out)
+    assert rc == 0 and jdoc["found"] and jdoc["chain"]
+
+
+# ------------------------------------------------ ia why (forensics drill)
+
+
+def test_ia_why_forensics_degrade_spill_sigkill(tmp_path, monkeypatch,
+                                                capsys):
+    """Acceptance tentpole: one request drilled through a degrade
+    verdict, a REAL SIGKILL journal handoff, and a spill to the ring
+    successor — `ia why` reconstructs the complete ordered chain across
+    both worker journals plus the router's decision log, reconciled
+    against the journals' raw history and the router's counters."""
+    from image_analogies_tpu.chaos.plan import ChaosPlan, SiteRule
+    from image_analogies_tpu.cli import main
+    from image_analogies_tpu.serve.fleet import Fleet
+    from image_analogies_tpu.serve.types import FleetConfig
+    from image_analogies_tpu.tune import store as tune_store
+
+    # A pessimistic cost prior in the tune store (inherited via the env
+    # by every spawned child) makes the deadline request degrade
+    # DETERMINISTICALLY inside the subprocess worker.
+    store = str(tmp_path / "tune.json")
+    monkeypatch.setenv("IA_TUNE_STORE", store)
+    tune_store.save_entries(
+        {"serve_cost|cpu|any": {"cost_rate": 1.0}}, store)
+    tune_store.invalidate_cache()
+
+    n = 3
+    root = str(tmp_path / "journals")
+    fcfg = FleetConfig(
+        serve=drills.serve_config(workers=1, max_batch=n,
+                                  batch_window_ms=2000.0),
+        size=2, vnodes=16, journal_root=root, transport="subprocess",
+        health_interval_s=0.1, death_checks=2,
+        backoff_s=0.01, backoff_cap_s=0.05)
+    load = drills.make_serve_load(n, seed=11)
+    ikey = "why-fleet-{}".format
+    # router.forward visits 0..n-1 are the original submits; the FIRST
+    # post-handoff resubmit (visit n) eats a transient hop fault and
+    # must spill to the ring successor (same geometry as the
+    # fleet_death_subprocess drill).
+    plan = ChaosPlan(seed=0, name="why-forensics", sites=(
+        ("router.forward", SiteRule(kind="transient", schedule=(n,))),))
+
+    with obs_trace.run_scope(fcfg.serve.params) as ctx:
+        inject.arm(plan)
+        try:
+            with Fleet(fcfg) as fl:
+                # wave 1: the probe request, deadlined so the child's
+                # seeded cost model degrades it; journaled done.
+                item0 = load[0]
+                futures = {0: fl.submit(item0["a"], item0["ap"],
+                                        item0["b"], deadline_s=120.0,
+                                        idempotency_key=ikey(0))}
+                probe = futures[0].result(timeout=180)
+                assert probe.status == "degraded"
+
+                def _journal(wid):
+                    w = fl.health()["workers"].get(wid, {})
+                    return w.get("journal") or {}
+
+                home = next(wid for wid in fl.workers
+                            if _journal(wid).get("done", 0) >= 1)
+                victim_pid = fl.workers[home].pid
+
+                # wave 2: coalescing in the home child's batch window
+                for i, item in enumerate(load[1:], start=1):
+                    futures[i] = fl.submit(item["a"], item["ap"],
+                                           item["b"],
+                                           idempotency_key=ikey(i))
+                end = time.monotonic() + 60.0
+                while (_journal(home).get("admitted", 0) < n
+                       and time.monotonic() < end):
+                    time.sleep(0.02)
+                assert _journal(home).get("admitted", 0) >= n
+
+                os.kill(victim_pid, signal.SIGKILL)
+                end = time.monotonic() + 120.0
+                while not fl.handoffs and time.monotonic() < end:
+                    time.sleep(0.02)
+                assert fl.handoffs, "no journal handoff happened"
+                for fut in futures.values():
+                    fut.result(timeout=180)
+
+                # resubmit under the original keys: the probe's forward
+                # is visit n -> transient -> spill to the successor,
+                # which computes fresh (and degrades again: the prior
+                # rides the env into every child)
+                replies = {}
+                for i, item in enumerate(load):
+                    replies[i] = fl.submit(
+                        item["a"], item["ap"], item["b"],
+                        deadline_s=120.0 if i == 0 else None,
+                        idempotency_key=ikey(i)).result(timeout=180)
+                assert replies[0].status == "degraded"
+                successor = next(w for w in fl.workers if w != home)
+        finally:
+            inject.disarm()
+        counters = dict(ctx.registry.snapshot()["counters"])
+
+    # --- the causal chain, merged across both journals + decision log
+    doc = serve_journal.reconstruct(ikey(0), root)
+    assert doc["found"]
+    assert set(doc["workers"]) == {home, successor}
+    assert doc["tenant"] and len(doc["tenant"]) == 12
+    chain = doc["chain"]
+    # ordered: home's full lifecycle, THEN the spill verdict, THEN the
+    # successor's fresh lifecycle
+    i_done = chain.index("done")
+    i_spill = next(i for i, s in enumerate(chain) if s.startswith("spill"))
+    second_admit = [i for i, s in enumerate(chain)
+                    if s.startswith("admitted")][1]
+    assert i_done < i_spill < second_admit
+    assert chain[-1] == "done"
+    assert sum(1 for s in chain if s.startswith("degrade")) == 2
+    assert sum(1 for s in chain if s == "done") == 2
+
+    # --- reconciled against journal ground truth: per-worker event
+    # slices must equal each journal's raw history, op for op
+    for wid in (home, successor):
+        hist = serve_journal.RequestJournal(
+            os.path.join(root, wid)).history(ikey(0))
+        assert [e["op"] for e in doc["events"] if e["worker"] == wid] \
+            == [r["op"] for r in hist]
+
+    # --- reconciled against the router's counters
+    assert counters.get("router.spills") == 1
+    assert counters.get("router.deaths") == 1
+    assert counters.get("router.handoffs") == 1
+    assert counters.get("serve.decision.spill") == 1
+    assert counters.get("serve.decision.death") == 1
+    assert counters.get("serve.decision.handoff") == 1
+    spills_in_chain = sum(1 for s in chain if s.startswith("spill"))
+    assert spills_in_chain == counters["router.spills"]
+
+    # fleet-scope verdicts (death, handoff) carry no idem: they feed
+    # counters and `ia report`, never another request's chain
+    dl = serve_journal.DecisionLog(
+        os.path.join(root, serve_journal.DecisionLog.NAME))
+    verdicts = {}
+    for rec in dl.read():
+        verdicts.setdefault(rec["verdict"], []).append(rec)
+    assert "death" in verdicts and "handoff" in verdicts
+    assert all(r.get("idem") is None
+               for v in ("death", "handoff") for r in verdicts[v])
+    assert verdicts["spill"][0]["idem"] == ikey(0)
+
+    # --- the CLI renders it
+    rc = main(["why", ikey(0), "--root", root])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for token in ("degrade", "spill", "admitted", "done", "chain:",
+                  home, successor):
+        assert token in out
+
+
+# ------------------------------------------------ ia top --tenants
+
+
+def test_ia_top_tenants_once_renders_live_view(capsys):
+    """Satellite: `ia top --tenants --once` fetches a live server's
+    /tenants and renders the per-style table, exit 0."""
+    from image_analogies_tpu.cli import main
+    from image_analogies_tpu.serve.http import serve_http
+
+    a, ap, b = make_pair(10, 10, seed=42)
+    with Server(drills.serve_config(workers=1)) as srv:
+        assert srv.request(a, ap, b, timeout=120).status == "ok"
+        httpd = serve_http(srv, 0)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            base = f"http://127.0.0.1:{httpd.server_address[1]}"
+            with urllib.request.urlopen(base + "/tenants",
+                                        timeout=5) as resp:
+                doc = json.loads(resp.read().decode())
+            rc = main(["top", "--tenants", "--once", "--url", base])
+        finally:
+            httpd.shutdown()
+    assert doc["armed"] is True and doc["tenants"]
+    tenant = doc["tenants"][0]["tenant"]
+    out = capsys.readouterr().out
+    assert rc == 0
+    for col in ("TENANT", "REQS", "QPS", "P95MS", "COST%", "DEGR"):
+        assert col in out
+    assert tenant[:12] in out
+
+
+def test_ia_top_tenants_unreachable_exits_2(capsys):
+    from image_analogies_tpu.cli import main
+
+    rc = main(["top", "--tenants", "--once",
+               "--url", "http://127.0.0.1:1"])
+    captured = capsys.readouterr()
+    assert rc == 2
+    assert "cannot fetch" in captured.err
+
+
+# ------------------------------------------------ bench rider
+
+
+def test_bench_check_gates_ledger_overhead():
+    """Satellite: ledger_overhead_pct rides the bench trajectory with
+    the same absolute-points gate as the timeline rider; legacy
+    archives record-only."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "ia_bench_ledger_test", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    doc = {"parsed": {"value": 7.5, "metric": "1024x1024 north star",
+                      "ledger_overhead_pct": 1.5}}
+    assert bench.extract_headline(doc)["ledger_overhead_pct"] == 1.5
+
+    trajectory = {"points": [
+        {"value": 7.0, "metric_key": "1024x1024", "round": 1,
+         "file": "BENCH_r01.json", "ledger_overhead_pct": 1.0},
+        {"value": 7.2, "metric_key": "1024x1024", "round": 2,
+         "file": "BENCH_r02.json", "ledger_overhead_pct": 2.0},
+    ], "problems": []}
+    ok = bench.check_regression(trajectory, fresh_value=7.1,
+                                fresh_ledger=2.5, threshold_pct=20.0)
+    assert ok["ok"] and ok["ledger_overhead_pct"] == 2.5
+    assert ok["ledger_overhead_floor"] == 1.0
+    assert ok["ledger_overhead_delta_pts"] == 1.5
+    bad = bench.check_regression(trajectory, fresh_value=7.1,
+                                 fresh_ledger=30.0, threshold_pct=20.0)
+    assert not bad["ok"]
+    assert any("ledger_overhead_pct" in p for p in bad["problems"])
+    # archive self-check reads the latest point's own overhead
+    latest = bench.check_regression(trajectory, threshold_pct=20.0)
+    assert latest["ledger_overhead_pct"] == 2.0
+    assert latest["ledger_overhead_floor"] == 1.0
+    # legacy archive (no ledger points): record-only, never a gate
+    legacy = {"points": [
+        {"value": 7.0, "metric_key": "1024x1024", "round": 1,
+         "file": "BENCH_r01.json"}], "problems": []}
+    rec = bench.check_regression(legacy, fresh_value=7.1,
+                                 fresh_ledger=99.0, threshold_pct=20.0)
+    assert rec["ok"] and rec["ledger_overhead_pct"] == 99.0
+    assert rec["ledger_overhead_floor"] is None
+
+
+def test_cli_bench_check_ledger_rider(tmp_path, capsys):
+    from image_analogies_tpu.cli import main
+
+    with open(tmp_path / "BENCH_r01.json", "w") as f:
+        json.dump({"parsed": {"value": 7.0,
+                              "metric": "1024x1024 north star",
+                              "ledger_overhead_pct": 1.0}}, f)
+    res = tmp_path / "result.json"
+    with open(res, "w") as f:
+        json.dump({"value": 7.1, "metric": "1024x1024 north star",
+                   "ledger_overhead_pct": 2.5}, f)
+    rc = main(["bench", "--check", "--result", str(res),
+               "--dir", str(tmp_path)])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["ledger_overhead_pct"] == 2.5
+    assert out["ledger_overhead_floor"] == 1.0
+
+
+# ------------------------------------------------ zipf loadgen
+
+
+def test_zipf_load_is_deterministic_and_skewed():
+    shapes = [(12, 12)]
+    l1 = loadgen.make_load(40, shapes, seed=3, zipf=1.2, styles=6)
+    l2 = loadgen.make_load(40, shapes, seed=3, zipf=1.2, styles=6)
+    h1, h2 = loadgen.style_hist(l1), loadgen.style_hist(l2)
+    assert h1 == h2 and sum(h1.values()) == 40
+    assert len(h1) <= 6
+    # Zipf skew: the rank-1 style dominates
+    assert h1["s0"] == max(h1.values())
+    assert h1["s0"] > 40 // 6
+    # same-style requests share exemplars — ONE tenant key per style
+    by_style = {}
+    for item in l1:
+        by_style.setdefault(item["style"], []).append(item)
+    for items in by_style.values():
+        for item in items[1:]:
+            np.testing.assert_array_equal(item["a"], items[0]["a"])
+            np.testing.assert_array_equal(item["ap"], items[0]["ap"])
+    # distinct styles use distinct exemplars
+    s_keys = sorted(by_style)
+    if len(s_keys) >= 2:
+        assert not np.array_equal(by_style[s_keys[0]][0]["a"],
+                                  by_style[s_keys[1]][0]["a"])
+    # classic loads have no style histogram
+    assert loadgen.style_hist(
+        loadgen.make_load(4, shapes, seed=3)) is None
+
+
+def test_zipf_selftest_summary_carries_style_hist():
+    cfg = drills.serve_config(workers=1)
+    with obs_trace.run_scope(cfg.params):
+        summary = loadgen.selftest(cfg, 4, seed=5, zipf=1.1, styles=3)
+    assert summary["errors"] == 0
+    assert summary["zipf"] == 1.1
+    hist = summary["style_hist"]
+    assert hist and sum(hist.values()) == 4
+    text = loadgen.render(summary)
+    assert "zipf S=1.1" in text
+
+
+# ------------------------------------------------ grep locks
+
+
+def test_ledger_and_tenants_modules_are_jax_free():
+    """Satellite lock: the metering plane is host-side bookkeeping on
+    the request path — no module-scope jax import, no jit/pjit calls."""
+    import image_analogies_tpu.obs as obs_pkg
+
+    root = os.path.dirname(obs_pkg.__file__)
+    forbidden = re.compile(r"\bjax\.jit\s*\(|\bpjit\s*\(|\bjax\.pmap\s*\(")
+    toplevel_jax = re.compile(r"^(import jax|from jax)", re.MULTILINE)
+    for name in ("ledger.py", "tenants.py"):
+        with open(os.path.join(root, name)) as f:
+            src = f.read()
+        assert not forbidden.findall(src), f"obs/{name} calls jit/pjit"
+        assert not toplevel_jax.findall(src), (
+            f"obs/{name} imports jax at module scope")
