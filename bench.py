@@ -138,6 +138,17 @@ def extract_headline(doc: dict):
         if obj.get("ledger_overhead_pct") is not None:
             out["ledger_overhead_pct"] = float(
                 obj["ledger_overhead_pct"])
+        # archive trajectory (PR 17): armed durable telemetry archive
+        # (sealed append-only segments) vs the bare armed timeline at
+        # 256^2 — the flight recorder only stays always-on if this
+        # stays small; sketch_p999_rel_err rides ungated (the sketch
+        # selftest raises on dishonesty before a number is printed)
+        if obj.get("archive_overhead_pct") is not None:
+            out["archive_overhead_pct"] = float(
+                obj["archive_overhead_pct"])
+        if obj.get("sketch_p999_rel_err") is not None:
+            out["sketch_p999_rel_err"] = float(
+                obj["sketch_p999_rel_err"])
         return out
 
     parsed = doc.get("parsed")
@@ -194,7 +205,8 @@ def check_regression(trajectory: dict, fresh_value=None,
                      fresh_gap=None, fresh_key=None,
                      fresh_obs=None, fresh_cold=None,
                      fresh_scale=None, fresh_timeline=None,
-                     fresh_handoff=None, fresh_ledger=None) -> dict:
+                     fresh_handoff=None, fresh_ledger=None,
+                     fresh_archive=None) -> dict:
     """Gate a wall-clock number against the trajectory floor.
 
     With ``fresh_value`` (a just-measured number), it is compared against
@@ -263,6 +275,13 @@ def check_regression(trajectory: dict, fresh_value=None,
     as ``timeline_overhead_pct``; archives from rounds before the
     ledger existed carry no floor, so the first point records without
     gating.
+
+    ``archive_overhead_pct`` (armed durable telemetry archive — sealed
+    append-only segments fed by the timeline sampler — vs the bare
+    armed timeline at 256^2, PR 17) rides via ``fresh_archive`` with
+    the same ABSOLUTE percentage-points gate; archives from rounds
+    before the flight recorder existed carry no floor, so the first
+    point records without gating.
     """
     points = trajectory.get("points") or []
     problems = list(trajectory.get("problems", []))
@@ -291,6 +310,7 @@ def check_regression(trajectory: dict, fresh_value=None,
         cand_timeline = fresh_timeline
         cand_handoff = fresh_handoff
         cand_ledger = fresh_ledger
+        cand_archive = fresh_archive
         prior = same
         floor = min(p["value"] for p in same)
     else:
@@ -305,6 +325,7 @@ def check_regression(trajectory: dict, fresh_value=None,
         cand_timeline = latest.get("timeline_overhead_pct")
         cand_handoff = latest.get("handoff_recovery_ms")
         cand_ledger = latest.get("ledger_overhead_pct")
+        cand_archive = latest.get("archive_overhead_pct")
         prior = same[:-1]
         if not prior:
             return {"ok": True, "reason": "single_point",
@@ -461,6 +482,26 @@ def check_regression(trajectory: dict, fresh_value=None,
         # the point without gating, same posture as timeline_overhead
         out["ledger_overhead_pct"] = float(cand_ledger)
         out["ledger_overhead_floor"] = None
+    prior_archives = [p["archive_overhead_pct"] for p in prior
+                      if p.get("archive_overhead_pct") is not None]
+    if cand_archive is not None and prior_archives:
+        av_floor = min(prior_archives)
+        # already a percentage — absolute points, like the timeline gate
+        av_delta = float(cand_archive) - av_floor
+        out["archive_overhead_pct"] = float(cand_archive)
+        out["archive_overhead_floor"] = av_floor
+        out["archive_overhead_delta_pts"] = round(av_delta, 2)
+        if av_delta > threshold_pct:
+            out["ok"] = False
+            problems.append(
+                f"archive_overhead_pct grew {av_delta:.1f} points past "
+                f"the {av_floor:.1f}% floor "
+                f"(candidate {cand_archive:.1f}%)")
+    elif cand_archive is not None:
+        # legacy archives (pre-flight-recorder rounds) carry no floor:
+        # record the point without gating, same posture as the others
+        out["archive_overhead_pct"] = float(cand_archive)
+        out["archive_overhead_floor"] = None
     return out
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -667,6 +708,55 @@ def _measure_ledger_overhead(a, ap, b, p, reps=3):
         best[armed] = t_best
     return {
         "ledger_overhead_pct": round(
+            (best[True] - best[False]) / best[False] * 100.0, 2),
+        "armed_s": round(best[True], 3),
+        "disarmed_s": round(best[False], 3),
+        "reps": reps,
+    }
+
+
+def _measure_archive_overhead(a, ap, b, p, reps=3):
+    """Wall-clock cost of the ARMED durable telemetry archive at one
+    256^2 synthesis.  Both arms run an armed timeline with a live
+    background sampler (that cost is already gated by
+    ``timeline_overhead_pct``); this isolates what the archive adds ON
+    TOP: the timeline feeder sealing closed windows, anomaly hints and
+    tenant snapshots into append-only segments mid-synthesis (the
+    sample throttle is zeroed so every sampler tick writes).  Headline
+    ``archive_overhead_pct`` rides the archive and ``ia bench --check``
+    gates it in percentage points (legacy archives carry no floor, so
+    the first point records only)."""
+    import tempfile
+
+    from image_analogies_tpu.models.analogy import create_image_analogy
+    from image_analogies_tpu.obs import archive as obs_archive
+    from image_analogies_tpu.obs import timeline as obs_timeline
+    from image_analogies_tpu.obs import trace as obs_trace
+
+    p_on = p.replace(metrics=True, log_path=None)
+    create_image_analogy(a, ap, b, p_on)  # shared compile warm-up
+    best = {}
+    with tempfile.TemporaryDirectory() as d:
+        for armed in (False, True):
+            t_best = float("inf")
+            for rep in range(reps):
+                tl = obs_timeline.arm()
+                if armed:
+                    obs_archive.arm(root=os.path.join(d, str(rep)),
+                                    sample_interval_s=0.0)
+                tl.start_sampler(interval_s=0.05)
+                try:
+                    t0 = time.perf_counter()
+                    with obs_trace.run_scope(p_on):
+                        create_image_analogy(a, ap, b, p_on)
+                    t_best = min(t_best, time.perf_counter() - t0)
+                finally:
+                    if armed:
+                        obs_archive.disarm()
+                    obs_timeline.disarm()
+            best[armed] = t_best
+    return {
+        "archive_overhead_pct": round(
             (best[True] - best[False]) / best[False] * 100.0, 2),
         "armed_s": round(best[True], 3),
         "disarmed_s": round(best[False], 3),
@@ -1064,6 +1154,20 @@ def main() -> int:
     ledger_overhead = _measure_ledger_overhead(a, ap, b, p)
     configs["ledger_overhead_256"] = ledger_overhead
 
+    # ---- archive overhead (PR 17): armed durable telemetry archive
+    # (sealed append-only segments fed by the timeline sampler) vs the
+    # same armed timeline without it — what the flight recorder costs
+    archive_overhead = _measure_archive_overhead(a, ap, b, p)
+    configs["archive_overhead_256"] = archive_overhead
+
+    # ---- tail-quantile honesty (PR 17): the DDSketch selftest at 10^6
+    # lognormal samples, whole-stream vs split-and-merged; it RAISES if
+    # p99/p999/p9999 drift past the stated relative error, so a bench
+    # that prints a number is itself the proof the sketch is honest
+    from image_analogies_tpu.obs import quantiles as obs_quantiles
+    sketch_honesty = obs_quantiles.selftest(n=1_000_000)
+    configs["sketch_honesty_1e6"] = sketch_honesty
+
     # ---- catalog cold start (PR 12): first-request wall at 256^2 with
     # a warm exemplar catalog vs an empty one, on the CPU path the
     # catalog serves; bit-identity between the two runs gates the number
@@ -1316,6 +1420,9 @@ def main() -> int:
             timeline_overhead["timeline_overhead_pct"],
         "handoff_recovery_ms": handoff["handoff_recovery_ms"],
         "ledger_overhead_pct": ledger_overhead["ledger_overhead_pct"],
+        "archive_overhead_pct":
+            archive_overhead["archive_overhead_pct"],
+        "sketch_p999_rel_err": sketch_honesty["p999_rel_err"],
         "vs_baseline": round(oracle_s / ns_s, 1),
         "ssim_vs_oracle": round(ns_ssim, 4),
         "value_match": round(ns_match, 4),

@@ -55,6 +55,9 @@ _KIND_NOTES = {
                      "disk/rebuild bit-identically",
     "ann_corrupt": "sealed ANN basis damaged mid-request; quarantine + "
                    "exact fallback + rebuild, bit-identically",
+    "archive_torn": "torn sealed archive segment quarantined at read, "
+                    "valid prefix survives; disk-full drops counted, "
+                    "never raised",
 }
 
 # What `selftest` (and the tier-1 parametrization) iterates: every raw
@@ -66,7 +69,7 @@ def _drill_kinds():
     from image_analogies_tpu.chaos import FAULT_KINDS
     return tuple(FAULT_KINDS) + ("fleet_death", "fleet_death_subprocess",
                                  "batch_partial", "devcache_tier",
-                                 "ann_corrupt")
+                                 "ann_corrupt", "archive_torn")
 
 
 DRILL_KINDS = _drill_kinds()
@@ -149,6 +152,15 @@ def plan_for_kind(kind: str, seed: int = 0) -> ChaosPlan:
         # request resolves it, so every level must quarantine, answer on
         # the exact path bit-identically, and re-seal a rebuilt basis.
         sites = (("match.prefilter", SiteRule(kind="corrupt", p=1.0)),)
+    elif kind == "archive_torn":
+        # Archive drill geometry (per-record segments): archive.append
+        # is visited once per sealed record; the corrupt directive at
+        # visit 1 tears record 1's segment AFTER a successful-looking
+        # write — the torn-tail shape a power cut leaves on disk.  The
+        # drill itself arms a second, raising rule at the same site for
+        # the disk-full leg (one site carries one rule per plan).
+        sites = (("archive.append", SiteRule(kind="corrupt",
+                                             schedule=(1,))),)
     elif kind == "batch_partial":
         # Batched-engine drill geometry (k=3 lanes, 2 levels): the
         # engine.batch site is visited once per (level, lane), coarsest
@@ -198,6 +210,7 @@ def _reconcile(plan: ChaosPlan, counters: Dict[str, float]) -> List[str]:
     # regardless of its class — the containment layer can't tell.
     retries = watchdogs = quarantines = crashes = deaths = 0.0
     hop_faults = lane_faults = tier_evictions = ann_faults = 0.0
+    archive_faults = 0.0
     for name, rule in plan.sites:
         n = counters.get(f"chaos.site.{name}", 0)
         if not n:
@@ -216,6 +229,14 @@ def _reconcile(plan: ChaosPlan, counters: Dict[str, float]) -> List[str]:
             # counter — must be matched before the generic corrupt →
             # ckpt.quarantined accounting below
             tier_evictions += n
+        elif name == "archive.append":
+            # the corrupt directive tears the sealed segment AFTER a
+            # successful-looking write (recovery is the READER's
+            # quarantine) and raising kinds model disk-full (recovery
+            # is the counted drop); both are the archive's own
+            # accounting, checked jointly below — must be matched
+            # before the generic corrupt → ckpt.quarantined branch
+            archive_faults += n
         elif name == "match.prefilter":
             # the corrupt directive here damages the sealed ANN artifact
             # — but only when one exists at the resolved key (gate-probe
@@ -261,6 +282,13 @@ def _reconcile(plan: ChaosPlan, counters: Dict[str, float]) -> List[str]:
         want("batch.lane_faults", lane_faults)
     if tier_evictions:
         want("catalog.chaos_evictions", tier_evictions)
+    if archive_faults:
+        accounted = (counters.get("obs.archive.quarantined", 0)
+                     + counters.get("obs.archive.append_errors", 0))
+        if accounted != archive_faults:
+            problems.append(
+                f"archive.append injected {archive_faults} faults but "
+                f"quarantines+drops account for {accounted}")
     if ann_faults:
         quarantined = counters.get("ann.quarantined", 0)
         if not quarantined:
@@ -1121,8 +1149,102 @@ def drill_batch_partial(plan: ChaosPlan, *, k: int = 3, seed: int = 7
     }
 
 
+def drill_archive_torn(plan: ChaosPlan, *, seed: int = 7,
+                       workdir: Optional[str] = None) -> Dict[str, Any]:
+    """Torn-segment + disk-full drill for the durable telemetry archive
+    (obs/archive.py).  Clean reference archive (disarmed) → chaos
+    archive: the plan's corrupt directive tears ONE sealed segment
+    AFTER a successful-looking write (per-record segments, so exactly
+    one record is at stake) → offline replay: the reader must
+    quarantine exactly the torn segment, keep every undamaged record,
+    and reconstruct the same final timeline document as the clean
+    archive.  A second, self-armed plan then models disk-full: a
+    raising rule at the same site must surface as a counted drop
+    (``obs.archive.append_errors``), never as an exception on the
+    producer path — the archive is a witness, not a dependency."""
+    from image_analogies_tpu.obs import archive as obs_archive
+    from image_analogies_tpu.obs import trace as obs_trace
+
+    n_records = 8
+    docs = [{"armed": True, "now": float(i), "idx": i,
+             "series": {"w0|serve.qps": [[float(i), float(i + seed)]]}}
+            for i in range(n_records)]
+
+    problems: List[str] = []
+    with tempfile.TemporaryDirectory(dir=workdir) as tmp:
+        clean = obs_archive.TelemetryArchive(
+            os.path.join(tmp, "clean"), max_segment_bytes=1)
+        for i, doc in enumerate(docs):
+            clean.append("timeline", doc, now=float(i))
+        clean_rep = clean.replay()
+
+        params = drills.image_params(retries=0)
+        with obs_trace.run_scope(params) as ctx:
+            torn = obs_archive.TelemetryArchive(
+                os.path.join(tmp, "torn"), max_segment_bytes=1)
+            with inject.plan_scope(plan):
+                appended = [torn.append("timeline", doc, now=float(i))
+                            for i, doc in enumerate(docs)]
+                snap = inject.snapshot()
+            if not all(appended):
+                problems.append(
+                    "corrupt directive must not drop the write itself")
+            rep = torn.replay()  # the reader quarantines the torn tail
+            full_plan = ChaosPlan(
+                seed=plan.seed,
+                sites=(("archive.append",
+                        SiteRule(kind="transient", schedule=(0,))),),
+                name=f"{plan.name}-diskfull")
+            with inject.plan_scope(full_plan):
+                dropped_ok = torn.append("timeline", docs[-1],
+                                         now=float(n_records))
+                recovered_ok = torn.append("timeline", docs[-1],
+                                           now=float(n_records + 1))
+            counters = _counters(ctx)
+        if dropped_ok:
+            problems.append("disk-full append did not report the drop")
+        if not recovered_ok:
+            problems.append("append after disk-full did not recover")
+        corrupt_files = [n for n in os.listdir(os.path.join(tmp, "torn"))
+                         if n.endswith(".corrupt")]
+
+    torn_total = sum(1 for _, r in plan.sites if r.kind == "corrupt")
+    if len(corrupt_files) != torn_total:
+        problems.append(f"{len(corrupt_files)} quarantined file(s) on "
+                        f"disk, expected {torn_total}")
+    identical = rep["timeline"] == clean_rep["timeline"]
+    if not identical:
+        problems.append("replayed final timeline document differs from "
+                        "the clean archive's")
+    survived = rep["kinds"].get("timeline", 0)
+    if survived != n_records - torn_total:
+        problems.append(f"{survived} records survived replay, expected "
+                        f"{n_records - torn_total} (valid prefix lost?)")
+    problems += _reconcile(plan, counters)
+    injected = sum(st["injected"] for st in snap.values())
+    if injected == 0:
+        problems.append("plan injected nothing (dead drill)")
+    return {
+        "workload": "archive_torn",
+        "plan": plan.to_dict(),
+        "injected": injected,
+        "sites": snap,
+        "outcomes": {"records": n_records, "survived": survived,
+                     "quarantined": len(corrupt_files),
+                     "diskfull_drops":
+                         int(counters.get("obs.archive.append_errors", 0))},
+        "counters": {k: v for k, v in counters.items()
+                     if k.startswith(("chaos.", "obs.archive."))},
+        "identical": identical,
+        "ok": not problems,
+        "problems": problems,
+    }
+
+
 def run_drill(plan: ChaosPlan, **kw) -> Dict[str, Any]:
     """Dispatch a plan to the workload its sites target."""
+    if any(name == "archive.append" for name, _ in plan.sites):
+        return drill_archive_torn(plan, **kw)
     if any(name == "match.prefilter" for name, _ in plan.sites):
         return drill_ann_corrupt(plan, **kw)
     if any(name == "devcache.tier" for name, _ in plan.sites):

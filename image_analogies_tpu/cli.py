@@ -434,6 +434,8 @@ def cmd_serve(args) -> int:
         print("serve: pass --selftest N or --http PORT", file=sys.stderr)
         return 2
 
+    from image_analogies_tpu.obs import archive as obs_archive
+    from image_analogies_tpu.obs import ceilings as obs_ceilings
     from image_analogies_tpu.obs import timeline as obs_timeline
     from image_analogies_tpu.serve.http import serve_http
 
@@ -442,17 +444,26 @@ def cmd_serve(args) -> int:
         # own background sampler (the fleet path samples per worker from
         # its health daemon instead) so /timeline and `ia top` are live
         tl = obs_timeline.arm()
+        # witness + watchdog planes ride the same sampler as feeders
+        archive_root = args.archive or os.environ.get("IA_ARCHIVE_DIR")
+        if archive_root:
+            obs_archive.arm(root=archive_root)
+        obs_ceilings.arm()
         tl.start_sampler(interval_s=1.0)
         httpd = serve_http(srv, args.http)
         print(f"serving on http://127.0.0.1:{args.http} "
               f"(POST /v1/analogy, GET /healthz, GET /metrics, "
-              f"GET /timeline, GET /tenants); Ctrl-C to drain+exit")
+              f"GET /timeline, GET /tenants, GET /archive/stats); "
+              f"Ctrl-C to drain+exit")
         try:
             httpd.serve_forever()
         except KeyboardInterrupt:
             pass
         finally:
             httpd.shutdown()
+            obs_ceilings.disarm()
+            if archive_root:
+                obs_archive.disarm()
             obs_timeline.disarm()
     return 0
 
@@ -818,6 +829,7 @@ def cmd_bench(args) -> int:
     fresh_timeline = None
     fresh_handoff = None
     fresh_ledger = None
+    fresh_archive = None
     fresh_key = args.metric_key
     if args.value is not None:
         fresh = args.value
@@ -845,6 +857,8 @@ def cmd_bench(args) -> int:
                 fresh_handoff = float(doc["handoff_recovery_ms"])
             if doc.get("ledger_overhead_pct") is not None:
                 fresh_ledger = float(doc["ledger_overhead_pct"])
+            if doc.get("archive_overhead_pct") is not None:
+                fresh_archive = float(doc["archive_overhead_pct"])
         else:
             head = bench.extract_headline(doc if isinstance(doc, dict)
                                           else {})
@@ -860,6 +874,7 @@ def cmd_bench(args) -> int:
             fresh_timeline = head.get("timeline_overhead_pct")
             fresh_handoff = head.get("handoff_recovery_ms")
             fresh_ledger = head.get("ledger_overhead_pct")
+            fresh_archive = head.get("archive_overhead_pct")
             if fresh_key is None:
                 fresh_key = head.get("metric_key")
     verdict = bench.check_regression(trajectory, fresh_value=fresh,
@@ -871,7 +886,8 @@ def cmd_bench(args) -> int:
                                      fresh_scale=fresh_scale,
                                      fresh_timeline=fresh_timeline,
                                      fresh_handoff=fresh_handoff,
-                                     fresh_ledger=fresh_ledger)
+                                     fresh_ledger=fresh_ledger,
+                                     fresh_archive=fresh_archive)
     print(json.dumps(verdict, sort_keys=True))
     for problem in verdict.get("problems", []):
         print(f"bench: warning: {problem}", file=sys.stderr)
@@ -892,6 +908,31 @@ def cmd_top(args) -> int:
     import urllib.request
 
     from image_analogies_tpu.obs import timeline as obs_timeline
+
+    if getattr(args, "from_archive", None):
+        # Replay archived history into the cockpit: every sealed
+        # timeline document becomes one frame, no server needed.
+        from image_analogies_tpu.obs import archive as obs_archive
+
+        ar = obs_archive.TelemetryArchive(args.from_archive)
+        frames = ar.history("timeline")
+        if not frames:
+            print(f"top: no archived timeline documents under "
+                  f"{args.from_archive}", file=sys.stderr)
+            return 2
+        if args.once:
+            print(obs_timeline.render_cockpit(frames[-1]))
+            return 0
+        try:
+            for doc in frames:
+                sys.stdout.write(
+                    "\x1b[2J\x1b[H" + obs_timeline.render_cockpit(doc)
+                    + "\n")
+                sys.stdout.flush()
+                _time.sleep(args.interval)
+        except KeyboardInterrupt:
+            pass
+        return 0
 
     if args.tenants:
         from image_analogies_tpu.obs import ledger as obs_ledger
@@ -954,6 +995,85 @@ def cmd_top(args) -> int:
             _time.sleep(args.interval)
     except KeyboardInterrupt:
         return 0
+
+
+def cmd_archive(args) -> int:
+    """Offline reader over a durable telemetry archive (obs/archive.py).
+    ``inspect`` summarizes the sealed store — segments, bytes, witnessed
+    record kinds, quarantined files; ``replay`` reconstructs the final
+    ``/timeline`` + ``/tenants`` documents exactly as the server last
+    published them (the round-trip contract); ``diff`` compares two
+    archives series-by-series — the before/after-an-incident view."""
+    from image_analogies_tpu.obs import archive as obs_archive
+
+    def _open(root):
+        if not os.path.isdir(root):
+            print(f"archive: no such directory {root}", file=sys.stderr)
+            return None
+        return obs_archive.TelemetryArchive(root)
+
+    if args.action == "diff":
+        a = _open(args.a)
+        b = _open(args.b)
+        if a is None or b is None:
+            return 2
+        d = obs_archive.diff_replays(a.replay(), b.replay())
+        if args.json:
+            print(json.dumps(d, indent=2, sort_keys=True))
+        else:
+            print(obs_archive.render_diff(d))
+        return 0
+
+    ar = _open(args.root)
+    if ar is None:
+        return 2
+
+    if args.action == "inspect":
+        info = ar.stats()
+        rep = ar.replay()
+        info["kinds"] = rep["kinds"]
+        info["span"] = rep["span"]
+        if args.json:
+            print(json.dumps(info, indent=2, sort_keys=True))
+            return 0
+        span = rep["span"]
+        dur = (span[1] - span[0]
+               if span[0] is not None and span[1] is not None else 0.0)
+        print(f"archive {args.root}: {info['segments']} segment(s) + "
+              f"{info['summary_segments']} summary, {info['bytes']} bytes"
+              + (f", {info['quarantined']} quarantined"
+                 if info["quarantined"] else ""))
+        kinds = ", ".join(f"{k}={n}"
+                          for k, n in sorted(rep["kinds"].items()))
+        print(f"  span: {dur:.1f}s  kinds: {kinds or '(empty)'}")
+        return 0
+
+    if args.action == "replay":
+        from image_analogies_tpu.obs import ledger as obs_ledger
+        from image_analogies_tpu.obs import timeline as obs_timeline
+
+        rep = ar.replay()
+        if args.json:
+            print(json.dumps(rep, indent=2, sort_keys=True))
+            return 0
+        if rep["timeline"] is None and rep["tenants"] is None:
+            print("archive: no witnessed timeline/tenants documents",
+                  file=sys.stderr)
+            return 2
+        if rep["timeline"] is not None:
+            print(obs_timeline.render_cockpit(rep["timeline"]))
+        if rep["tenants"] is not None:
+            print(obs_ledger.render_tenants(rep["tenants"],
+                                            title="tenants (archived)"))
+        if rep["decisions"]:
+            print(f"decisions witnessed: {len(rep['decisions'])}  latest: "
+                  + json.dumps(rep["decisions"][-1], sort_keys=True))
+        if rep["anomalies"]:
+            print(f"anomalies witnessed: {len(rep['anomalies'])}")
+        return 0
+
+    print(f"archive: unknown action {args.action}", file=sys.stderr)
+    return 2
 
 
 def cmd_trace(args) -> int:
@@ -1062,6 +1182,12 @@ def build_parser() -> argparse.ArgumentParser:
                          "worker cockpit: top-K tenants by request "
                          "count with QPS, p95, cost share, and degrade/"
                          "retry burden (space-saving heavy hitters)")
+    tp.add_argument("--from-archive", default=None, metavar="ROOT",
+                    help="replay a durable telemetry archive instead of "
+                         "scraping a live server: each sealed timeline "
+                         "document renders as one cockpit frame at "
+                         "--interval pace (--once shows only the final "
+                         "frame)")
     tp.set_defaults(fn=cmd_top)
 
     mx = sub.add_parser("metrics",
@@ -1237,6 +1363,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "style dominating) instead of cycling shapes")
     sv.add_argument("--styles", type=int, default=0,
                     help="style count for --zipf (default 8)")
+    sv.add_argument("--archive", default=None, metavar="DIR",
+                    help="durable telemetry archive root: closed timeline "
+                         "windows, tenant cost vectors, decision records "
+                         "and anomaly events stream to sealed append-only "
+                         "segments under DIR (also via IA_ARCHIVE_DIR; "
+                         "inspect offline with `ia archive` / "
+                         "`ia top --from-archive`)")
     sv.add_argument("--seed", type=int, default=0)
     _add_engine_flags(sv)
     sv.set_defaults(fn=cmd_serve)
@@ -1371,6 +1504,43 @@ def build_parser() -> argparse.ArgumentParser:
                     help="also remove quarantined .corrupt files "
                          "(they are evidence; default keeps them)")
     cg.set_defaults(fn=cmd_catalog)
+
+    # archive is pure file io — no engine flags, no distributed gate.
+    av = sub.add_parser("archive",
+                        help="durable telemetry archive tooling: "
+                             "summarize the sealed store (inspect), "
+                             "reconstruct the final cockpit + tenants "
+                             "documents (replay), or compare two "
+                             "archives series-by-series (diff)")
+    av_sub = av.add_subparsers(dest="action", required=True)
+    ai = av_sub.add_parser("inspect",
+                           help="read-only store summary: segments, "
+                                "bytes, witnessed record kinds, "
+                                "quarantined files")
+    ai.add_argument("root", help="archive root directory")
+    ai.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ai.set_defaults(fn=cmd_archive)
+    av_rp = av_sub.add_parser("replay",
+                              help="reconstruct the final /timeline + "
+                                   "/tenants documents from the sealed "
+                                   "segments and render them as the "
+                                   "cockpit would have")
+    av_rp.add_argument("root", help="archive root directory")
+    av_rp.add_argument("--json", action="store_true",
+                       help="full replay document (timeline, tenants, "
+                            "kinds, decisions, anomalies, span) as JSON")
+    av_rp.set_defaults(fn=cmd_archive)
+    ad = av_sub.add_parser("diff",
+                           help="compare two archives' replayed state: "
+                                "per-series deltas (p50/p95/p99/p999, "
+                                "counts), tenants present in only one, "
+                                "witnessed-kind counts")
+    ad.add_argument("a", help="baseline archive root")
+    ad.add_argument("b", help="comparison archive root")
+    ad.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ad.set_defaults(fn=cmd_archive)
 
     jr = sub.add_parser("journal",
                         help="write-ahead request journal tooling: "

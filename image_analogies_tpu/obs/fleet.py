@@ -36,6 +36,7 @@ import re
 from typing import Any, Dict, List, Optional, Tuple
 
 from image_analogies_tpu.obs import live as _live
+from image_analogies_tpu.obs import quantiles as _quantiles
 
 # Gauge families merged by MAX instead of SUM.  Substring match on the
 # dotted registry name: peak watermarks and state-like gauges are
@@ -91,12 +92,22 @@ def merge_snapshots(by_worker: Dict[str, Dict[str, dict]]
     for _wid, snap in sorted(by_worker.items()):
         for name, summ in (snap.get("histograms") or {}).items():
             hists.setdefault(name, []).append(summ)
-    return {
+    sketches: Dict[str, List[Dict[str, Any]]] = {}
+    for _wid, snap in sorted(by_worker.items()):
+        for name, summ in (snap.get("sketches") or {}).items():
+            sketches.setdefault(name, []).append(summ)
+    out = {
         "counters": counters,
         "gauges": gauges,
         "histograms": {name: merge_histograms(ss)
                        for name, ss in hists.items()},
     }
+    if sketches:
+        # merge-closed by construction (bucket counts add on a shared
+        # grid), so the fleet sketch equals the whole-stream sketch.
+        out["sketches"] = {name: _quantiles.merge_summaries(ss)
+                           for name, ss in sketches.items()}
+    return out
 
 
 # --- tenant federation -------------------------------------------------------
@@ -164,6 +175,14 @@ def render_fleet(by_worker: Dict[str, Dict[str, dict]],
             summ = val(by_worker[wid], "histograms", name)
             if summ is not None:
                 lines.extend(_hist_lines(pn, summ, f'worker="{wid}"'))
+
+    for name in sorted(merged.get("sketches") or {}):
+        lines.extend(_live.sketch_lines(name, merged["sketches"][name]))
+        for wid in wids:
+            summ = val(by_worker[wid], "sketches", name)
+            if summ is not None:
+                lines.extend(_live.sketch_lines(name, summ,
+                                                f'worker="{wid}"'))
 
     if extra is not None:
         label, snap = extra

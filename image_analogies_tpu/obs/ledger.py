@@ -200,6 +200,8 @@ def emit_decision(site: str, verdict: str, cause: Optional[str] = None,
     if extra:
         rec.update(extra)
     _trace.emit_record(rec)
+    from image_analogies_tpu.obs import archive as _archive
+    _archive.record("decision", rec)
 
 
 # --- rendering (`ia top --tenants` and tests share it) -----------------------

@@ -118,9 +118,39 @@ def render_prometheus(snap: Optional[Dict[str, dict]]) -> str:
         lines.append(f"{pn}_sum {_fmt(summ.get('sum', 0.0))}")
         lines.append(f"{pn}_count {count}")
 
+    for name in sorted(snap.get("sketches") or {}):
+        lines.extend(sketch_lines(name, snap["sketches"][name]))
+
     if not lines:
         lines.append("# no active run (observability disabled)")
     return "\n".join(lines) + "\n"
+
+
+def sketch_lines(name: str, summ: Dict[str, Any],
+                 label: str = "") -> List[str]:
+    """Prometheus ``summary``-type exposition of one quantile-sketch
+    summary: ``{quantile="0.999"}``-labeled samples plus _sum/_count.
+    The ``_q`` suffix keeps the family distinct from the base-2
+    histogram riding on the same registry name.  ``label`` (e.g.
+    ``worker="w0"``) composes with the quantile label for the fleet
+    view."""
+    from image_analogies_tpu.obs import quantiles as _quantiles
+
+    sk = _quantiles.QuantileSketch.from_summary(summ)
+    pn = prom_name(name) + "_q"
+    sep = "," if label else ""
+    out: List[str] = []
+    if not label:
+        out.append(f"# HELP {pn} quantile sketch {name} "
+                   f"(relative error {summ.get('alpha', '?')})")
+        out.append(f"# TYPE {pn} summary")
+    for q in _quantiles.EXPORT_QUANTILES:
+        out.append(f'{pn}{{quantile="{_fmt(q)}"{sep}{label}}} '
+                   f"{_fmt(sk.quantile(q))}")
+    suffix = "{" + label + "}" if label else ""
+    out.append(f"{pn}_sum{suffix} {_fmt(summ.get('sum', 0.0))}")
+    out.append(f"{pn}_count{suffix} {int(summ.get('count', 0))}")
+    return out
 
 
 def metrics_text() -> str:
@@ -135,6 +165,7 @@ def default_health() -> Dict[str, Any]:
     """Generic liveness payload for non-serve expositions: is a run
     active, which run, how long has this process been up.  The serving
     front end replaces this with :meth:`serve.server.Server.health`."""
+    from image_analogies_tpu.obs import ceilings as _ceilings
     from image_analogies_tpu.obs import trace as _trace
 
     return {
@@ -142,6 +173,7 @@ def default_health() -> Dict[str, Any]:
         "active_run": _metrics.registry() is not None,
         "run_id": _trace.current_run_id(),
         "uptime_s": round(time.monotonic() - _T0, 3),
+        "vitals": _ceilings.read_proc_vitals(),
     }
 
 
@@ -269,6 +301,22 @@ def start_http_server(port: int,
                     raise
                 finally:
                     _metrics.observe("obs.scrape.tenants.duration_ms",
+                                     (time.perf_counter() - t0) * 1e3)
+            elif parts.path == "/archive/stats":
+                from image_analogies_tpu.obs import archive as _archive
+
+                t0 = time.perf_counter()
+                _metrics.inc("obs.scrape.archive.total")
+                try:
+                    self._reply(200,
+                                json.dumps(_archive.stats_doc()).encode(),
+                                "application/json")
+                except Exception:  # noqa: BLE001 - counted, then raised
+                    _metrics.inc("obs.scrape.errors")
+                    _metrics.inc("obs.scrape.archive.errors")
+                    raise
+                finally:
+                    _metrics.observe("obs.scrape.archive.duration_ms",
                                      (time.perf_counter() - t0) * 1e3)
             elif parts.path == "/healthz":
                 self._reply(200, json.dumps(hz_fn()).encode(),

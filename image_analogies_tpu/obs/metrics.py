@@ -32,6 +32,7 @@ import math
 import threading
 from typing import Dict, List, Optional
 
+from image_analogies_tpu.obs import quantiles as _quantiles
 from image_analogies_tpu.obs import recorder as _recorder
 
 
@@ -140,14 +141,24 @@ class Histogram:
         return h
 
 
+# Series (by name suffix) that also feed a relative-error quantile
+# sketch next to their base-2 histogram — the honest-tail rider for
+# p99.9/p99.99.  Latency is the tail that matters; everything else
+# keeps the cheap histogram only.
+SKETCH_SUFFIXES = ("latency_ms",)
+
+
 class MetricsRegistry:
-    """Thread-safe named counters / gauges / histograms."""
+    """Thread-safe named counters / gauges / histograms, plus a
+    DDSketch-style quantile sketch riding beside the histogram on
+    latency series (see :data:`SKETCH_SUFFIXES`)."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._counters: Dict[str, float] = {}
         self._gauges: Dict[str, float] = {}
         self._histograms: Dict[str, Histogram] = {}
+        self._sketches: Dict[str, "_quantiles.QuantileSketch"] = {}
 
     def inc(self, name: str, value: float = 1) -> None:
         with self._lock:
@@ -174,20 +185,32 @@ class MetricsRegistry:
             if h is None:
                 h = self._histograms[name] = Histogram()
             h.observe(value)
+            if name.endswith(SKETCH_SUFFIXES):
+                sk = self._sketches.get(name)
+                if sk is None:
+                    sk = self._sketches[name] = _quantiles.QuantileSketch()
+                sk.observe(value)
 
     def counter(self, name: str) -> float:
         with self._lock:
             return self._counters.get(name, 0)
 
     def snapshot(self) -> Dict[str, dict]:
-        """Plain-dict dump, safe to json-serialize into a run record."""
+        """Plain-dict dump, safe to json-serialize into a run record.
+        The ``sketches`` key appears only once a latency series exists,
+        so pre-sketch snapshot shapes (golden tests, archived run logs)
+        stay byte-stable."""
         with self._lock:
-            return {
+            snap = {
                 "counters": dict(self._counters),
                 "gauges": dict(self._gauges),
                 "histograms": {k: h.summary()
                                for k, h in self._histograms.items()},
             }
+            if self._sketches:
+                snap["sketches"] = {k: sk.summary()
+                                    for k, sk in self._sketches.items()}
+            return snap
 
 
 # --- scoped observability contexts ------------------------------------------

@@ -419,6 +419,30 @@ def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         for dev, v in (hr.get("peaks") or {}).items():
             hbm[dev] = max(hbm.get(dev, 0.0), float(v))
 
+    # --- resource-ceiling section (obs/ceilings.py trend watchdogs) -------
+    # Each ceiling_alarm record carries the robust (Theil-Sen) slope that
+    # crossed its per-series growth threshold; the frozen run_end gauges
+    # show where the process's vitals ended up.
+    ceiling_recs = [r for r in records if r.get("event") == "ceiling_alarm"]
+    ceilings_info: Optional[Dict[str, Any]] = None
+    if ceiling_recs or any(k.startswith("obs.ceiling.") for k in counters):
+        by_series = {k.split("obs.ceiling.", 1)[1]: int(v)
+                     for k, v in counters.items()
+                     if k.startswith("obs.ceiling.")
+                     and k != "obs.ceiling.alarms"}
+        ceilings_info = {
+            "alarms": int(counters.get("obs.ceiling.alarms",
+                                       len(ceiling_recs))),
+            "by_series": by_series,
+            "vitals": {k: gauges[k] for k in
+                       ("proc.rss_bytes", "proc.open_fds", "proc.threads")
+                       if gauges.get(k) is not None},
+            # each alarm, in order
+            "events": [{k: r[k] for k in
+                        ("series", "slope_per_s", "threshold_per_s",
+                         "value") if k in r} for r in ceiling_recs],
+        }
+
     # --- pipeline-overlap section (driver pipeline.* gauges/counters) -----
     pipeline_info: Optional[Dict[str, Any]] = None
     if ("pipeline.host_gap_ms" in gauges
@@ -568,6 +592,7 @@ def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "catalog": catalog_info,
         "router": router_info,
         "slo": slo_info,
+        "ceilings": ceilings_info,
         "traces": traces_info,
         "journal": journal_info,
         "chaos": chaos_info,
@@ -638,7 +663,7 @@ def render(an: Dict[str, Any], run_id: Optional[str] = None) -> str:
             and not k.startswith(("serve.", "chaos.", "watchdog.",
                                   "ckpt.", "retry.", "pipeline.",
                                   "router.", "batch.", "catalog.",
-                                  "ann."))}
+                                  "ann.", "obs.ceiling."))}
     for k in sorted(rest):
         w(f"    {k:<13} {rest[k]:g}")
 
@@ -865,6 +890,28 @@ def render(an: Dict[str, Any], run_id: Optional[str] = None) -> str:
             w(f"    burn rate     fast {bf if bf is not None else '-'} / "
               f"slow {bs if bs is not None else '-'}  "
               "(1.0 = exactly on budget)")
+
+    ce = an.get("ceilings")
+    if ce:
+        w("  ceilings:")
+        series = ", ".join(f"{k}x{v}" for k, v in
+                           sorted(ce["by_series"].items()))
+        w(f"    alarms        {ce['alarms']}  ({series or '-'})")
+        vit = ce["vitals"]
+        if vit:
+            parts = []
+            if vit.get("proc.rss_bytes") is not None:
+                parts.append(f"rss {_fmt_bytes(vit['proc.rss_bytes'])}")
+            if vit.get("proc.open_fds") is not None:
+                parts.append(f"{int(vit['proc.open_fds'])} fds")
+            if vit.get("proc.threads") is not None:
+                parts.append(f"{int(vit['proc.threads'])} threads")
+            w(f"    vitals        {', '.join(parts)}")
+        for ev in ce["events"]:
+            w(f"    alarm         {ev.get('series', '?')}: "
+              f"+{_fmt_bytes(ev.get('slope_per_s', 0))}/s over the "
+              f"{_fmt_bytes(ev.get('threshold_per_s', 0))}/s ceiling "
+              f"(at {_fmt_bytes(ev.get('value', 0))})")
 
     trs = an.get("traces")
     if trs:

@@ -51,6 +51,7 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from image_analogies_tpu.obs import metrics as _metrics
+from image_analogies_tpu.obs import quantiles as _quantiles
 
 # (window_seconds, ring_capacity) per tier, coarsening left to right:
 # 2 minutes of 1s, 15 minutes of 10s, 1 hour of 60s — fixed memory.
@@ -117,10 +118,17 @@ class Timeline:
         self._warmup = int(warmup)
         self._alpha = float(alpha)
         # Per-series cumulative baselines (counter last value / histogram
-        # last summary) so each sample contributes only its delta.
+        # last summary / sketch last summary) so each sample contributes
+        # only its delta.
         self._cum: Dict[str, float] = {}
         self._cum_h: Dict[str, Dict] = {}
+        self._cum_q: Dict[str, Dict] = {}
         self._kinds: Dict[str, str] = {}
+        # Last sample wall time per series key, so baselines from dead
+        # worker generations can be pruned instead of pinned forever.
+        self._last_seen: Dict[str, float] = {}
+        self._next_prune = 0.0
+        self.series_pruned = 0
         # EWMA state per anomaly-watched series: [mean, mad, n_windows].
         self._ewma: Dict[str, List[float]] = {}
         self._hints: deque = deque(maxlen=MAX_HINTS)
@@ -167,6 +175,30 @@ class Timeline:
                         win.series[key] = delta_h
                     else:
                         cur.merge(delta_h)
+            for name, summ in (snap.get("sketches") or {}).items():
+                # distinct key: the same registry name also carries the
+                # base-2 histogram; ".q" keeps the kinds from colliding.
+                key = prefix + name + ".q"
+                self._kinds[key] = "sketch"
+                prev = self._cum_q.get(key)
+                delta = _quantiles.delta_summary(summ, prev)
+                if delta is None:  # count regressed: fresh generation
+                    delta = dict(summ)
+                self._cum_q[key] = summ
+                if int(delta.get("count", 0)) > 0:
+                    cur = win.series.get(key)
+                    win.series[key] = delta if cur is None else \
+                        _quantiles.merge_summaries([cur, delta])
+            stamp = now
+            for name in (snap.get("counters") or {}):
+                self._last_seen[prefix + name] = stamp
+            for name in (snap.get("gauges") or {}):
+                self._last_seen[prefix + name] = stamp
+            for name in (snap.get("histograms") or {}):
+                self._last_seen[prefix + name] = stamp
+            for name in (snap.get("sketches") or {}):
+                self._last_seen[prefix + name + ".q"] = stamp
+            self._prune_locked(stamp)
 
     def _hist_delta_locked(self, key: str, summ: Dict) -> _metrics.Histogram:
         """New samples since the last snapshot of ``key``, as a
@@ -192,6 +224,31 @@ class Timeline:
             if d > 0:
                 h.buckets[int(k)] = d
         return h
+
+    def _prune_locked(self, now: float) -> None:
+        """Drop per-series baselines (cum / cum_h / cum_q / kinds /
+        ewma) idle for more than two full tier-0 retentions.  A SIGKILLed
+        worker's ``w<N>:`` series stop arriving the moment its scrape
+        dies; without this, every generation's baselines stay pinned for
+        the life of the fleet.  Ring windows age the *values* out on
+        their own; this reclaims the dictionaries."""
+        t0 = self._tiers[0]
+        retention = t0.window_s * (t0.windows.maxlen or 1)
+        if now < self._next_prune:
+            return
+        self._next_prune = now + retention
+        horizon = now - 2.0 * retention
+        stale = [k for k, ts in self._last_seen.items() if ts < horizon]
+        for k in stale:
+            self._last_seen.pop(k, None)
+            self._cum.pop(k, None)
+            self._cum_h.pop(k, None)
+            self._cum_q.pop(k, None)
+            self._kinds.pop(k, None)
+            self._ewma.pop(k, None)
+        if stale:
+            self.series_pruned += len(stale)
+            _metrics.inc("timeline.series_pruned", len(stale))
 
     # --- window lifecycle ----------------------------------------------------
 
@@ -231,6 +288,10 @@ class Timeline:
                     target.series[key] = h
                 else:
                     cur.merge(v)
+            elif kind == "sketch":
+                cur = target.series.get(key)
+                target.series[key] = dict(v) if cur is None else \
+                    _quantiles.merge_summaries([cur, v])
             else:  # gauge: last value wins (windows close in time order)
                 target.series[key] = v
 
@@ -285,6 +346,12 @@ class Timeline:
                     "p50": round(v.percentile(50), 3),
                     "p95": round(v.percentile(95), 3),
                     "max": round(v.max, 3) if v.count else 0.0}
+        if isinstance(v, dict) and "bins" in v and "alpha" in v:
+            sk = _quantiles.QuantileSketch.from_summary(v)
+            out = {"count": sk.count,
+                   "max": round(sk.max, 3) if sk.count else 0.0}
+            out.update(sk.quantiles_doc())
+            return out
         return v
 
     def range(self, series: str, window_s: Optional[float] = None
@@ -499,7 +566,8 @@ def cockpit_rows(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
     def row(worker: str) -> Dict[str, Any]:
         return workers.setdefault(worker, {
             "worker": worker, "qps": 0.0, "p50": None, "p95": None,
-            "queue": None, "breaker": "", "hbm": None, "anomalies": 0})
+            "p999": None, "queue": None, "breaker": "", "hbm": None,
+            "anomalies": 0})
 
     for key, ent in series.items():
         worker, _, name = key.rpartition(":")
@@ -513,6 +581,8 @@ def cockpit_rows(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
         elif name == "serve.latency_ms" and isinstance(v, dict):
             row(worker)["p50"] = v.get("p50")
             row(worker)["p95"] = v.get("p95")
+        elif name == "serve.latency_ms.q" and isinstance(v, dict):
+            row(worker)["p999"] = v.get("p999")
         elif name == "serve.queue_depth":
             row(worker)["queue"] = v
         elif name.startswith("serve.breaker.state."):
@@ -535,7 +605,8 @@ def render_cockpit(doc: Dict[str, Any]) -> str:
     """One terminal frame of the ``ia top`` cockpit."""
     rows = cockpit_rows(doc)
     hdr = (f"{'WORKER':<10} {'QPS':>8} {'P50ms':>8} {'P95ms':>8} "
-           f"{'QUEUE':>6} {'BREAKER':>12} {'HBM':>10} {'ANOM':>5}")
+           f"{'P999ms':>8} {'QUEUE':>6} {'BREAKER':>12} {'HBM':>10} "
+           f"{'ANOM':>5}")
     lines = [f"ia top — window {doc.get('window_s', '?')}s, "
              f"{len(doc.get('series') or {})} series"
              + ("" if doc.get("armed", True) else "  [timeline disarmed]"),
@@ -552,7 +623,8 @@ def render_cockpit(doc: Dict[str, Any]) -> str:
     for r in rows:
         lines.append(
             f"{r['worker']:<10} {r['qps']:>8.2f} {fmt(r['p50']):>8} "
-            f"{fmt(r['p95']):>8} {fmt(r['queue'], '{:.0f}'):>6} "
+            f"{fmt(r['p95']):>8} {fmt(r.get('p999')):>8} "
+            f"{fmt(r['queue'], '{:.0f}'):>6} "
             f"{(r['breaker'] or '-'):>12} {fmt_hbm(r['hbm']):>10} "
             f"{r['anomalies']:>5d}")
     if not rows:

@@ -57,6 +57,8 @@ import time
 from typing import Any, Dict, List, Optional
 
 from image_analogies_tpu.obs import fleet as obs_fleet
+from image_analogies_tpu.obs import archive as obs_archive
+from image_analogies_tpu.obs import ceilings as obs_ceilings
 from image_analogies_tpu.obs import ledger as obs_ledger
 from image_analogies_tpu.obs import live as obs_live
 from image_analogies_tpu.obs import metrics as obs_metrics
@@ -156,6 +158,16 @@ class Fleet:
         # cadence — arm the process timeline for the fleet's lifetime so
         # each poll lands worker-labeled windowed series in it.
         obs_timeline.arm()
+        # Witness plane: with an archive root configured (env
+        # IA_ARCHIVE_DIR — the fleet-operator path, like the catalog's
+        # IA_CATALOG_DIR), the health loop also persists closed
+        # timeline/tenants documents to sealed disk segments, and the
+        # ceilings watchdog trends RSS / journal / archive growth.
+        archive_root = os.environ.get("IA_ARCHIVE_DIR")
+        self._archive_armed = bool(archive_root)
+        if archive_root:
+            obs_archive.arm(root=archive_root)
+        obs_ceilings.arm(decision_log=self.decisions)
         for i in range(self.cfg.size):
             wid = "w{}".format(i)
             self._spawn(wid, generation=0)
@@ -187,6 +199,10 @@ class Fleet:
             handle.shutdown()
         if self.decisions is not None:
             self.decisions.close()
+        obs_ceilings.disarm()
+        if getattr(self, "_archive_armed", False):
+            obs_archive.disarm()
+            self._archive_armed = False
         obs_timeline.disarm()
         self._scope_exit.close()
         self._started = False
@@ -291,6 +307,26 @@ class Fleet:
         # incarnations.
         obs_timeline.sample_snapshot(snap, worker=wid)
 
+    def _journal_bytes(self) -> Optional[float]:
+        """Total on-disk bytes under the fleet journal root (segments,
+        decision log, worker subdirs) — the ceilings watchdog's
+        journal-growth series.  None (series skipped) without a root."""
+        root = self.cfg.journal_root
+        if not root:
+            return None
+        total = 0
+        try:
+            for dirpath, _dirs, files in os.walk(root):
+                for name in files:
+                    try:
+                        total += os.path.getsize(
+                            os.path.join(dirpath, name))
+                    except OSError:
+                        pass
+        except OSError:
+            return None
+        return float(total)
+
     def _health_loop(self) -> None:
         while not self._stop.wait(self.cfg.health_interval_s):
             if self._scope is not None:
@@ -302,6 +338,12 @@ class Fleet:
             # the same cadence (no-op when the plane is disarmed — e.g.
             # subprocess transport, where children sample their own).
             obs_ledger.sample_timeline()
+            # Witness + watchdog planes (both no-ops when disarmed):
+            # persist the current timeline/tenants documents to the
+            # archive, and trend the resource-ceiling series.
+            obs_archive.sample()
+            obs_ceilings.sample(extra={
+                "journal.bytes": self._journal_bytes()})
             for wid in list(self.workers):
                 if self._stop.is_set():
                     return

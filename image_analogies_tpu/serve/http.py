@@ -123,6 +123,8 @@ def _make_handler_from(health_fn, submit_fn, refresh_fn, metrics_fn=None,
                 self._scrape("timeline", self._get_timeline, parts)
             elif parts.path == "/tenants":
                 self._scrape("tenants", self._get_tenants, parts)
+            elif parts.path == "/archive/stats":
+                self._scrape("archive", self._get_archive_stats, parts)
             else:
                 self._reply(404, {"error": "not_found"})
 
@@ -172,6 +174,10 @@ def _make_handler_from(health_fn, submit_fn, refresh_fn, metrics_fn=None,
                 return
             from image_analogies_tpu.obs import ledger as obs_ledger
             self._reply(200, obs_ledger.tenants_doc())
+
+        def _get_archive_stats(self, parts) -> None:
+            from image_analogies_tpu.obs import archive as obs_archive
+            self._reply(200, obs_archive.stats_doc())
 
         def _get_timeline(self, parts) -> None:
             query = urllib.parse.parse_qs(parts.query)

@@ -38,6 +38,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.obs import ceilings as obs_ceilings
 from image_analogies_tpu.obs import ledger as obs_ledger
 from image_analogies_tpu.obs import metrics as obs_metrics
 from image_analogies_tpu.obs import trace as obs_trace
@@ -533,6 +534,9 @@ class Server:
             # handoff (None when the journal is disabled)
             "journal": ({**self._journal.stats(), **self._journal.info()}
                         if self._journal is not None else None),
+            # process vitals from /proc (graceful off-Linux): the
+            # ceilings watchdog and `ia top` read the same source.
+            "vitals": obs_ceilings.read_proc_vitals(),
         }
 
 

@@ -35,7 +35,9 @@ import threading
 from http.server import ThreadingHTTPServer
 from typing import Optional
 
+from image_analogies_tpu.obs import ceilings as obs_ceilings
 from image_analogies_tpu.obs import metrics as obs_metrics
+from image_analogies_tpu.obs import timeline as obs_timeline
 from image_analogies_tpu.obs import trace as obs_trace
 from image_analogies_tpu.serve import http as serve_http
 from image_analogies_tpu.serve import transport as serve_transport
@@ -65,6 +67,15 @@ def main(argv: Optional[list] = None) -> int:
                                        "generation": generation,
                                        "pid": os.getpid()}}):
         server = Server(cfg).start()
+
+        # Per-process temporal plane: the child samples its own registry
+        # (the fleet cannot reach across the process boundary to do it)
+        # so GET /timeline answers live windows, and the ceilings
+        # watchdog trends this worker's own RSS — a leaking child emits
+        # its own obs.ceiling.* alarms and decision records.
+        tl = obs_timeline.arm()
+        obs_ceilings.arm()
+        tl.start_sampler(interval_s=1.0)
 
         def _snapshot():
             return obs_metrics.snapshot() or {}
@@ -96,6 +107,8 @@ def main(argv: Optional[list] = None) -> int:
 
         stop.wait()
         httpd.shutdown()
+        obs_ceilings.disarm()
+        obs_timeline.disarm()
         server.shutdown()
     return 0
 

@@ -1,0 +1,444 @@
+"""Durable telemetry archive, tail-quantile sketches, and ceiling
+watchdogs (ISSUE 17): the witness layer that survives the process.
+
+Tier-1 invariants locked here:
+
+- a sealed archive round-trips bit-identically: the replayed /timeline
+  and /tenants documents equal the last appended docs, verbatim;
+- a flipped byte costs exactly the records at and after it in that
+  segment — the valid prefix survives, the file is quarantined
+  ``.corrupt`` in place, and stats() counts it;
+- compaction bounds the raw tier while preserving replay: the latest
+  documents and total per-kind counts survive total raw-tier folding;
+- the DDSketch-style quantile sketch honours its stated relative-error
+  bound at 1e5 samples (bench re-runs at 1e6), merge is associative and
+  merge-closed — the worker -> fleet federation path reports the same
+  tail as the whole stream;
+- the DISARMED archive plane allocates nothing (tracemalloc, same
+  contract as the ledger/timeline planes);
+- the timeline prunes per-series baselines of dead worker generations
+  (fake clock: idle > 2 retentions -> dictionaries reclaimed,
+  ``series_pruned`` counts) and a respawned generation starts fresh;
+- the ceilings watchdog selftest catches a seeded synthetic leak within
+  its tick budget, never alarms on flat noise, and an alarm lands as a
+  sealed fleet DecisionLog record (`ia why` visibility);
+- `ia archive inspect` summarizes a sealed store from the CLI and
+  `ia top --from-archive --once` renders the archived cockpit offline;
+- the live server exposes ``/archive/stats`` (disarmed shape mirrors
+  the other planes) and ``/healthz`` carries process vitals;
+- `ia bench --check` gates archive_overhead_pct in absolute points
+  (legacy archives record-only) and passes sketch_p999_rel_err through.
+"""
+
+import gc
+import json
+import os
+import threading
+import tracemalloc
+import urllib.request
+
+import pytest
+
+from image_analogies_tpu.chaos import drills, inject
+from image_analogies_tpu.obs import archive as obs_archive
+from image_analogies_tpu.obs import ceilings as obs_ceilings
+from image_analogies_tpu.obs import quantiles as obs_quantiles
+from image_analogies_tpu.obs import timeline as obs_timeline
+from image_analogies_tpu.serve import journal as serve_journal
+from image_analogies_tpu.serve.server import Server
+from tests.conftest import make_pair
+
+
+@pytest.fixture(autouse=True)
+def _clean_planes():
+    yield
+    inject.disarm()
+    for _ in range(8):
+        if obs_archive.current() is None:
+            break
+        obs_archive.disarm()
+    for _ in range(8):
+        if obs_ceilings.current() is None:
+            break
+        obs_ceilings.disarm()
+    for _ in range(8):
+        if obs_timeline.current() is None:
+            break
+        obs_timeline.disarm()
+
+
+def _tl_doc(n):
+    """A synthetic /timeline-shaped doc; the archive treats docs as
+    opaque, so the round-trip contract is plain equality."""
+    return {"armed": True, "window_s": 1.0, "series": {
+        "w0:serve.completed": {"kind": "counter",
+                               "points": [[float(n), float(n + 1)]]}},
+        "anomalies": [], "seq": n}
+
+
+# ------------------------------------------------ sealed round trip
+
+
+def test_archive_round_trip_bit_identity(tmp_path):
+    root = str(tmp_path / "ar")
+    ar = obs_archive.TelemetryArchive(root, sample_interval_s=0.0)
+    docs = [_tl_doc(i) for i in range(5)]
+    for d in docs:
+        assert ar.append("timeline", d) is True
+    ar.append("tenants", {"armed": True, "tenants": [], "recorded": 3})
+    ar.append("decision", {"site": "router", "verdict": "spill"})
+
+    # a SECOND reader over the same root sees only what is durable
+    rd = obs_archive.TelemetryArchive(root)
+    rep = rd.replay()
+    assert rep["timeline"] == docs[-1]
+    assert rep["tenants"]["recorded"] == 3
+    assert rep["kinds"] == {"timeline": 5, "tenants": 1, "decision": 1}
+    assert rep["decisions"] == [{"site": "router", "verdict": "spill"}]
+    assert rd.history("timeline") == docs
+    st = rd.stats()
+    assert st["segments"] >= 1 and st["bytes"] > 0
+    assert st["quarantined"] == 0
+
+
+def test_flipped_byte_quarantines_and_keeps_valid_prefix(tmp_path):
+    """Torn-write honesty: per-record segments make the blast radius
+    exactly one record; the damaged file is renamed ``.corrupt``."""
+    root = str(tmp_path / "ar")
+    # max_segment_bytes=1: every append rotates -> one record/segment
+    ar = obs_archive.TelemetryArchive(root, max_segment_bytes=1)
+    docs = [_tl_doc(i) for i in range(5)]
+    for d in docs:
+        ar.append("timeline", d)
+    segs = sorted(n for n in os.listdir(root) if n.endswith(".jsonl"))
+    assert len(segs) == 5
+    victim = os.path.join(root, segs[2])
+    raw = bytearray(open(victim, "rb").read())
+    raw[len(raw) // 2] ^= 0x01  # flip one payload bit
+    with open(victim, "wb") as f:
+        f.write(bytes(raw))
+
+    rd = obs_archive.TelemetryArchive(root)
+    hist = rd.history("timeline")
+    assert hist == [docs[0], docs[1], docs[3], docs[4]]
+    names = os.listdir(root)
+    assert sum(1 for n in names if n.endswith(".corrupt")) == 1
+    assert segs[2] not in names  # quarantined in place, not re-read
+    assert rd.stats()["quarantined"] == 1
+    # the survivors replay verbatim — corruption is surgical
+    assert rd.replay()["timeline"] == docs[-1]
+
+
+def test_compaction_bounds_disk_and_preserves_replay(tmp_path):
+    root = str(tmp_path / "ar")
+    ar = obs_archive.TelemetryArchive(
+        root, max_segment_bytes=400, max_total_bytes=1600,
+        sample_interval_s=0.0)
+    n = 120
+    for i in range(n):
+        assert ar.append("timeline", _tl_doc(i)) is True
+    st = ar.stats()
+    assert st["compactions"] >= 1
+    assert st["summary_segments"] >= 1
+    # the RAW tier stays bounded near the cap (one open segment of
+    # slack); the summary tier grows one sealed line per fold
+    raw = sum(os.path.getsize(os.path.join(root, f))
+              for f in os.listdir(root) if f.startswith("archive-"))
+    assert raw <= ar.max_total_bytes + ar.max_segment_bytes
+    rep = obs_archive.TelemetryArchive(root).replay()
+    assert rep["timeline"] == _tl_doc(n - 1)      # latest doc survives
+    assert rep["kinds"]["timeline"] == n          # counts fold, not drop
+
+
+# ------------------------------------------------ quantile sketches
+
+
+def test_sketch_selftest_and_merge_associativity():
+    """The stated relative-error bound holds at 1e5 samples, whole
+    stream AND after a worker->fleet merge; merge is associative and
+    merge-closed (summary round trip)."""
+    st = obs_quantiles.selftest(n=100_000)
+    assert st["ok"], st
+    assert st["p999_rel_err"] <= st["bound"]
+
+    import random
+    rng = random.Random(3)
+    streams = [[rng.lognormvariate(3.0, 0.7) for _ in range(2000)]
+               for _ in range(3)]
+    sks = []
+    for vals in streams:
+        sk = obs_quantiles.QuantileSketch()
+        for v in vals:
+            sk.observe(v)
+        sks.append(sk)
+    whole = obs_quantiles.QuantileSketch()
+    for vals in streams:
+        for v in vals:
+            whole.observe(v)
+    a, b, c = (sk.summary() for sk in sks)
+    left = obs_quantiles.merge_summaries(
+        [obs_quantiles.merge_summaries([a, b]), c])
+    right = obs_quantiles.merge_summaries(
+        [a, obs_quantiles.merge_summaries([b, c])])
+    assert left == right == whole.summary()
+    merged = obs_quantiles.QuantileSketch.from_summary(left)
+    exact = obs_quantiles.exact_quantile(
+        [v for vals in streams for v in vals], 0.999)
+    assert abs(merged.quantile(0.999) - exact) / exact <= merged.alpha
+
+
+def test_sketch_values_never_poison():
+    sk = obs_quantiles.QuantileSketch()
+    sk.observe(float("nan"))
+    sk.observe(0.0)
+    sk.observe(-1.0)
+    sk.observe(5.0)
+    assert sk.count == 3 and sk.zeros == 2
+    assert sk.quantile(0.999) > 0.0
+
+
+# ------------------------------------------------ disarmed plane cost
+
+
+def test_disarmed_archive_plane_allocates_nothing():
+    """Acceptance: disarmed, the producer path is one module-bool read —
+    no steady-state allocations attributable to obs/ (same tracemalloc
+    lock as the timeline/ledger planes)."""
+    assert obs_archive.current() is None
+    doc = {"series": {"serve.qps": 1.0}}
+    gc.collect()
+    gc.disable()
+    tracemalloc.start()
+    try:
+        for _ in range(2000):
+            obs_archive.record("timeline", doc)
+            obs_archive.sample()
+        taken = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+        gc.enable()
+    obs_allocs = [t for t in taken.traces
+                  if any("image_analogies_tpu/obs/" in fr.filename
+                         for fr in t.traceback)]
+    assert len(obs_allocs) <= 8
+    assert sum(t.size for t in obs_allocs) <= 1024
+
+
+# ------------------------------------------------ timeline pruning
+
+
+def test_timeline_prunes_dead_worker_series_baselines():
+    """A SIGKILLed worker's series stop arriving; idle > 2 retentions,
+    its per-series baselines are reclaimed and counted.  A respawned
+    generation re-enters fresh (whole value = first delta)."""
+    now = [0.0]
+    tl = obs_timeline.Timeline(tiers=((1.0, 4),), clock=lambda: now[0])
+    snap_w0 = {"counters": {"serve.requests": 10.0}, "gauges": {},
+               "histograms": {}}
+    tl.sample_snapshot(snap_w0, worker="w0", now=0.0)
+    retention = 4.0  # tier-0 window_s * maxlen
+    # w1 keeps reporting long past w0's horizon (2 * retention idle)
+    for t in range(1, 14):
+        tl.sample_snapshot(
+            {"counters": {"serve.requests": 10.0 + t}, "gauges": {},
+             "histograms": {}}, worker="w1", now=float(t))
+    assert tl.series_pruned >= 1
+    assert not any(k.startswith("w0:") for k in tl._cum)
+    assert any(k.startswith("w1:") for k in tl._cum)
+    # respawn: the fresh generation's counter enters as its own delta
+    tl.sample_snapshot(snap_w0, worker="w0", now=14.0)
+    assert tl._cum["w0:serve.requests"] == 10.0
+    assert tl.series_pruned >= 1 and retention == 4.0
+
+
+# ------------------------------------------------ ceiling watchdogs
+
+
+def test_ceilings_selftest_catches_seeded_leak():
+    st = obs_ceilings.selftest()
+    assert st["ok"], st
+    assert st["first_alarm_tick"] <= st["budget_ticks"]
+    assert st["flat_alarms"] == 0
+
+
+def test_ceiling_alarm_lands_in_fleet_decision_log(tmp_path):
+    """The funnel end-to-end: a synthetic RSS leak trips the trend
+    watchdog and the alarm is durable in decisions.jsonl — the same
+    sealed trail `ia why` merges."""
+    dl = serve_journal.DecisionLog(
+        str(tmp_path / serve_journal.DecisionLog.NAME))
+    now = [0.0]
+    mon = obs_ceilings.CeilingMonitor(
+        clock=lambda: now[0], cooldown_s=0.0, decision_log=dl)
+    alarms = []
+    for i in range(24):
+        now[0] = float(i)
+        alarms += mon.sample(
+            extra={"proc.rss_bytes": float((512 << 20) + (4 << 20) * i)},
+            now=float(i))
+    assert alarms and alarms[0]["series"] == "proc.rss_bytes"
+    recs = [r for r in dl.read() if r["site"] == "ceilings"]
+    assert recs and recs[0]["verdict"] == "alarm"
+    assert recs[0]["cause"] == "proc.rss_bytes_trend"
+    assert recs[0].get("idem") is None  # fleet-scope, no request chain
+    rpt = mon.report()["proc.rss_bytes"]
+    assert rpt["alarms"] >= 1 and rpt["slope_per_s"] > 0
+
+
+# ------------------------------------------------ CLI offline readers
+
+
+def _seed_archive(root, n=3):
+    ar = obs_archive.TelemetryArchive(root, sample_interval_s=0.0)
+    for i in range(n):
+        ar.append("timeline", _tl_doc(i))
+    ar.append("anomaly", {"series": "w0:serve.latency_ms",
+                          "kind": "zscore"})
+    return ar
+
+
+def test_cli_archive_inspect_and_replay(tmp_path, capsys):
+    from image_analogies_tpu.cli import main
+
+    root = str(tmp_path / "ar")
+    _seed_archive(root)
+    rc = main(["archive", "inspect", root])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "segment(s)" in out and "timeline=3" in out
+
+    rc = main(["archive", "inspect", root, "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["kinds"] == {"timeline": 3, "anomaly": 1}
+    assert doc["quarantined"] == 0
+
+    rc = main(["archive", "replay", root])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "ia top" in out  # archived cockpit frame
+
+    missing = main(["archive", "inspect", str(tmp_path / "nope")])
+    assert missing == 2
+
+
+def test_cli_archive_diff(tmp_path, capsys):
+    from image_analogies_tpu.cli import main
+
+    ra, rb = str(tmp_path / "a"), str(tmp_path / "b")
+    _seed_archive(ra, n=2)
+    _seed_archive(rb, n=4)
+    rc = main(["archive", "diff", ra, rb, "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert isinstance(doc, dict) and doc
+
+
+def test_cli_top_from_archive_once(tmp_path, capsys):
+    from image_analogies_tpu.cli import main
+
+    root = str(tmp_path / "ar")
+    _seed_archive(root)
+    rc = main(["top", "--from-archive", root, "--once"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "ia top" in out and "WORKER" in out
+
+    empty = str(tmp_path / "empty")
+    os.makedirs(empty)
+    rc = main(["top", "--from-archive", empty, "--once"])
+    captured = capsys.readouterr()
+    assert rc == 2 and captured.err
+
+
+# ------------------------------------------------ live endpoints
+
+
+def test_http_archive_stats_and_healthz_vitals(tmp_path):
+    """Satellites: /archive/stats mirrors the plane (armed shape with a
+    live root, disarmed shape otherwise) and /healthz carries process
+    vitals for the fleet health loop."""
+    from image_analogies_tpu.serve.http import serve_http
+
+    a, ap, b = make_pair(10, 10, seed=42)
+    with Server(drills.serve_config(workers=1)) as srv:
+        assert srv.request(a, ap, b, timeout=120).status == "ok"
+        httpd = serve_http(srv, 0)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            base = f"http://127.0.0.1:{httpd.server_address[1]}"
+            with urllib.request.urlopen(base + "/archive/stats",
+                                        timeout=5) as resp:
+                disarmed = json.loads(resp.read().decode())
+            obs_archive.arm(root=str(tmp_path / "ar"))
+            try:
+                obs_archive.current().append("timeline", _tl_doc(0))
+                with urllib.request.urlopen(base + "/archive/stats",
+                                            timeout=5) as resp:
+                    armed = json.loads(resp.read().decode())
+            finally:
+                obs_archive.disarm()
+            with urllib.request.urlopen(base + "/healthz",
+                                        timeout=5) as resp:
+                health = json.loads(resp.read().decode())
+        finally:
+            httpd.shutdown()
+    assert disarmed == {"armed": False, "segments": 0, "bytes": 0}
+    assert armed["armed"] is True and armed["bytes"] > 0
+    assert armed["appended"] == 1
+    vitals = health["vitals"]
+    assert vitals["rss_bytes"] and vitals["rss_bytes"] > 0
+    assert vitals["threads"] and vitals["threads"] >= 1
+
+
+# ------------------------------------------------ bench rider
+
+
+def test_bench_check_gates_archive_overhead():
+    """archive_overhead_pct rides the bench trajectory with the same
+    absolute-points gate as the timeline/ledger riders; legacy archives
+    record-only; sketch_p999_rel_err passes through ungated."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "ia_bench_archive_test", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    doc = {"parsed": {"value": 7.5, "metric": "1024x1024 north star",
+                      "archive_overhead_pct": 1.5,
+                      "sketch_p999_rel_err": 0.004}}
+    head = bench.extract_headline(doc)
+    assert head["archive_overhead_pct"] == 1.5
+    assert head["sketch_p999_rel_err"] == 0.004
+
+    trajectory = {"points": [
+        {"value": 7.0, "metric_key": "1024x1024", "round": 1,
+         "file": "BENCH_r01.json", "archive_overhead_pct": 1.0},
+        {"value": 7.2, "metric_key": "1024x1024", "round": 2,
+         "file": "BENCH_r02.json", "archive_overhead_pct": 2.0},
+    ], "problems": []}
+    ok = bench.check_regression(trajectory, fresh_value=7.1,
+                                fresh_archive=2.5, threshold_pct=20.0)
+    assert ok["ok"] and ok["archive_overhead_pct"] == 2.5
+    assert ok["archive_overhead_floor"] == 1.0
+    assert ok["archive_overhead_delta_pts"] == 1.5
+    bad = bench.check_regression(trajectory, fresh_value=7.1,
+                                 fresh_archive=30.0, threshold_pct=20.0)
+    assert not bad["ok"]
+    assert any("archive_overhead_pct" in p for p in bad["problems"])
+    # self-check reads the latest point's own overhead
+    latest = bench.check_regression(trajectory, threshold_pct=20.0)
+    assert latest["archive_overhead_pct"] == 2.0
+    assert latest["archive_overhead_floor"] == 1.0
+    # legacy archive (no archive points): record-only, never a gate
+    legacy = {"points": [
+        {"value": 7.0, "metric_key": "1024x1024", "round": 1,
+         "file": "BENCH_r01.json"}], "problems": []}
+    rec = bench.check_regression(legacy, fresh_value=7.1,
+                                 fresh_archive=99.0, threshold_pct=20.0)
+    assert rec["ok"] and rec["archive_overhead_pct"] == 99.0
+    assert rec["archive_overhead_floor"] is None
