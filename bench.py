@@ -149,6 +149,12 @@ def extract_headline(doc: dict):
         if obj.get("sketch_p999_rel_err") is not None:
             out["sketch_p999_rel_err"] = float(
                 obj["sketch_p999_rel_err"])
+        # elastic-fleet trajectory (PR 19): burst overruns the policy's
+        # pressure threshold -> the control plane's reconcile spawns,
+        # ring-joins, and warm-stages a worker; the headline is
+        # pressure-onset -> joined worker ready, in ms
+        if obj.get("scale_up_ms") is not None:
+            out["scale_up_ms"] = float(obj["scale_up_ms"])
         return out
 
     parsed = doc.get("parsed")
@@ -206,7 +212,7 @@ def check_regression(trajectory: dict, fresh_value=None,
                      fresh_obs=None, fresh_cold=None,
                      fresh_scale=None, fresh_timeline=None,
                      fresh_handoff=None, fresh_ledger=None,
-                     fresh_archive=None) -> dict:
+                     fresh_archive=None, fresh_scaleup=None) -> dict:
     """Gate a wall-clock number against the trajectory floor.
 
     With ``fresh_value`` (a just-measured number), it is compared against
@@ -282,6 +288,14 @@ def check_regression(trajectory: dict, fresh_value=None,
     the same ABSOLUTE percentage-points gate; archives from rounds
     before the flight recorder existed carry no floor, so the first
     point records without gating.
+
+    ``scale_up_ms`` (a burst overruns the control policy's pressure
+    threshold -> the reconcile loop spawns, ring-joins, and warm-stages
+    a worker; headline = pressure onset -> joined worker ready — PR
+    19's elastic-fleet promise) rides via ``fresh_scaleup``, gated
+    relatively like ``handoff_recovery_ms``.  Archives from rounds
+    before the control plane existed carry no floor, so the first
+    measured point records without gating.
     """
     points = trajectory.get("points") or []
     problems = list(trajectory.get("problems", []))
@@ -311,6 +325,7 @@ def check_regression(trajectory: dict, fresh_value=None,
         cand_handoff = fresh_handoff
         cand_ledger = fresh_ledger
         cand_archive = fresh_archive
+        cand_scaleup = fresh_scaleup
         prior = same
         floor = min(p["value"] for p in same)
     else:
@@ -326,6 +341,7 @@ def check_regression(trajectory: dict, fresh_value=None,
         cand_handoff = latest.get("handoff_recovery_ms")
         cand_ledger = latest.get("ledger_overhead_pct")
         cand_archive = latest.get("archive_overhead_pct")
+        cand_scaleup = latest.get("scale_up_ms")
         prior = same[:-1]
         if not prior:
             return {"ok": True, "reason": "single_point",
@@ -502,6 +518,27 @@ def check_regression(trajectory: dict, fresh_value=None,
         # record the point without gating, same posture as the others
         out["archive_overhead_pct"] = float(cand_archive)
         out["archive_overhead_floor"] = None
+    prior_scaleups = [p["scale_up_ms"] for p in prior
+                      if p.get("scale_up_ms") is not None]
+    if cand_scaleup is not None and prior_scaleups:
+        su_floor = min(prior_scaleups)
+        su_reg = ((float(cand_scaleup) - su_floor)
+                  / max(su_floor, 1.0) * 100.0)
+        out["scale_up_ms"] = float(cand_scaleup)
+        out["scale_up_floor"] = su_floor
+        out["scale_up_regression_pct"] = round(su_reg, 2)
+        if su_reg > threshold_pct:
+            out["ok"] = False
+            problems.append(
+                f"scale_up_ms regressed {su_reg:.1f}% past the "
+                f"{su_floor:.1f} ms floor "
+                f"(candidate {cand_scaleup:.1f} ms)")
+    elif cand_scaleup is not None:
+        # legacy archives (pre-control-plane rounds) carry no floor:
+        # record the point without gating, same posture as
+        # handoff_recovery_ms
+        out["scale_up_ms"] = float(cand_scaleup)
+        out["scale_up_floor"] = None
     return out
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -897,6 +934,87 @@ def measure_handoff_recovery(size=64, levels=2, seed=7):
     }
 
 
+def measure_scale_up(size=48, levels=1, seed=7, burst=8):
+    """Elastic scale-up point (`ia bench`'s ``scale_up_ms``).
+
+    An inproc fleet floored at ONE worker under a declarative
+    ControlPolicy (max 2, single pressure window, tight reconcile
+    cadence): a burst of distinct-style requests overruns
+    ``queue_high``, the control plane's reconcile loop spawns a second
+    worker, joins it to the ring, and (with a catalog armed) warm-stages
+    its share.  The headline is burst-admit -> the joined worker
+    reporting ready in fleet health — detection latency, spawn, and
+    ring join all inside the measured window, because that IS what an
+    elastic scale-up costs.  The run refuses to report a number whose
+    burst answers drifted from direct engine runs (``bit_identical``
+    gates), and fails loudly if the control plane never scaled.
+
+    ``size``/``levels``/``burst`` are parameters so tier-1 can run the
+    identical methodology at toy scale; the bench runs 48^2 x 8.
+    """
+    from image_analogies_tpu.config import AnalogyParams
+    from image_analogies_tpu.models.analogy import create_image_analogy
+    from image_analogies_tpu.serve.fleet import Fleet
+    from image_analogies_tpu.serve.policy import ControlPolicy
+    from image_analogies_tpu.serve.types import FleetConfig, ServeConfig
+
+    a, ap, b = make_structured(size, seed)
+    # one exemplar pair per request: distinct styles = distinct batch
+    # keys, so the consistent-hash ring actually spreads the burst over
+    # the grown fleet instead of pinning it to one home worker
+    styles = [(np.ascontiguousarray(np.roll(a, i + 1, axis=0)),
+               np.ascontiguousarray(np.roll(ap, i + 1, axis=0)))
+              for i in range(burst)]
+    params = AnalogyParams(levels=levels, kappa=5.0, backend="cpu")
+    baselines = [np.asarray(create_image_analogy(ai, api, b, params).bp)
+                 for ai, api in styles]
+
+    scfg = ServeConfig(params=params, queue_depth=64,
+                       batch_window_ms=4.0, max_batch=2, workers=1,
+                       cost_persist=False)
+    policy = ControlPolicy(min_workers=1, max_workers=2,
+                           queue_high=1.0, queue_low=0.1,
+                           scale_up_windows=1, scale_down_windows=1000,
+                           scale_up_cooldown_s=0.05,
+                           scale_down_cooldown_s=600.0)
+    fcfg = FleetConfig(serve=scfg, size=2, vnodes=16, policy=policy,
+                       health_interval_s=0.05)
+    with Fleet(fcfg) as fl:
+        # warm compile on the floor worker so the measured window is
+        # control-plane cost, not first-ever jit of the burst shape
+        fl.submit(a, ap, b, idempotency_key="bench-scaleup-warm"
+                  ).result(timeout=600)
+        t0 = time.perf_counter()
+        futs = [fl.submit(ai, api, b,
+                          idempotency_key=f"bench-scaleup-{i}")
+                for i, (ai, api) in enumerate(styles)]
+        scale_ms = None
+        end = time.monotonic() + 120.0
+        while time.monotonic() < end:
+            h = fl.health()
+            ready = sum(1 for w in h["workers"].values()
+                        if w.get("ok") and w.get("ready"))
+            if h["size"] >= 2 and ready >= 2:
+                scale_ms = (time.perf_counter() - t0) * 1e3
+                break
+            time.sleep(0.002)
+        results = [np.asarray(f.result(timeout=600).bp) for f in futs]
+        status = fl.health()["control"]
+    if scale_ms is None:
+        raise SystemExit("control plane never scaled up under burst — "
+                         "refusing to record scale_up_ms")
+    return {
+        "scale_up_ms": round(scale_ms, 1),
+        "burst": burst,
+        "last_verdict": (status.get("last_verdict") or {}).get("verdict"),
+        "control_events": status.get("events"),
+        "bit_identical": all(
+            np.array_equal(r, bl) for r, bl in zip(results, baselines)),
+        "size": size,
+        "levels": levels,
+    }
+
+
 def measure_exemplar_scaling(size=64, levels=2, seed=7,
                              scales=(1, 4, 16), reps=2):
     """Exemplar-DB scaling point (`ia bench --exemplar-scale`).
@@ -1194,6 +1312,17 @@ def main() -> int:
                          "engine run — refusing to record "
                          "handoff_recovery_ms")
 
+    # ---- elastic scale-up (PR 19): a burst overruns the declarative
+    # policy's pressure threshold; the headline is burst-admit -> the
+    # control plane's spawned worker joined and ready; bit-identity of
+    # every burst answer gates
+    scale_up = measure_scale_up()
+    configs["scale_up_48"] = scale_up
+    if not scale_up["bit_identical"]:
+        raise SystemExit("burst answers under autoscale drifted from "
+                         "direct engine runs — refusing to record "
+                         "scale_up_ms")
+
     # ---- configs 1/3/5 (BASELINE.json:7-12): texture-by-numbers,
     # super-res kappa sweep, batched video — live oracles at native sizes
     # (round-4 VERDICT item 6: the driver artifact must substantiate all
@@ -1419,6 +1548,7 @@ def main() -> int:
         "timeline_overhead_pct":
             timeline_overhead["timeline_overhead_pct"],
         "handoff_recovery_ms": handoff["handoff_recovery_ms"],
+        "scale_up_ms": scale_up["scale_up_ms"],
         "ledger_overhead_pct": ledger_overhead["ledger_overhead_pct"],
         "archive_overhead_pct":
             archive_overhead["archive_overhead_pct"],

@@ -421,10 +421,13 @@ def cmd_serve(args) -> int:
     if args.selftest is not None:
         from image_analogies_tpu.serve import loadgen
 
+        flash_crowd = (loadgen.parse_flash_crowd(args.flash_crowd)
+                       if args.flash_crowd else None)
         with _maybe_metrics_server(args):
             summary = loadgen.selftest(cfg, args.selftest, seed=args.seed,
                                        deadline_ms=deadline_ms,
-                                       zipf=args.zipf, styles=args.styles)
+                                       zipf=args.zipf, styles=args.styles,
+                                       flash_crowd=flash_crowd)
         print(loadgen.render(summary))
         print(json.dumps(summary, sort_keys=True), file=sys.stderr)
         return 0 if (summary["errors"] == 0
@@ -487,21 +490,36 @@ def cmd_fleet(args) -> int:
         cost_persist=False,
         journal_dir=None,  # per-worker dirs derive from journal_root
     )
+    # --policy FILE > --autoscale > static fleet.  With bare --autoscale
+    # the declarative defaults apply except the ceiling, which --size
+    # already names: the fleet breathes between the policy floor and the
+    # size the operator asked for.
+    policy = None
+    if args.policy:
+        from image_analogies_tpu.serve.policy import ControlPolicy
+        policy = ControlPolicy.load(args.policy)
+    elif args.autoscale:
+        from image_analogies_tpu.serve.policy import ControlPolicy
+        policy = ControlPolicy(max_workers=max(1, args.size))
     fcfg = FleetConfig(
         serve=scfg,
         size=args.size,
         journal_root=args.journal,
         wire=args.wire,
         transport=args.transport,
+        policy=policy,
     )
 
     if args.selftest is not None:
         from image_analogies_tpu.serve import loadgen
 
+        flash_crowd = (loadgen.parse_flash_crowd(args.flash_crowd)
+                       if args.flash_crowd else None)
         summary = loadgen.fleet_selftest(fcfg, args.selftest,
                                          seed=args.seed,
                                          zipf=args.zipf,
-                                         styles=args.styles)
+                                         styles=args.styles,
+                                         flash_crowd=flash_crowd)
         print(loadgen.render_fleet(summary))
         print(json.dumps(summary, sort_keys=True), file=sys.stderr)
         return 0 if (summary["errors"] == 0
@@ -830,6 +848,7 @@ def cmd_bench(args) -> int:
     fresh_handoff = None
     fresh_ledger = None
     fresh_archive = None
+    fresh_scaleup = None
     fresh_key = args.metric_key
     if args.value is not None:
         fresh = args.value
@@ -859,6 +878,8 @@ def cmd_bench(args) -> int:
                 fresh_ledger = float(doc["ledger_overhead_pct"])
             if doc.get("archive_overhead_pct") is not None:
                 fresh_archive = float(doc["archive_overhead_pct"])
+            if doc.get("scale_up_ms") is not None:
+                fresh_scaleup = float(doc["scale_up_ms"])
         else:
             head = bench.extract_headline(doc if isinstance(doc, dict)
                                           else {})
@@ -875,6 +896,7 @@ def cmd_bench(args) -> int:
             fresh_handoff = head.get("handoff_recovery_ms")
             fresh_ledger = head.get("ledger_overhead_pct")
             fresh_archive = head.get("archive_overhead_pct")
+            fresh_scaleup = head.get("scale_up_ms")
             if fresh_key is None:
                 fresh_key = head.get("metric_key")
     verdict = bench.check_regression(trajectory, fresh_value=fresh,
@@ -887,7 +909,8 @@ def cmd_bench(args) -> int:
                                      fresh_timeline=fresh_timeline,
                                      fresh_handoff=fresh_handoff,
                                      fresh_ledger=fresh_ledger,
-                                     fresh_archive=fresh_archive)
+                                     fresh_archive=fresh_archive,
+                                     fresh_scaleup=fresh_scaleup)
     print(json.dumps(verdict, sort_keys=True))
     for problem in verdict.get("problems", []):
         print(f"bench: warning: {problem}", file=sys.stderr)
@@ -968,10 +991,36 @@ def cmd_top(args) -> int:
     url = args.url.rstrip("/") + "/timeline"
     if args.window is not None:
         url += f"?window={args.window:g}"
+    health_url = args.url.rstrip("/") + "/healthz"
 
     def fetch():
         with urllib.request.urlopen(url, timeout=5) as resp:
             return json.loads(resp.read().decode())
+
+    def fleet_line():
+        # Best-effort elastic-fleet banner from /healthz: live size vs
+        # configured, the control plane's last verdict, and how to
+        # attribute it.  Single-server fronts (no "control" section)
+        # and fetch failures render nothing.
+        try:
+            with urllib.request.urlopen(health_url, timeout=5) as resp:
+                doc = json.loads(resp.read().decode())
+        except (OSError, ValueError, urllib.error.URLError):
+            return ""
+        ctl = doc.get("control") if isinstance(doc, dict) else None
+        if not isinstance(ctl, dict):
+            return ""
+        line = (f"fleet: size={ctl.get('size', '?')}"
+                f"/{doc.get('configured_size', '?')} "
+                f"autoscale={'on' if ctl.get('autoscale') else 'off'}")
+        last = ctl.get("last_verdict")
+        if isinstance(last, dict):
+            line += (f"  last={last.get('verdict', '?')}"
+                     f"({last.get('cause', '?')}) "
+                     f"{last.get('worker', '?')} "
+                     f"— ia why ctl-{last.get('verdict', '?')}-"
+                     f"{last.get('worker', '?')}")
+        return line + "\n"
 
     if args.once:
         try:
@@ -979,12 +1028,13 @@ def cmd_top(args) -> int:
         except (OSError, ValueError, urllib.error.URLError) as exc:
             print(f"top: cannot fetch {url}: {exc}", file=sys.stderr)
             return 2
-        print(obs_timeline.render_cockpit(doc))
+        print(fleet_line() + obs_timeline.render_cockpit(doc))
         return 0
     try:
         while True:
             try:
-                frame = obs_timeline.render_cockpit(fetch())
+                frame = (fleet_line()
+                         + obs_timeline.render_cockpit(fetch()))
             except (OSError, ValueError,
                     urllib.error.URLError) as exc:
                 frame = f"top: cannot fetch {url}: {exc}"
@@ -1363,6 +1413,12 @@ def build_parser() -> argparse.ArgumentParser:
                          "style dominating) instead of cycling shapes")
     sv.add_argument("--styles", type=int, default=0,
                     help="style count for --zipf (default 8)")
+    sv.add_argument("--flash-crowd", default=None, metavar="T0,DUR,MULT",
+                    help="selftest arrival shape: Poisson arrivals whose "
+                         "rate multiplies by MULT inside [T0, T0+DUR) "
+                         "seconds — a flash-crowd surge, deterministic "
+                         "from --seed (the same generator the chaos "
+                         "flash_crowd drill replays)")
     sv.add_argument("--archive", default=None, metavar="DIR",
                     help="durable telemetry archive root: closed timeline "
                          "windows, tenant cost vectors, decision records "
@@ -1419,6 +1475,23 @@ def build_parser() -> argparse.ArgumentParser:
                          "(see ia serve --zipf)")
     fp.add_argument("--styles", type=int, default=0,
                     help="style count for --zipf (default 8)")
+    fp.add_argument("--flash-crowd", default=None, metavar="T0,DUR,MULT",
+                    help="selftest arrival shape: Poisson arrivals whose "
+                         "rate multiplies by MULT inside [T0, T0+DUR) "
+                         "seconds (see ia serve --flash-crowd)")
+    fp.add_argument("--autoscale", action="store_true",
+                    help="arm the elastic control plane with the default "
+                         "declarative policy (--size becomes the "
+                         "ceiling): the fleet starts at the policy floor "
+                         "and the reconcile loop grows/shrinks it on "
+                         "observed queue depth, SLO burn, and breaker "
+                         "state — every verdict lands in the decision "
+                         "plane (`ia why ctl-<verdict>-<wid>`)")
+    fp.add_argument("--policy", default=None, metavar="FILE",
+                    help="ControlPolicy JSON file (implies autoscaling): "
+                         "min/max workers, pressure/calm thresholds, "
+                         "hysteresis window counts, per-direction "
+                         "cooldowns; unknown keys are rejected")
     fp.add_argument("--seed", type=int, default=0)
     _add_engine_flags(fp)
     fp.set_defaults(fn=cmd_fleet)
@@ -1436,8 +1509,8 @@ def build_parser() -> argparse.ArgumentParser:
                     help="one canonical drill per kind "
                          "(transient, oom, latency, corrupt, crash, "
                          "process_death, fleet_death, batch_partial, "
-                         "devcache_tier, ann_corrupt) plus the "
-                         "same-seed schedule-determinism check")
+                         "devcache_tier, ann_corrupt, flash_crowd) plus "
+                         "the same-seed schedule-determinism check")
     ch.add_argument("--kinds", default=None,
                     help="comma-separated fault-kind subset for "
                          "--selftest (default: all)")

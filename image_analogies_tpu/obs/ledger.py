@@ -159,6 +159,16 @@ def tenants_doc() -> Dict[str, Any]:
     return led.tenants_doc()
 
 
+def record_throttle(tenant: str) -> None:
+    """Producer fast path for a quota refusal: one bool check when
+    disarmed, else a sketch offer + per-tenant THROTTLE increment."""
+    if not _ARMED:
+        return
+    led = _LEDGER
+    if led is not None and tenant:
+        led.tenants.throttle(str(tenant))
+
+
 def sample_timeline() -> None:
     """Mirror tracked tenants into the armed timeline store as
     ``tenant:<sha1[:8]>``-labeled series.  Counters/histograms are
@@ -221,8 +231,8 @@ def render_tenants(doc: Dict[str, Any], title: str = "tenants") -> str:
                      "metering plane on)")
         return "\n".join(lines) + "\n"
     lines.append(f"  {'TENANT':<14}{'REQS':>7}{'QPS':>10}{'P95MS':>9}"
-                 f"{'COST%':>7}{'DEGR':>6}{'RETRY':>6}{'ERR':>5}"
-                 f"{'±ERR':>6}")
+                 f"{'COST%':>7}{'DEGR':>6}{'RETRY':>6}{'THROT':>6}"
+                 f"{'ERR':>5}{'±ERR':>6}")
     for row in doc.get("tenants", []):
         lines.append(
             f"  {str(row.get('tenant', '?'))[:12]:<14}"
@@ -232,6 +242,7 @@ def render_tenants(doc: Dict[str, Any], title: str = "tenants") -> str:
             f"{100.0 * (row.get('cost_share') or 0.0):>6.1f}%"
             f"{row.get('degraded', 0):>6}"
             f"{row.get('retries', 0):>6}"
+            f"{row.get('throttled', 0):>6}"
             f"{row.get('errors', 0):>5}"
             f"{row.get('count_error', 0.0):>6.0f}")
     if not doc.get("tenants"):

@@ -104,8 +104,8 @@ class SpaceSaving:
 
 def _blank_stats() -> Dict[str, Any]:
     return {"requests": 0, "errors": 0, "degraded": 0, "retries": 0,
-            "dispatch_ms": 0.0, "queue_ms": 0.0, "lanes": 0,
-            "wire_bytes": 0, "latency": Histogram()}
+            "throttled": 0, "dispatch_ms": 0.0, "queue_ms": 0.0,
+            "lanes": 0, "wire_bytes": 0, "latency": Histogram()}
 
 
 class TenantTracker:
@@ -141,6 +141,20 @@ class TenantTracker:
             st["wire_bytes"] += wire_bytes
             st["latency"].observe(latency_ms)
 
+    def throttle(self, tenant: str) -> None:
+        """Record one quota refusal for *tenant*.  A throttle is NOT a
+        request observation (no latency, no cost) — but it does count
+        toward the sketch, so a tenant seen only through refusals still
+        shows up in the top-K with its THROTTLE tally."""
+        with self._lock:
+            evicted = self._ss.offer(tenant)
+            if evicted is not None:
+                self._stats.pop(evicted, None)
+            st = self._stats.get(tenant)
+            if st is None:
+                st = self._stats[tenant] = _blank_stats()
+            st["throttled"] += 1
+
     def merge(self, other: "TenantTracker") -> None:
         with other._lock:
             ss_copy, stats_copy = _copy_locked(other)
@@ -155,8 +169,8 @@ class TenantTracker:
                     self._stats[tenant] = st
                     continue
                 for f in ("requests", "errors", "degraded", "retries",
-                          "lanes", "wire_bytes"):
-                    mine[f] += st[f]
+                          "throttled", "lanes", "wire_bytes"):
+                    mine[f] += st.get(f, 0)
                 for f in ("dispatch_ms", "queue_ms"):
                     mine[f] += st[f]
                 mine["latency"].merge(st["latency"])
@@ -183,6 +197,7 @@ class TenantTracker:
                     "errors": st["errors"],
                     "degraded": st["degraded"],
                     "retries": st["retries"],
+                    "throttled": st.get("throttled", 0),
                     "lanes": st["lanes"],
                     "wire_bytes": st["wire_bytes"],
                     "dispatch_ms": round(st["dispatch_ms"], 3),
@@ -235,8 +250,8 @@ def merge_docs(docs: List[Dict[str, Any]],
                                                    or {})}
                 continue
             for f in ("count", "count_error", "requests", "errors",
-                      "degraded", "retries", "lanes", "wire_bytes",
-                      "dispatch_ms", "queue_ms"):
+                      "degraded", "retries", "throttled", "lanes",
+                      "wire_bytes", "dispatch_ms", "queue_ms"):
                 cur[f] = (cur.get(f) or 0) + (row.get(f) or 0)
             h = Histogram.from_summary(cur.get("latency") or {})
             h.merge(Histogram.from_summary(row.get("latency") or {}))

@@ -51,6 +51,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import hashlib
 import os
 import threading
 import time
@@ -72,6 +73,7 @@ from image_analogies_tpu.serve import transport as serve_transport
 from image_analogies_tpu.serve.transport import (  # noqa: F401
     CrashLoopSupervisor, WorkerHandle, _roundtrip_iaf2, _roundtrip_json,
     _wrap_response)
+from image_analogies_tpu.serve.control import ControlPlane
 from image_analogies_tpu.serve.router import Router
 from image_analogies_tpu.serve.types import FleetConfig, Rejected, Response
 
@@ -98,6 +100,10 @@ class Fleet:
                              backoff_s=cfg.backoff_s,
                              backoff_cap_s=cfg.backoff_cap_s,
                              decision_log=self.decisions)
+        # Control plane (serve/control.py): owns the per-worker gate
+        # verdict always, and the autoscaling reconcile pass when a
+        # declarative policy is attached.
+        self.control = ControlPlane(self, cfg.policy)
         self.handoffs: List[Dict[str, Any]] = []
         self._gates: Dict[str, str] = {}   # wid -> reason
         self._misses: Dict[str, int] = {}
@@ -147,12 +153,17 @@ class Fleet:
         # The fleet's own run scope (joins an ambient drill/test run
         # reentrantly): router counters written from caller threads
         # resolve here, and every worker scope chains into it.
+        # With an autoscaling policy the fleet breathes: start at the
+        # policy floor and let the control plane grow it under load.
+        initial = (self.cfg.policy.min_workers if self.cfg.policy
+                   else self.cfg.size)
         self._scope_exit.enter_context(obs_trace.run_scope(
             self.cfg.serve.params.replace(metrics=True),
-            manifest_extra={"fleet": {"size": self.cfg.size,
+            manifest_extra={"fleet": {"size": initial,
                                       "wire": self.cfg.wire,
                                       "vnodes": self.cfg.vnodes,
-                                      "transport": self.cfg.transport}}))
+                                      "transport": self.cfg.transport,
+                                      "autoscale": bool(self.cfg.policy)}}))
         self._scope = obs_metrics.current_scope()
         # Temporal plane: the health loop below is the fleet's sampling
         # cadence — arm the process timeline for the fleet's lifetime so
@@ -168,7 +179,7 @@ class Fleet:
         if archive_root:
             obs_archive.arm(root=archive_root)
         obs_ceilings.arm(decision_log=self.decisions)
-        for i in range(self.cfg.size):
+        for i in range(initial):
             wid = "w{}".format(i)
             self._spawn(wid, generation=0)
             self.router.ring.add(wid)
@@ -234,17 +245,18 @@ class Fleet:
         self.supervisor.reset(wid)
 
     def forward(self, wid: str, a, ap, b, params,
-                deadline_s: Optional[float], idem: Optional[str]
-                ) -> "Future[Response]":
+                deadline_s: Optional[float], idem: Optional[str],
+                priority: int = 2) -> "Future[Response]":
         """One router->worker hop through the transport handle: request
         planes AND the trace context through the negotiated codec,
         submit, response planes back through the codec."""
         return self.workers[wid].forward(a, ap, b, params, deadline_s,
-                                         idem)
+                                         idem, priority=priority)
 
     def submit(self, a, ap, b, params=None, deadline_s=None,
                idempotency_key=None,
-               wire_bytes: int = 0) -> "Future[Response]":
+               wire_bytes: int = 0, priority: int = 2
+               ) -> "Future[Response]":
         """Client entry point — delegates to the router.  ``wire_bytes``
         (the fleet HTTP front end's body size) is accepted for submit_fn
         signature parity; the router->worker hop measures its own frame
@@ -252,33 +264,23 @@ class Fleet:
         del wire_bytes
         return self.router.submit(a, ap, b, params=params,
                                   deadline_s=deadline_s,
-                                  idempotency_key=idempotency_key)
+                                  idempotency_key=idempotency_key,
+                                  priority=priority)
 
     # ------------------------------------------------------------------
     # health gate loop
 
     def _judge(self, handle) -> Optional[str]:
-        """None = healthy; "dead" = missed; else a gate reason."""
+        """None = healthy; "dead" = missed; else a gate reason.  The
+        judgement itself moved to the control plane
+        (ControlPlane.gate_verdict); this shim fetches the health doc
+        and keeps the historical handle-facing surface."""
         try:
             h = handle.health()
         except Exception:  # noqa: BLE001 - unresponsive counts as dead
-            return "dead"
-        workers = h.get("workers") or {}
-        if not h.get("accepting") or workers.get("alive", 0) == 0:
-            return "dead"
-        if h.get("recovering"):
-            # Alive but not READY: journal replay in flight.  Liveness
-            # gates the death verdict, and no advisory gate either —
-            # spilling keys whose replay is about to answer them would
-            # double-compute work the journal already holds.
-            return None
-        breakers = h.get("breakers") or {}
-        if any(state == "open" for state in breakers.values()):
-            return "breaker_open"
-        depth_gate = self.cfg.spill_queue_frac * self.cfg.serve.queue_depth
-        if h.get("queue_depth", 0) >= depth_gate:
-            return "saturated"
-        return None
+            h = None
+        control = getattr(self, "control", None) or ControlPlane(self)
+        return control.gate_verdict(h)
 
     def _scrape_locked(self, wid: str, handle) -> None:
         """Cache a metrics snapshot of the worker's registry (lock held).
@@ -327,8 +329,23 @@ class Fleet:
             return None
         return float(total)
 
+    @staticmethod
+    def _poll_phase(wid: str) -> float:
+        """Deterministic per-worker fraction of the poll interval.
+
+        N workers polled back-to-back at a fixed cadence scrape (and,
+        over the subprocess transport, hit /healthz) in lockstep — a
+        thundering herd that grows with the fleet.  Hashing the wid
+        spreads the polls across the interval, stably per worker, with
+        no shared state and no RNG."""
+        digest = hashlib.sha256(wid.encode()).digest()
+        return int.from_bytes(digest[:4], "big") / 2.0 ** 32
+
     def _health_loop(self) -> None:
-        while not self._stop.wait(self.cfg.health_interval_s):
+        interval = self.cfg.health_interval_s
+        if self._stop.wait(interval):
+            return
+        while True:
             if self._scope is not None:
                 # Fleet-level series (router.* live only here) sampled
                 # unlabeled, alongside the worker-labeled ones below.
@@ -344,7 +361,17 @@ class Fleet:
             obs_archive.sample()
             obs_ceilings.sample(extra={
                 "journal.bytes": self._journal_bytes()})
-            for wid in list(self.workers):
+            # Jittered per-worker polls: visit workers in phase order,
+            # sleeping the phase gap between them, so one pass still
+            # takes ~interval but no two workers scrape in lockstep.
+            healths: Dict[str, Optional[Dict[str, Any]]] = {}
+            elapsed = 0.0
+            for wid in sorted(list(self.workers), key=self._poll_phase):
+                gap = self._poll_phase(wid) * interval - elapsed
+                if gap > 0:
+                    if self._stop.wait(gap):
+                        return
+                    elapsed += gap
                 if self._stop.is_set():
                     return
                 handle = self.workers.get(wid)
@@ -356,7 +383,12 @@ class Fleet:
                         # respawns, until an operator ungates.
                         continue
                     self._scrape_locked(wid, handle)
-                verdict = self._judge(handle)
+                try:
+                    h = handle.health()
+                except Exception:  # noqa: BLE001 - unresponsive = dead
+                    h = None
+                healths[wid] = h
+                verdict = self.control.gate_verdict(h)
                 if verdict == "dead":
                     with self._lock:
                         self._misses[wid] = self._misses.get(wid, 0) + 1
@@ -373,6 +405,17 @@ class Fleet:
                         self._gates.pop(wid, None)
                     else:
                         self._gates[wid] = verdict
+            # Autoscaling pass (no-op without a policy): the control
+            # plane compares this pass's observed signals against the
+            # declarative targets and spawns/retires through the
+            # fleet's own primitives.
+            if self.control.policy is not None:
+                try:
+                    self.control.reconcile(healths)
+                except Exception:  # noqa: BLE001 - keep the loop alive
+                    obs_metrics.inc("control.reconcile_errors")
+            if self._stop.wait(max(0.0, interval - elapsed)):
+                return
 
     # ------------------------------------------------------------------
     # death + journal handoff
@@ -552,12 +595,16 @@ class Fleet:
                                 "obs": self._worker_obs(wid, handle)}
         return {
             "ok": all(w.get("ok") for w in workers.values()),
-            "size": self.cfg.size,
+            # Live size: with an autoscaling policy the fleet breathes,
+            # so /healthz reports what exists, not what was configured.
+            "size": len(self.workers),
+            "configured_size": self.cfg.size,
             "wire": self.cfg.wire,
             "transport": self.cfg.transport,
             "ring": {"members": self.router.ring.members(),
                      "vnodes": self.cfg.vnodes},
             "pending": self.router.pending_count(),
             "handoffs": len(self.handoffs),
+            "control": self.control.status(),
             "workers": workers,
         }

@@ -61,6 +61,7 @@ from image_analogies_tpu.obs import metrics as obs_metrics
 from image_analogies_tpu.obs import timeline as obs_timeline
 from image_analogies_tpu.obs import trace as obs_trace
 from image_analogies_tpu.serve import journal as serve_journal
+from image_analogies_tpu.serve import policy as serve_policy
 from image_analogies_tpu.serve import wire
 from image_analogies_tpu.serve.server import Server
 from image_analogies_tpu.serve.types import DeadlineExceeded, Rejected
@@ -224,6 +225,7 @@ def _make_handler_from(health_fn, submit_fn, refresh_fn, metrics_fn=None,
                     params_doc = self.headers.get("X-IA-Params")
                     params_doc = json.loads(params_doc) \
                         if params_doc else None
+                    priority = self.headers.get("X-IA-Priority")
                 else:
                     req = json.loads(body or b"{}")
                     a = np.asarray(req["a"], dtype=np.float32)
@@ -232,6 +234,19 @@ def _make_handler_from(health_fn, submit_fn, refresh_fn, metrics_fn=None,
                     deadline_ms = req.get("deadline_ms")
                     idem = req.get("idempotency_key")
                     params_doc = req.get("params")
+                    priority = req.get("priority")
+                # Priority class: an int weight or a class name
+                # ("interactive"); absent/garbage degrades to standard
+                # rather than erroring — priority is advisory.
+                if isinstance(priority, str) and \
+                        priority in serve_policy.PRIORITY_CLASSES:
+                    priority = serve_policy.PRIORITY_CLASSES[priority]
+                try:
+                    priority = max(1, int(priority)) \
+                        if priority is not None \
+                        else serve_policy.PRIORITY_STANDARD
+                except (TypeError, ValueError):
+                    priority = serve_policy.PRIORITY_STANDARD
                 params = None
                 if params_doc is not None:
                     from image_analogies_tpu.serve import transport \
@@ -270,7 +285,8 @@ def _make_handler_from(health_fn, submit_fn, refresh_fn, metrics_fn=None,
                         deadline_s=None if deadline_ms is None
                         else float(deadline_ms) / 1e3,
                         idempotency_key=idem,
-                        wire_bytes=len(body)).result()
+                        wire_bytes=len(body),
+                        priority=priority).result()
             except Rejected as exc:
                 self._reply(429, {"error": "rejected", "reason": exc.reason},
                             headers=trace_headers)

@@ -77,6 +77,54 @@ def make_load(n: int, shapes: Sequence[Tuple[int, int]], seed: int, *,
     return load
 
 
+def parse_flash_crowd(spec: str) -> Dict[str, float]:
+    """Parse ``--flash-crowd T0,DURATION,MULT``: at T0 seconds into the
+    run the arrival rate multiplies by MULT for DURATION seconds, then
+    falls back to the base rate — the canonical flash-crowd shape the
+    autoscaling drill and ``ia bench`` share."""
+    parts = [p.strip() for p in str(spec).split(",")]
+    if len(parts) != 3:
+        raise ValueError("--flash-crowd expects T0,DURATION,MULT "
+                         "(e.g. 0.5,2.0,8)")
+    t0, duration, mult = (float(p) for p in parts)
+    if t0 < 0:
+        raise ValueError("flash-crowd T0 must be >= 0")
+    if duration <= 0:
+        raise ValueError("flash-crowd DURATION must be > 0")
+    if mult < 1:
+        raise ValueError("flash-crowd MULT must be >= 1")
+    return {"t0": t0, "duration": duration, "mult": mult}
+
+
+def arrival_schedule(n: int, *, t0: float, duration: float, mult: float,
+                     base_rps: float = 50.0, seed: int = 0) -> List[float]:
+    """Deterministic arrival offsets (seconds from run start) for a
+    flash-crowd load: Poisson arrivals at ``base_rps``, multiplied by
+    ``mult`` inside the ``[t0, t0+duration)`` surge window.  One seed
+    fixes the whole schedule, so the chaos drill and ``ia bench``
+    replay the exact same traffic."""
+    # Offset the seed stream from make_load's so pacing never reuses
+    # the bytes that drew the request contents.
+    rng = np.random.RandomState((int(seed) + 0x9E37) & 0x7FFFFFFF)
+    t = 0.0
+    out: List[float] = []
+    for _ in range(max(0, int(n))):
+        rate = base_rps * (mult if t0 <= t < t0 + duration else 1.0)
+        t += float(rng.exponential(1.0 / max(rate, 1e-9)))
+        out.append(t)
+    return out
+
+
+def _pace(sched: Optional[List[float]], idx: int, t_start: float) -> None:
+    """Sleep until request ``idx``'s scheduled arrival (no-op without a
+    schedule)."""
+    if sched is None:
+        return
+    delay = sched[idx] - (time.perf_counter() - t_start)
+    if delay > 0:
+        time.sleep(delay)
+
+
 def style_hist(load: List[Dict[str, Any]]) -> Optional[Dict[str, int]]:
     """Per-style request counts of a zipf load (None for classic loads)."""
     if not load or "style" not in load[0]:
@@ -91,7 +139,8 @@ def style_hist(load: List[Dict[str, Any]]) -> Optional[Dict[str, int]]:
 def selftest(cfg: ServeConfig, n: int, *, seed: int = 0,
              deadline_ms: Optional[Any] = None,
              shapes: Sequence[Tuple[int, int]] = DEFAULT_SHAPES,
-             zipf: Optional[float] = None, styles: int = 0
+             zipf: Optional[float] = None, styles: int = 0,
+             flash_crowd: Optional[Dict[str, float]] = None
              ) -> Dict[str, Any]:
     """Run the synthetic load end-to-end; returns the summary dict.
 
@@ -104,6 +153,8 @@ def selftest(cfg: ServeConfig, n: int, *, seed: int = 0,
     from image_analogies_tpu.obs import metrics as obs_metrics
 
     load = make_load(n, shapes, seed, zipf=zipf, styles=styles)
+    sched = (arrival_schedule(n, seed=seed, **flash_crowd)
+             if flash_crowd else None)
 
     def deadline_s(i: int) -> Optional[float]:
         if deadline_ms is None:
@@ -132,6 +183,7 @@ def selftest(cfg: ServeConfig, n: int, *, seed: int = 0,
         t0 = time.perf_counter()
         futures = {}
         for item in load:
+            _pace(sched, item["index"], t0)
             try:
                 futures[item["index"]] = srv.submit(
                     item["a"], item["ap"], item["b"],
@@ -213,13 +265,15 @@ def selftest(cfg: ServeConfig, n: int, *, seed: int = 0,
         "journal": journal_stats,
         "zipf": zipf,
         "style_hist": style_hist(load),
+        "flash_crowd": flash_crowd,
     }
 
 
 def fleet_selftest(fcfg: "Any", n: int, *, seed: int = 0,
                    deadline_ms: Optional[Any] = None,
                    shapes: Sequence[Tuple[int, int]] = DEFAULT_SHAPES,
-                   zipf: Optional[float] = None, styles: int = 0
+                   zipf: Optional[float] = None, styles: int = 0,
+                   flash_crowd: Optional[Dict[str, float]] = None
                    ) -> Dict[str, Any]:
     """``ia fleet --selftest N``: the synthetic load routed through the
     consistent-hash Router over a worker fleet, against the same
@@ -232,6 +286,8 @@ def fleet_selftest(fcfg: "Any", n: int, *, seed: int = 0,
     from image_analogies_tpu.serve.fleet import Fleet
 
     load = make_load(n, shapes, seed, zipf=zipf, styles=styles)
+    sched = (arrival_schedule(n, seed=seed, **flash_crowd)
+             if flash_crowd else None)
 
     def deadline_s(i: int) -> Optional[float]:
         if deadline_ms is None:
@@ -256,6 +312,7 @@ def fleet_selftest(fcfg: "Any", n: int, *, seed: int = 0,
         t0 = time.perf_counter()
         futures = {}
         for item in load:
+            _pace(sched, item["index"], t0)
             try:
                 futures[item["index"]] = fl.submit(
                     item["a"], item["ap"], item["b"],
@@ -315,6 +372,8 @@ def fleet_selftest(fcfg: "Any", n: int, *, seed: int = 0,
         "bit_identical": bool(identical),
         "zipf": zipf,
         "style_hist": style_hist(load),
+        "flash_crowd": flash_crowd,
+        "control": health.get("control"),
     }
 
 
@@ -344,6 +403,14 @@ def render_fleet(summary: Dict[str, Any]) -> str:
     if summary.get("style_hist"):
         lines.insert(-1, f"  styles:     zipf S={summary['zipf']} -> "
                      f"{summary['style_hist']}")
+    if summary.get("flash_crowd"):
+        fc = summary["flash_crowd"]
+        lines.insert(-1, f"  flash crowd: x{fc['mult']} surge at "
+                     f"t0={fc['t0']}s for {fc['duration']}s")
+    ctl = summary.get("control")
+    if ctl and ctl.get("autoscale"):
+        lines.insert(-1, f"  autoscale:  fleet size {ctl.get('size')}"
+                     f" (last verdict: {ctl.get('last_verdict')})")
     return "\n".join(lines)
 
 

@@ -13,6 +13,19 @@ ahead of slack FIFO traffic instead of timing out behind it.  Starvation
 is bounded, not assumed away: once the OLDEST waiter has queued longer
 than ``age_bound_s`` it leads regardless of deadlines, so undeadlined
 traffic always makes progress.
+
+With a :class:`~.policy.QosPolicy` that arms ``weighted_fair``, the
+leader pick becomes stride-scheduled across TENANTS (tenant = style =
+the batch key's exemplar sha1): each tenant holds a running "pass"
+value, the waiting tenant with the smallest pass leads, and its pass
+advances by ``1 / priority`` of the picked request — so an
+``interactive`` request (weight 4) costs its tenant a quarter of a
+``background`` step, and a viral style with a thousand waiters still
+only gets its fair share of leaders.  The aging bound applies on top
+(a waiter older than ``age_bound_s`` leads unconditionally), and
+same-key coalescing after the leader is unchanged — followers share
+the leader's key, hence its tenant.  Without a policy the pick is
+byte-identical to the pre-QoS queue.
 """
 
 from __future__ import annotations
@@ -20,19 +33,31 @@ from __future__ import annotations
 import collections
 import threading
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from image_analogies_tpu import chaos
 from image_analogies_tpu.obs import metrics as obs_metrics
+from image_analogies_tpu.serve.policy import QosPolicy
 from image_analogies_tpu.serve.types import Rejected, Request
+
+
+def _tenant(req: Request) -> str:
+    """Tenant identity = the batch key's exemplar sha1 (the same
+    derivation the cost ledger uses in serve/worker.py)."""
+    return str(req.key[-1]) if req.key else ""
 
 
 class AdmissionQueue:
     def __init__(self, depth: int, deadline_ordering: bool = False,
-                 age_bound_s: float = 5.0):
+                 age_bound_s: float = 5.0,
+                 qos: Optional[QosPolicy] = None):
         self._depth = depth
         self._deadline_ordering = deadline_ordering
         self._age_bound_s = age_bound_s
+        self._weighted_fair = bool(qos and qos.weighted_fair)
+        # Stride-scheduling pass values, kept only for tenants with
+        # waiters (bounded by queue depth; pruned on every pick).
+        self._passes: Dict[str, float] = {}
         self._items: collections.deque[Request] = collections.deque()
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -70,6 +95,8 @@ class AdmissionQueue:
         oldest waiter has aged past the bound — then it leads no matter
         what, so EDF reordering can delay it by at most the bound.
         """
+        if self._weighted_fair and len(self._items) > 1:
+            return self._take_leader_wf()
         if not self._deadline_ordering or len(self._items) == 1:
             return self._items.popleft()
         now = time.monotonic()
@@ -85,10 +112,59 @@ class AdmissionQueue:
                           if self._items[i].deadline is not None
                           else float("inf"),
                           self._items[i].t_submit))
+        return self._pop_at(idx)
+
+    def _pop_at(self, idx: int) -> Request:
+        """Remove and return item ``idx`` (lock held) via the rotate
+        trick — deque has no O(1) mid-removal, but leaders are near the
+        front in practice."""
         self._items.rotate(-idx)
         leader = self._items.popleft()
         self._items.rotate(idx)
         return leader
+
+    def _best_of(self, indices: List[int]) -> int:
+        """EDF (when armed) else arrival order, within one tenant's
+        waiting indices (lock held)."""
+        if not self._deadline_ordering:
+            return min(indices, key=lambda i: self._items[i].t_submit)
+        return min(indices, key=lambda i: (
+            self._items[i].deadline
+            if self._items[i].deadline is not None else float("inf"),
+            self._items[i].t_submit))
+
+    def _take_leader_wf(self) -> Request:
+        """Stride-scheduled leader pick across tenants (lock held).
+
+        The aging bound still trumps fairness — a waiter older than
+        ``age_bound_s`` leads no matter whose turn it is, so weighted
+        fairness can reorder, never starve."""
+        now = time.monotonic()
+        oldest = min(range(len(self._items)),
+                     key=lambda i: self._items[i].t_submit)
+        if now - self._items[oldest].t_submit > self._age_bound_s:
+            obs_metrics.inc("serve.aging_promotions")
+            return self._pop_at(oldest)
+        waiting: Dict[str, List[int]] = {}
+        for i, req in enumerate(self._items):
+            waiting.setdefault(_tenant(req), []).append(i)
+        # New tenants join at the current floor: no credit for having
+        # been absent, no penalty for being late to the party.
+        floor = min((self._passes[t] for t in waiting
+                     if t in self._passes), default=0.0)
+        for t in waiting:
+            self._passes.setdefault(t, floor)
+        tenant = min(waiting, key=lambda t: (self._passes[t],
+                                             min(waiting[t])))
+        idx = self._best_of(waiting[tenant])
+        leader = self._items[idx]
+        self._passes[tenant] += 1.0 / max(1, int(leader.priority))
+        # Prune pass state to tenants that still have waiters, so the
+        # dict is bounded by queue depth, not tenant-lifetime history.
+        self._passes = {t: v for t, v in self._passes.items()
+                        if t in waiting}
+        obs_metrics.inc("serve.wf_picks")
+        return self._pop_at(idx)
 
     def pop_batch(self, max_batch: int, window_s: float) -> Optional[List[Request]]:
         """Return a batch of same-key requests, or None when closed+empty.

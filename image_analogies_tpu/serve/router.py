@@ -100,15 +100,18 @@ class Ring:
 class _Pending:
     """One in-flight routed request: enough to re-submit by idem key."""
 
-    __slots__ = ("idem", "wid", "future", "payload", "deadline_s")
+    __slots__ = ("idem", "wid", "future", "payload", "deadline_s",
+                 "priority")
 
     def __init__(self, idem: str, wid: str, future: "Future[Response]",
-                 payload: Tuple[Any, ...], deadline_s: Optional[float]):
+                 payload: Tuple[Any, ...], deadline_s: Optional[float],
+                 priority: int = 2):
         self.idem = idem
         self.wid = wid
         self.future = future
         self.payload = payload
         self.deadline_s = deadline_s
+        self.priority = priority
 
 
 def _resolve(fut: "Future[Response]", src: "Future[Response]") -> None:
@@ -162,8 +165,8 @@ class Router:
 
     def submit(self, a: np.ndarray, ap: np.ndarray, b: np.ndarray,
                params=None, deadline_s: Optional[float] = None,
-               idempotency_key: Optional[str] = None
-               ) -> "Future[Response]":
+               idempotency_key: Optional[str] = None,
+               priority: int = 2) -> "Future[Response]":
         """Route one request to its ring home (spilling as needed) and
         return a router-owned Future chained to the worker's."""
         if (idempotency_key is not None
@@ -181,8 +184,10 @@ class Router:
         # downstream worker's spans share one trace id: adopt the
         # caller's (the HTTP hop set it from X-IA-Trace) or mint here.
         with obs_trace.ensure_trace("router_submit", origin_request=idem):
-            wid, src = self._route(kstr, idem, payload, deadline_s)
-        ent = _Pending(idem, wid, fut, payload, deadline_s)
+            wid, src = self._route(kstr, idem, payload, deadline_s,
+                                   priority=priority)
+        ent = _Pending(idem, wid, fut, payload, deadline_s,
+                       priority=priority)
         with self._lock:
             self._pending[idem] = ent
         self._chain(src, ent)
@@ -204,7 +209,7 @@ class Router:
         return order[0] if order else None
 
     def _route(self, kstr: str, idem: str, payload: Tuple[Any, ...],
-               deadline_s: Optional[float]
+               deadline_s: Optional[float], priority: int = 2
                ) -> Tuple[str, "Future[Response]"]:
         """Walk ring successors with capped jittered backoff until one
         worker accepts the forward."""
@@ -240,7 +245,8 @@ class Router:
             try:
                 chaos.site("router.forward", worker=wid, key=kstr)
                 src = self._fleet.forward(wid, a, ap, b, p,
-                                          deadline_s, idem)
+                                          deadline_s, idem,
+                                          priority=priority)
                 obs_metrics.inc("router.routed.{}".format(wid))
                 obs_trace.emit_record({"event": "router_route",
                                        "idem": idem, "worker": wid,
@@ -249,9 +255,13 @@ class Router:
             except chaos.ProcessDeath:
                 raise  # the ROUTER process dying is never contained
             except Rejected as exc:
-                if exc.reason in ("poison", "bad_idempotency_key"):
+                if exc.reason in ("poison", "bad_idempotency_key",
+                                  "quota"):
                     # Verdicts about the request, not the worker: every
-                    # replica would answer the same — never spill.
+                    # replica would answer the same — never spill.  A
+                    # quota refusal especially: spilling the viral
+                    # tenant to ring successors would hand it exactly
+                    # the fleet-wide capacity the quota exists to cap.
                     obs_metrics.inc("router.rejected")
                     raise
                 last = exc
@@ -309,7 +319,8 @@ class Router:
             a, ap, b, p = ent.payload
             try:
                 src = self._fleet.forward(wid, a, ap, b, p,
-                                          ent.deadline_s, ent.idem)
+                                          ent.deadline_s, ent.idem,
+                                          priority=ent.priority)
             except BaseException as exc:  # noqa: BLE001 - surfaced
                 if not ent.future.done():
                     try:

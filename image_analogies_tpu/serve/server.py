@@ -47,6 +47,7 @@ from image_analogies_tpu.serve import batcher
 from image_analogies_tpu.serve import degrade as serve_degrade
 from image_analogies_tpu.serve import journal as serve_journal
 from image_analogies_tpu.serve.degrade import CostModel
+from image_analogies_tpu.serve.policy import TenantQuota
 from image_analogies_tpu.serve.queue import AdmissionQueue
 from image_analogies_tpu.serve.types import (
     Rejected,
@@ -84,7 +85,17 @@ class Server:
         self._queue = AdmissionQueue(
             cfg.queue_depth,
             deadline_ordering=cfg.deadline_ordering,
-            age_bound_s=cfg.ordering_age_bound_s)
+            age_bound_s=cfg.ordering_age_bound_s,
+            qos=cfg.qos)
+        # Per-tenant admission quota: None unless the QoS policy arms a
+        # positive rate — the disabled path must stay byte-identical to
+        # the pre-QoS server.  Cost shares feed back from the tenant
+        # ledger, so a tenant burning an outsized share of dispatch time
+        # sees its refill rate squeezed (see policy.TenantQuota).
+        self._quota = (TenantQuota(cfg.qos,
+                                   shares_fn=obs_ledger.tenants_doc)
+                       if cfg.qos is not None and cfg.qos.quota_rps > 0
+                       else None)
         # Seed the degrade cost EWMA: store (this device's persisted
         # rate) > packaged class table > optimistic default.
         rate, self.cost_prior_source = serve_degrade.load_prior(cfg.params)
@@ -328,7 +339,8 @@ class Server:
                params: Optional[AnalogyParams] = None,
                deadline_s: Optional[float] = None,
                idempotency_key: Optional[str] = None,
-               wire_bytes: int = 0) -> "Future[Response]":
+               wire_bytes: int = 0,
+               priority: int = 2) -> "Future[Response]":
         """Enqueue one request; returns a Future resolving to a Response
         (or raising DeadlineExceeded / the dispatch error).  Raises
         :class:`Rejected` when the server is full or shutting down.
@@ -419,6 +431,27 @@ class Server:
             obs_ledger.emit_decision("server", "shed", "breaker_open",
                                      idem=idem)
             raise Rejected("breaker_open")
+        if self._quota is not None:
+            # Per-tenant admission quota (tenant = the batch key's
+            # exemplar sha1): a tenant out of tokens is shed HERE, on
+            # its own request, before it can hold a queue slot — the
+            # viral style degrades itself, not the fleet.  "quota" is a
+            # verdict about the request, so the router never spills it
+            # to another worker (that would hand the throttled tenant
+            # fleet-wide capacity).
+            if key is None:
+                key = batcher.batch_key(a, ap, b, p)
+            tenant = str(key[-1])
+            if not self._quota.try_admit(tenant):
+                obs_metrics.inc("serve.rejected")
+                obs_metrics.inc("serve.quota_throttled")
+                obs_ledger.record_throttle(tenant)
+                obs_ledger.emit_decision("server", "shed", "quota",
+                                         idem=idem, tenant=tenant[:12])
+                if self._journal is not None and idem is not None:
+                    self._journal.record_decision(
+                        idem, "server", "shed", "quota")
+                raise Rejected("quota")
         if deadline_s is None:
             deadline_s = self.cfg.default_deadline_s
         with self._id_lock:
@@ -433,6 +466,7 @@ class Server:
             future=fut,
             idem=idem,
             wire_bytes=wire_bytes,
+            priority=priority,
             # Submit runs on the caller's thread; the worker thread that
             # dispatches is a different one — the trace context crosses
             # via the request itself.
@@ -537,6 +571,9 @@ class Server:
             # process vitals from /proc (graceful off-Linux): the
             # ceilings watchdog and `ia top` read the same source.
             "vitals": obs_ceilings.read_proc_vitals(),
+            # per-tenant admission quota state (None when QoS is off)
+            "quota": (self._quota.snapshot()
+                      if self._quota is not None else None),
         }
 
 

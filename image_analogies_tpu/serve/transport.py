@@ -57,6 +57,7 @@ from image_analogies_tpu.obs import metrics as obs_metrics
 from image_analogies_tpu.obs import trace as obs_trace
 from image_analogies_tpu.serve import wire
 from image_analogies_tpu.serve.server import Server
+from image_analogies_tpu.serve.policy import QosPolicy
 from image_analogies_tpu.serve.types import (DeadlineExceeded, Rejected,
                                              Response, ServeConfig)
 from image_analogies_tpu.utils import failure
@@ -131,6 +132,8 @@ def config_from_json(doc: Dict[str, Any]) -> ServeConfig:
     params = params_from_json(doc.pop("params"))
     doc["warmup_sizes"] = tuple(
         tuple(int(d) for d in s) for s in doc.get("warmup_sizes") or ())
+    if doc.get("qos") is not None:
+        doc["qos"] = QosPolicy.from_json(doc["qos"])
     return ServeConfig(params=params, **doc)
 
 
@@ -251,7 +254,8 @@ class WorkerHandle:
     # -- data plane ----------------------------------------------------
 
     def forward(self, a, ap, b, params, deadline_s: Optional[float],
-                idem: Optional[str]) -> "Future[Response]":
+                idem: Optional[str], priority: int = 2
+                ) -> "Future[Response]":
         """One router->worker hop: request planes AND the trace context
         through the negotiated codec, submit, response planes back
         through the codec."""
@@ -284,7 +288,8 @@ class WorkerHandle:
             src = self.server.submit(a, ap, b, params=params,
                                      deadline_s=deadline_s,
                                      idempotency_key=idem,
-                                     wire_bytes=hop_bytes)
+                                     wire_bytes=hop_bytes,
+                                     priority=priority)
         return _wrap_response(src, self.codec)
 
 
@@ -498,7 +503,8 @@ class SubprocessHandle:
     # -- data plane ----------------------------------------------------
 
     def forward(self, a, ap, b, params, deadline_s: Optional[float],
-                idem: Optional[str]) -> "Future[Response]":
+                idem: Optional[str], priority: int = 2
+                ) -> "Future[Response]":
         """One router->worker hop over real HTTP.  Encoding and wire
         accounting happen on the CALLER thread (deterministic counters);
         the blocking POST + decode run on the hop pool.
@@ -537,6 +543,8 @@ class SubprocessHandle:
             obs_metrics.inc("router.wire_bytes", len(body))
             headers = {"Content-Type": "application/json"}
         headers["X-IA-Worker-Hop"] = "1"
+        if priority != 2:
+            headers["X-IA-Priority"] = str(int(priority))
         if ctx:
             hdr = obs_trace.format_trace_header(ctx)
             if hdr:

@@ -16,6 +16,7 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 from image_analogies_tpu.config import AnalogyParams
+from image_analogies_tpu.serve.policy import ControlPolicy, QosPolicy
 
 
 class Rejected(RuntimeError):
@@ -28,6 +29,10 @@ class Rejected(RuntimeError):
     ``"circuit_open"`` when the breaker trips between an accepted
     request's admission and its dispatch,
     ``"worker_crash"`` when a crashed worker exhausted the requeue budget,
+    ``"quota"`` when the tenant's per-style admission token bucket is
+    empty (serve/policy.py — the viral style degrades itself, not the
+    fleet; like ``"poison"`` this is a verdict about the REQUEST, so
+    the router never spills it to another worker),
     ``"poison"`` when the request's idempotency key was previously marked
     poisoned in the write-ahead journal (it exhausted ``crash_requeues``
     once already — resubmission sheds instantly, before the breaker, so a
@@ -123,6 +128,13 @@ class ServeConfig:
     ledger: bool = True
     ledger_capacity: int = 512     # bounded in-memory cost vectors
     tenant_k: int = 16             # heavy-hitter slots (O(K) memory)
+    # Per-tenant QoS (serve/policy.py): admission token buckets fed by
+    # the tenants sketch's observed cost shares + weighted-fair batch
+    # pop across tenants.  None (default) disables QoS entirely — the
+    # admission and pop paths are byte-identical to the pre-QoS server.
+    # Round-trips through config_to_json/config_from_json for the
+    # subprocess transport (serve/transport.py re-hydrates the dict).
+    qos: Optional[QosPolicy] = None
 
     def __post_init__(self):
         if self.ledger_capacity < 1:
@@ -192,6 +204,13 @@ class FleetConfig:
     spill_retries: int = 3         # extra route attempts after the first
     backoff_s: float = 0.05        # utils.failure.backoff_delay base
     backoff_cap_s: float = 1.0
+    # Elastic-fleet control plane (serve/control.py): when set, the
+    # fleet starts at ``policy.min_workers`` (``size`` is ignored) and
+    # the health daemon's reconcile pass scales it between min and max
+    # under the declarative targets.  None (default) keeps the fixed
+    # ``size`` fleet with no autoscaling — only the gate/death verdicts
+    # (now rendered by the control plane) remain.
+    policy: Optional[ControlPolicy] = None
 
     def __post_init__(self):
         if self.size < 1:
@@ -249,6 +268,15 @@ class Request:
     # Encoded request size as it crossed the HTTP boundary (0 for
     # in-process submissions) — part of the cost vector (obs/ledger.py).
     wire_bytes: int = 0
+    # Priority class weight (serve/policy.py PRIORITY_*): the tenant's
+    # stride-scheduling share in the weighted-fair queue pop.  Carried
+    # per request (X-IA-Priority over HTTP); inert unless the queue
+    # runs with a QosPolicy that arms weighted_fair.
+    priority: int = 2
+
+    def __post_init__(self):
+        if self.priority < 1:
+            raise ValueError("priority must be >= 1")
 
     def remaining(self, now: Optional[float] = None) -> Optional[float]:
         if self.deadline is None:

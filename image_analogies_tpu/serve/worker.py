@@ -341,6 +341,7 @@ class WorkerPool:
             "degrade_levels": degraded.get("levels"),
             "retries": retries,
             "requeues": req.requeues,
+            "priority": req.priority,
             "ann": bool(getattr(params, "ann_prefilter", False)),
             "catalog": bool(getattr(params, "catalog_dir", None)),
             "wire_bytes": req.wire_bytes,

@@ -1,0 +1,467 @@
+"""Elastic-fleet control plane (ISSUE 19): declarative autoscaling +
+per-tenant QoS (serve/control.py, serve/policy.py).
+
+Locked here:
+
+- ControlPolicy / QosPolicy round-trip to plain JSON, reject unknown
+  fields, and validate their numeric invariants (a policy is a checked-
+  in artifact, so a typo must fail loudly at load time);
+- reconcile hysteresis: pressure must HOLD for ``scale_up_windows``
+  consecutive passes (a mid-range pass resets both counters), and each
+  direction honors its own cooldown;
+- the full breathe cycle against a real inproc fleet: queue pressure
+  -> spawn + ring join, calm -> the emptiest worker drains, ring-
+  leaves, and retires, with every verdict in the ``control.*`` counters
+  and the decision plane;
+- scale-down NEVER strands work: a worker with queued requests,
+  inflight dispatches, an unreplayed journal, or router-pending futures
+  is not retireable, and a retire that races admitted traffic aborts
+  and fully restores membership;
+- scale-up warm path: with the exemplar catalog active, the joining
+  worker's home styles are pre-staged, so its first home-style request
+  is tier hits — zero cold builds;
+- TenantQuota token buckets are deterministic under an injected clock,
+  and the observed-cost-share penalty scales refill down;
+- weighted-fair queue pop: stride scheduling across tenants with
+  priority-class weights, aging promotion trumping fairness;
+- flash-crowd arrival schedules are seed-deterministic and actually
+  compress arrivals into the surge window;
+- `ia fleet --autoscale --selftest` + `ia serve --flash-crowd` CLI
+  smoke.
+"""
+
+import dataclasses
+import json
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from image_analogies_tpu.chaos import drills
+from image_analogies_tpu.obs import metrics as obs_metrics
+from image_analogies_tpu.serve.control import ControlPlane
+from image_analogies_tpu.serve.fleet import Fleet
+from image_analogies_tpu.serve.policy import (ControlPolicy, QosPolicy,
+                                              TenantQuota)
+from image_analogies_tpu.serve.types import FleetConfig, Request
+
+# ------------------------------------------------------------- policy
+
+
+def test_control_policy_json_roundtrip(tmp_path):
+    pol = ControlPolicy(min_workers=2, max_workers=5, queue_high=3.0,
+                        queue_low=0.25, scale_up_windows=3)
+    assert ControlPolicy.from_json(pol.to_json()) == pol
+    path = tmp_path / "policy.json"
+    path.write_text(json.dumps(pol.to_json()))
+    assert ControlPolicy.load(str(path)) == pol
+    with pytest.raises(ValueError):
+        ControlPolicy.from_json({"min_workers": 1, "warp_factor": 9})
+    with pytest.raises(ValueError):
+        ControlPolicy(min_workers=0)
+    with pytest.raises(ValueError):
+        ControlPolicy(min_workers=3, max_workers=2)
+    with pytest.raises(ValueError):
+        ControlPolicy(queue_low=4.0, queue_high=4.0)
+    with pytest.raises(ValueError):
+        ControlPolicy(scale_up_windows=0)
+
+
+def test_qos_policy_json_roundtrip():
+    qos = QosPolicy(quota_rps=2.0, quota_burst=4.0, share_cap=0.3)
+    assert QosPolicy.from_json(qos.to_json()) == qos
+    with pytest.raises(ValueError):
+        QosPolicy.from_json({"quota_rps": 1.0, "free_lunch": True})
+    with pytest.raises(ValueError):
+        QosPolicy(quota_rps=-1.0)
+    with pytest.raises(ValueError):
+        QosPolicy(share_cap=0.0)
+    with pytest.raises(ValueError):
+        QosPolicy(quota_burst=0.5)
+
+
+# ---------------------------------------------------------- hysteresis
+
+
+class _FakeFleet:
+    """Just enough fleet for reconcile passes that never act: the
+    size reads and nothing else (min == max pins both directions)."""
+
+    def __init__(self, n=1):
+        self.workers = {f"w{i}": object() for i in range(n)}
+
+
+def _health(depth=0.0, ok=True, recovering=False, burn=0.0):
+    return {"ok": ok, "recovering": recovering, "queue_depth": depth,
+            "slo": {"burn_rate_fast": burn}, "breakers": {}}
+
+
+def test_reconcile_hysteresis_counters():
+    """Pressure must hold for ``scale_up_windows`` consecutive passes;
+    a mid-range pass (neither over queue_high nor under queue_low)
+    resets BOTH hysteresis counters, so flapping load never scales."""
+    pol = ControlPolicy(min_workers=1, max_workers=1, queue_high=2.0,
+                        queue_low=0.5, scale_up_windows=2)
+    now = [0.0]
+    cp = ControlPlane(_FakeFleet(1), pol, clock=lambda: now[0])
+    busy = {"w0": _health(depth=5)}
+    mid = {"w0": _health(depth=1)}      # between low and high
+    calm = {"w0": _health(depth=0)}
+
+    assert cp.reconcile(busy) is None and cp._over == 1
+    assert cp.reconcile(mid) is None
+    assert cp._over == 0 and cp._idle == 0   # mid-range resets both
+    assert cp.reconcile(calm) is None and cp._idle == 1
+    assert cp.reconcile(busy) is None
+    assert cp._over == 1 and cp._idle == 0
+    # min == max: even held pressure/calm can never change the fleet
+    for _ in range(10):
+        assert cp.reconcile(busy) is None
+    assert len(cp.fleet.workers) == 1
+
+
+def _fleet_cfg(tmp_path=None, size=1, **kw):
+    scfg = drills.serve_config(workers=1, max_batch=4,
+                               batch_window_ms=20.0)
+    return FleetConfig(
+        serve=scfg, size=size, vnodes=16,
+        journal_root=str(tmp_path / "journals") if tmp_path else None,
+        health_interval_s=0.05, death_checks=2,
+        backoff_s=0.01, backoff_cap_s=0.05, **kw)
+
+
+def test_reconcile_scales_fleet_up_and_down():
+    """The breathe cycle on a real inproc fleet, clock injected:
+    held queue pressure spawns + ring-joins w1, held calm retires it
+    (highest index first), and the scale-up cooldown blocks a second
+    spawn until the clock moves past it.  Verdicts land in the
+    ``control.*`` counters and the event deque."""
+    pol = ControlPolicy(min_workers=1, max_workers=2, queue_high=2.0,
+                        queue_low=0.5, scale_up_windows=2,
+                        scale_down_windows=2, scale_up_cooldown_s=10.0,
+                        scale_down_cooldown_s=0.0)
+    now = [0.0]
+    with Fleet(_fleet_cfg()) as fl:
+        cp = ControlPlane(fl, pol, clock=lambda: now[0])
+        busy = {"w0": _health(depth=5)}
+        assert cp.reconcile(busy) is None          # window 1/2
+        ev = cp.reconcile(busy)                    # window 2/2 -> spawn
+        assert ev and ev["verdict"] == "scale_up" and ev["worker"] == "w1"
+        assert ev["cause"] == "queue_pressure"
+        assert set(fl.workers) == {"w0", "w1"}
+        assert "w1" in fl.router.ring.members()
+
+        # at max_workers: held pressure changes nothing
+        both_busy = {w: _health(depth=5) for w in ("w0", "w1")}
+        assert cp.reconcile(both_busy) is None
+        assert len(fl.workers) == 2
+
+        # held calm: the emptiest retireable worker goes, highest
+        # index first, and the ring restores to w0 alone
+        both_calm = {w: _health(depth=0) for w in ("w0", "w1")}
+        assert cp.reconcile(both_calm) is None     # window 1/2
+        ev = cp.reconcile(both_calm)
+        assert ev and ev["verdict"] == "scale_down" and ev["worker"] == "w1"
+        assert set(fl.workers) == {"w0"}
+        assert fl.router.ring.members() == ["w0"]
+        # at min_workers: held calm changes nothing
+        calm0 = {"w0": _health(depth=0)}
+        for _ in range(4):
+            assert cp.reconcile(calm0) is None
+        assert set(fl.workers) == {"w0"}
+
+        # scale-up cooldown: pressure holds but the clock hasn't moved
+        assert cp.reconcile(busy) is None
+        assert cp.reconcile(busy) is None          # windows met, cooled
+        assert len(fl.workers) == 1
+        now[0] = 11.0                              # past the cooldown
+        ev = cp.reconcile(busy)
+        assert ev and ev["verdict"] == "scale_up"
+        assert set(fl.workers) == {"w0", "w1"}
+
+        snap = (obs_metrics.snapshot() or {}).get("counters") or {}
+        assert snap.get("control.scale_up") == 2
+        assert snap.get("control.scale_down") == 1
+        # decision-plane mirror: every verdict funnels one decision
+        assert snap.get("serve.decision.scale_up") == 2
+        events = fl.control.status()  # the fleet's own plane is static
+        assert events["autoscale"] is False
+        assert [e["verdict"] for e in cp.events] == [
+            "scale_up", "scale_down", "scale_up"]
+
+
+def test_scale_down_never_strands_work(monkeypatch):
+    """The satellite lock: a worker holding queued requests, inflight
+    dispatches, an unreplayed journal entry, or router-pending futures
+    is NOT retireable — reconcile stays armed rather than retiring it —
+    and a retire that races admitted traffic aborts and restores ring
+    membership + the gate."""
+    pol = ControlPolicy(min_workers=1, max_workers=2, queue_high=2.0,
+                        queue_low=0.5, scale_down_windows=1,
+                        scale_down_cooldown_s=0.0)
+    with Fleet(_fleet_cfg(size=2)) as fl:
+        cp = ControlPlane(fl, pol, clock=lambda: 0.0)
+
+        assert cp._retireable("w1", _health(depth=0)) is True
+        assert cp._retireable("w1", None) is False
+        assert cp._retireable("w1", _health(depth=3)) is False
+        assert cp._retireable("w1", _health(recovering=True)) is False
+        inflight = dict(_health(), inflight=1)
+        assert cp._retireable("w1", inflight) is False
+        unreplayed = dict(_health(),
+                          journal={"admitted": 3, "done": 2, "deduped": 0,
+                                   "rejected": 0, "poisoned": 0})
+        assert cp._retireable("w1", unreplayed) is False
+        settled = dict(_health(),
+                       journal={"admitted": 3, "done": 2, "deduped": 1,
+                                "rejected": 0, "poisoned": 0})
+        assert cp._retireable("w1", settled) is True
+        monkeypatch.setattr(fl.router, "pending_for", lambda wid: True)
+        assert cp._retireable("w1", _health()) is False
+        monkeypatch.undo()
+
+        # every worker unsafe -> reconcile returns None, nobody retired
+        stuck = {w: dict(_health(), inflight=1) for w in fl.workers}
+        assert cp.reconcile(stuck) is None
+        assert set(fl.workers) == {"w0", "w1"}
+
+        # raced retire: health looked clean at pick time, but by the
+        # gate-and-recheck the worker holds queued work -> abort,
+        # membership and gate fully restored
+        monkeypatch.setattr(fl.workers["w1"], "health",
+                            lambda: dict(_health(depth=2), accepting=True))
+        ev = cp.scale_down("w1", "idle")
+        assert ev is None
+        assert "w1" in fl.workers
+        assert "w1" in fl.router.ring.members()
+        assert fl._gates.get("w1") is None
+        assert [e["verdict"] for e in cp.events] == ["scale_down_abort"]
+
+
+# ------------------------------------------------------- warm scale-up
+
+
+def test_scale_up_warms_joining_worker(tmp_path):
+    """ISSUE acceptance: with the exemplar catalog active, scale-up
+    pre-stages the joining worker's home styles (ring-placement-aware
+    ``warm_for_fleet``), so the first request for a style homed on the
+    joiner is pure tier hits — zero cold feature builds after the
+    join."""
+    from image_analogies_tpu.catalog import build as catalog_build
+    from image_analogies_tpu.catalog import tiers
+    from image_analogies_tpu.serve.router import Ring
+
+    params = drills.catalog_params(str(tmp_path), levels=1)
+    scfg = dataclasses.replace(
+        drills.serve_config(workers=1, max_batch=4, batch_window_ms=20.0),
+        params=params)
+    fcfg = FleetConfig(serve=scfg, size=1, vnodes=16,
+                       health_interval_s=0.05, death_checks=2,
+                       backoff_s=0.01, backoff_cap_s=0.05)
+
+    # pick an exemplar whose PREFETCH home in the post-join ring is the
+    # joiner: warm_for_fleet(only_worker="w1") stages exactly these
+    ring = Ring(vnodes=16)
+    ring.add("w0")
+    ring.add("w1")
+    chosen = None
+    for seed in range(64):
+        rng = np.random.RandomState(seed)
+        a, ap, b = (rng.rand(12, 12).astype(np.float32) for _ in range(3))
+        if ring.successors(tiers.style_key(a, ap))[0] == "w1":
+            chosen = (a, ap, b)
+            break
+    assert chosen is not None
+    a, ap, b = chosen
+    baseline = drills.run_image(a, ap, b, params)
+
+    catalog_build.build_style(a, ap, params, root_dir=str(tmp_path),
+                              target=b)
+    tiers.clear()                     # fresh process: disk only
+    tiers.configure(str(tmp_path))
+    try:
+        with Fleet(fcfg) as fl:
+            assert list(fl.workers) == ["w0"]
+            ev = fl.control.scale_up("test_join")
+            assert ev["verdict"] == "scale_up" and ev["worker"] == "w1"
+            before = dict((obs_metrics.snapshot() or {})
+                          .get("counters") or {})
+            res = fl.submit(a, ap, b).result(timeout=120)
+            after = dict((obs_metrics.snapshot() or {})
+                         .get("counters") or {})
+    finally:
+        tiers.clear()
+        tiers.configure(None)
+
+    assert np.array_equal(np.asarray(res.bp), baseline)
+    delta = {k: after.get(k, 0) - before.get(k, 0)
+             for k in set(after) | set(before)
+             if k.startswith("catalog.")}
+    # the join pre-staged the style: the request hits warm tiers and
+    # never rebuilds features
+    assert delta.get("catalog.builds", 0) == 0, delta
+    hits = (delta.get("catalog.hbm.hits", 0)
+            + delta.get("catalog.host.hits", 0))
+    assert hits >= 1, delta
+
+
+# ------------------------------------------------------------- quotas
+
+
+def test_tenant_quota_deterministic_clock():
+    now = [0.0]
+    q = TenantQuota(QosPolicy(quota_rps=1.0, quota_burst=2.0),
+                    clock=lambda: now[0])
+    assert q.try_admit("s0") and q.try_admit("s0")   # burst
+    assert not q.try_admit("s0")                     # bucket empty
+    now[0] = 1.0
+    assert q.try_admit("s0")                         # 1 token refilled
+    assert not q.try_admit("s0")
+    assert q.throttled == 2
+    snap = q.snapshot()
+    assert snap["throttled"] == 2 and "s0" in snap["tenants"]
+    # quota_rps=0 disables quotas entirely
+    off = TenantQuota(QosPolicy(quota_rps=0.0), clock=lambda: now[0])
+    assert all(off.try_admit("s0") for _ in range(100))
+
+
+def test_tenant_quota_cost_share_penalty():
+    """A tenant over ``share_cap`` of observed dispatch cost has its
+    refill scaled by share_cap/share — the viral style throttles harder
+    as it gets hotter; everyone else refills at full rate."""
+    doc = {"tenants": [{"tenant": "hot", "cost_share": 1.0},
+                       {"tenant": "cold", "cost_share": 0.1}]}
+    now = [0.0]
+    q = TenantQuota(QosPolicy(quota_rps=1.0, quota_burst=1.0,
+                              share_cap=0.5, share_refresh_s=0.001),
+                    shares_fn=lambda: doc, clock=lambda: now[0])
+    assert q.try_admit("hot") and q.try_admit("cold")  # burst drained
+    assert q.effective_rps("hot") == pytest.approx(0.5)
+    assert q.effective_rps("cold") == pytest.approx(1.0)
+    now[0] = 1.0
+    assert q.try_admit("cold")       # full refill: 1 token in 1 s
+    assert not q.try_admit("hot")    # penalized: only 0.5 tokens
+    now[0] = 2.0
+    assert q.try_admit("hot")        # 0.5 + 0.5 across two seconds
+
+
+# ------------------------------------------------------ weighted fair
+
+
+def _req(rid, tenant, priority=2, t_submit=None):
+    z = np.zeros((2, 2), np.float32)
+    kw = {} if t_submit is None else {"t_submit": t_submit}
+    return Request(request_id=rid, a=z, ap=z, b=z,
+                   params=drills.image_params(levels=1, retries=0),
+                   key=("2x2", tenant), future=Future(),
+                   priority=priority, **kw)
+
+
+def test_weighted_fair_pop_interleaves_tenants():
+    """Stride scheduling: a tenant's pass advances by 1/priority per
+    pick, so an interactive (weight 4) tenant gets picked repeatedly
+    before a background (weight 1) tenant's next turn — a thousand-
+    waiter viral style still only gets its fair share of leaders."""
+    from image_analogies_tpu.serve.queue import AdmissionQueue
+
+    q = AdmissionQueue(depth=32, qos=QosPolicy(weighted_fair=True))
+    for i in range(6):
+        q.submit(_req(i, "viral", priority=1))
+    for i in range(6, 8):
+        q.submit(_req(i, "nice", priority=4))
+    order = [q.pop_batch(1, 0.0)[0] for _ in range(8)]
+    tenants = [str(r.key[-1]) for r in order]
+    # first pick goes to the earliest arrival (both passes at floor),
+    # then the interactive tenant's cheap strides pull BOTH its
+    # requests ahead of viral's five remaining waiters
+    assert tenants[:3] == ["viral", "nice", "nice"]
+    assert tenants[3:] == ["viral"] * 5
+    q.close()
+
+
+def test_weighted_fair_aging_trumps_fairness():
+    """Anti-starvation: a waiter older than the age bound leads no
+    matter whose stride turn it is — fairness may reorder, never
+    starve."""
+    import time as _time
+
+    from image_analogies_tpu.serve.queue import AdmissionQueue
+
+    q = AdmissionQueue(depth=8, qos=QosPolicy(weighted_fair=True))
+    q.submit(_req(0, "a", priority=1))
+    assert str(q.pop_batch(1, 0.0)[0].key[-1]) == "a"   # a's pass -> 1.0
+    # fairness would now prefer "b" (pass floor) — but a's next waiter
+    # has aged past the bound (default 5 s), so it leads anyway
+    q.submit(_req(1, "a", priority=1,
+                  t_submit=_time.monotonic() - 10.0))
+    q.submit(_req(2, "b", priority=4))
+    assert q.pop_batch(1, 0.0)[0].request_id == 1
+    assert q.pop_batch(1, 0.0)[0].request_id == 2
+    q.close()
+
+
+# -------------------------------------------------------- flash crowd
+
+
+def test_arrival_schedule_deterministic_and_surging():
+    from image_analogies_tpu.serve import loadgen
+
+    kw = dict(t0=0.2, duration=0.5, mult=20.0, base_rps=40.0)
+    s1 = loadgen.arrival_schedule(50, seed=3, **kw)
+    s2 = loadgen.arrival_schedule(50, seed=3, **kw)
+    assert s1 == s2                        # one seed, one schedule
+    assert s1 != loadgen.arrival_schedule(50, seed=4, **kw)
+    assert len(s1) == 50
+    assert all(b >= a for a, b in zip(s1, s1[1:]))   # non-decreasing
+    # the surge compresses arrivals: the window holds far more than
+    # its share under the base rate
+    inside = sum(1 for t in s1 if 0.2 <= t < 0.7)
+    flat = loadgen.arrival_schedule(50, seed=3, t0=0.2, duration=0.5,
+                                    mult=1.0, base_rps=40.0)
+    inside_flat = sum(1 for t in flat if 0.2 <= t < 0.7)
+    assert inside > 1.5 * max(inside_flat, 1)
+    assert s1[-1] < flat[-1]       # the surge compresses the whole run
+
+    assert loadgen.parse_flash_crowd("0.5, 2.0, 8") == {
+        "t0": 0.5, "duration": 2.0, "mult": 8.0}
+    for bad in ("", "1,2", "a,b,c", "-1,1,2", "0,0,2", "0,1,0.5"):
+        with pytest.raises(ValueError):
+            loadgen.parse_flash_crowd(bad)
+
+
+# ---------------------------------------------------------- CLI smoke
+
+
+def test_fleet_autoscale_cli_selftest(capsys):
+    """`ia fleet --autoscale --selftest`: the fleet starts at the
+    policy floor, the summary carries the control-plane section, and
+    bit-identity still gates."""
+    from image_analogies_tpu.cli import main
+
+    rc = main(["fleet", "--selftest", "3", "--size", "2", "--autoscale",
+               "--max-batch", "3", "--batch-window-ms", "20",
+               "--levels", "1", "--backend", "cpu"])
+    captured = capsys.readouterr()
+    assert rc == 0, captured.err
+    summary = json.loads(captured.err.strip().splitlines()[-1])
+    assert summary["errors"] == 0 and summary["bit_identical"] is True
+    ctl = summary["control"]
+    assert ctl["autoscale"] is True
+    assert ctl["policy"]["max_workers"] == 2
+    assert "autoscale" in captured.out
+
+
+def test_serve_flash_crowd_cli_selftest(capsys):
+    """`ia serve --flash-crowd T0,DUR,MULT`: the paced selftest passes
+    and records the surge shape in its summary."""
+    from image_analogies_tpu.cli import main
+
+    rc = main(["serve", "--selftest", "3", "--workers", "1",
+               "--flash-crowd", "0.05,0.2,5", "--levels", "1",
+               "--backend", "cpu"])
+    captured = capsys.readouterr()
+    assert rc == 0, captured.err
+    summary = json.loads(captured.err.strip().splitlines()[-1])
+    assert summary["bit_identical"] is True
+    assert summary["flash_crowd"] == {"t0": 0.05, "duration": 0.2,
+                                      "mult": 5.0}

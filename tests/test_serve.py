@@ -601,6 +601,8 @@ def test_serve_never_calls_jit_directly():
     # router/fleet out of serve/ must move the jax-free guarantee with
     # it; transport/worker_main are the subprocess spawn path, where a
     # module-scope jax import would bill every child ~seconds before
-    # the readiness handshake even starts
-    assert {"router.py", "fleet.py",
-            "transport.py", "worker_main.py"} <= scanned
+    # the readiness handshake even starts; control/policy are the
+    # elastic control plane, which runs inside the health daemon and
+    # the admission path
+    assert {"router.py", "fleet.py", "transport.py", "worker_main.py",
+            "control.py", "policy.py"} <= scanned
