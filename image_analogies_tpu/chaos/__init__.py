@@ -36,7 +36,11 @@ from image_analogies_tpu.chaos.inject import (  # noqa: F401
     site,
     snapshot,
 )
-from image_analogies_tpu.chaos.plan import ChaosPlan, SiteRule  # noqa: F401
+from image_analogies_tpu.chaos.plan import (  # noqa: F401
+    KNOWN_SITES,
+    ChaosPlan,
+    SiteRule,
+)
 
 FAULT_KINDS = ("transient", "oom", "latency", "corrupt", "crash",
                "process_death")

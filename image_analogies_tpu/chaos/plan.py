@@ -30,6 +30,26 @@ from typing import Any, Dict, Optional, Tuple
 _KINDS = ("transient", "oom", "latency", "corrupt", "crash",
           "process_death")
 
+# Every injection site wired into the codebase (chaos/inject.py's
+# docstring is the prose version).  A plan naming a site outside this
+# registry would arm NOTHING — the typo'd rule silently never fires and
+# a drill (or a soak) passes vacuously — so loaders validate against it.
+KNOWN_SITES = (
+    "level.dispatch",    # models/analogy.py  — per-level device dispatch
+    "devcache.upload",   # utils/devcache.py  — host→device upload
+    "devcache.tier",     # catalog/tiers.py   — catalog tier resolution
+    "match.prefilter",   # backends/tpu.py    — ANN projection resolution
+    "ckpt.save",         # utils/checkpoint.py — checkpoint write
+    "ckpt.load",         # utils/checkpoint.py — checkpoint read
+    "serve.admit",       # serve/queue.py     — request admission
+    "serve.dispatch",    # serve/worker.py    — batch dispatch
+    "serve.journal",     # serve/journal.py   — journal append
+    "engine.batch",      # batch/engine.py    — per-lane batched dispatch
+    "mesh.step",         # parallel/step.py   — multichip level step
+    "router.forward",    # serve/router.py    — fleet hop forward
+    "archive.append",    # obs/archive.py     — sealed telemetry append
+)
+
 
 @dataclasses.dataclass(frozen=True)
 class SiteRule:
@@ -95,6 +115,20 @@ class ChaosPlan:
                 return rule
         return None
 
+    def validate_sites(self, known: Optional[Tuple[str, ...]] = None
+                       ) -> "ChaosPlan":
+        """Reject site names outside ``known`` (default: the wired-in
+        :data:`KNOWN_SITES` registry).  A typo'd site would never fire
+        and the drill would pass vacuously — loud beats vacuous.
+        Returns ``self`` so loaders can chain it."""
+        registry = tuple(known) if known is not None else KNOWN_SITES
+        unknown = [name for name, _ in self.sites if name not in registry]
+        if unknown:
+            raise ValueError(
+                f"unknown injection site(s) {sorted(unknown)!r}; "
+                f"known sites: {sorted(registry)}")
+        return self
+
     def to_dict(self) -> Dict[str, Any]:
         return {
             "seed": self.seed,
@@ -138,5 +172,10 @@ class ChaosPlan:
 
     @staticmethod
     def load(path: str) -> "ChaosPlan":
+        """Load a checked-in plan file.  Unlike the programmatic
+        constructors (tests build plans against synthetic sites), a
+        FILE plan is an operator artifact: its site names are validated
+        against :data:`KNOWN_SITES` here, at load time, so a typo fails
+        loudly instead of never firing."""
         with open(path) as f:
-            return ChaosPlan.from_dict(json.load(f))
+            return ChaosPlan.from_dict(json.load(f)).validate_sites()

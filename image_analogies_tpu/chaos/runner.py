@@ -1388,6 +1388,18 @@ def drill_flash_crowd(plan: ChaosPlan, *, seed: int = 7) -> Dict[str, Any]:
                     while (len(fl.workers) > policy.min_workers
                            and time.monotonic() < end):
                         time.sleep(0.02)
+                    # The retirement's decision record lands AFTER the
+                    # worker leaves the map (scale_down pops first so a
+                    # racing forward spills to a live successor), so
+                    # settle until the floor-reaching event is visible
+                    # before snapshotting — else the counter read after
+                    # scope exit can outrun the event list.
+                    end = time.monotonic() + 10.0
+                    while (not any(e["verdict"] == "scale_down"
+                                   and e["size"] <= policy.min_workers
+                                   for e in fl.control.events)
+                           and time.monotonic() < end):
+                        time.sleep(0.01)
                     final_size = len(fl.workers)
                     events = list(fl.control.events)
                     handoffs = list(fl.handoffs)

@@ -580,6 +580,40 @@ def cmd_chaos(args) -> int:
     return 0 if result["ok"] else 1
 
 
+def cmd_soak(args) -> int:
+    """Trace-driven soak (soak/): replay a seeded TraceSpec against an
+    autoscaling fleet with chaos armed the whole run, then gate on the
+    duration-emergent invariants — zero-loss accounting, audit-subset
+    bit-identity, the DDSketch p99.9 bound, zero ceiling alarms, and
+    journals bounded under autocompaction.  Exits non-zero on a red
+    gate; failing verdicts name an `ia why`-linkable culprit key."""
+    from image_analogies_tpu.soak import driver as soak_driver
+    from image_analogies_tpu.soak import invariants as soak_invariants
+    from image_analogies_tpu.soak import trace as soak_trace
+
+    if args.spec:
+        try:
+            spec = soak_trace.TraceSpec.load(args.spec)
+        except (OSError, ValueError) as exc:
+            print(f"soak: bad spec {args.spec}: {exc}", file=sys.stderr)
+            return 2
+    elif args.full:
+        spec = soak_trace.full_spec(seed=args.seed)
+    else:
+        spec = soak_trace.smoke_spec(seed=args.seed)
+    result = soak_driver.run(spec, workdir=args.workdir)
+    sys.stdout.write(soak_invariants.render(result))
+    if args.workdir:
+        print(f"artifacts kept under {args.workdir} — runbook: "
+              f"ia why <culprit> --root "
+              f"{result['facts'].get('journal_root')}; "
+              f"ia archive inspect {result['facts'].get('archive_root')}")
+    if args.json:
+        print(json.dumps(result, sort_keys=True, default=str),
+              file=sys.stderr)
+    return 0 if result["ok"] else 1
+
+
 def cmd_journal(args) -> int:
     """Write-ahead journal tooling (serve/journal.py).  ``inspect`` is a
     read-only summary of a journal directory — segments, per-state
@@ -849,6 +883,8 @@ def cmd_bench(args) -> int:
     fresh_ledger = None
     fresh_archive = None
     fresh_scaleup = None
+    fresh_soak_p999 = None
+    fresh_soak_loss = None
     fresh_key = args.metric_key
     if args.value is not None:
         fresh = args.value
@@ -880,6 +916,10 @@ def cmd_bench(args) -> int:
                 fresh_archive = float(doc["archive_overhead_pct"])
             if doc.get("scale_up_ms") is not None:
                 fresh_scaleup = float(doc["scale_up_ms"])
+            if doc.get("soak_p999_ms") is not None:
+                fresh_soak_p999 = float(doc["soak_p999_ms"])
+            if doc.get("soak_loss") is not None:
+                fresh_soak_loss = int(doc["soak_loss"])
         else:
             head = bench.extract_headline(doc if isinstance(doc, dict)
                                           else {})
@@ -897,6 +937,8 @@ def cmd_bench(args) -> int:
             fresh_ledger = head.get("ledger_overhead_pct")
             fresh_archive = head.get("archive_overhead_pct")
             fresh_scaleup = head.get("scale_up_ms")
+            fresh_soak_p999 = head.get("soak_p999_ms")
+            fresh_soak_loss = head.get("soak_loss")
             if fresh_key is None:
                 fresh_key = head.get("metric_key")
     verdict = bench.check_regression(trajectory, fresh_value=fresh,
@@ -910,7 +952,9 @@ def cmd_bench(args) -> int:
                                      fresh_handoff=fresh_handoff,
                                      fresh_ledger=fresh_ledger,
                                      fresh_archive=fresh_archive,
-                                     fresh_scaleup=fresh_scaleup)
+                                     fresh_scaleup=fresh_scaleup,
+                                     fresh_soak_p999=fresh_soak_p999,
+                                     fresh_soak_loss=fresh_soak_loss)
     print(json.dumps(verdict, sort_keys=True))
     for problem in verdict.get("problems", []):
         print(f"bench: warning: {problem}", file=sys.stderr)
@@ -1520,6 +1564,34 @@ def build_parser() -> argparse.ArgumentParser:
                     help="also print the full machine-readable report "
                          "to stderr")
     ch.set_defaults(fn=cmd_chaos)
+
+    # soak takes NO engine flags (the driver builds its own CPU fleet
+    # config), so it skips the distributed-init gate.
+    sk = sub.add_parser("soak",
+                        help="seeded trace-driven soak: replay a "
+                             "TraceSpec against an autoscaling fleet "
+                             "with chaos armed throughout and gate on "
+                             "duration-emergent invariants (zero loss, "
+                             "audit bit-identity, p99.9 bound, zero "
+                             "ceiling alarms, bounded journals)")
+    sk.add_argument("--spec", default=None, metavar="FILE",
+                    help="TraceSpec JSON (seed, Zipf styles, diurnal + "
+                         "flash-crowd shape, session/priority mixes, "
+                         "chaos plan); default is the built-in smoke")
+    sk.add_argument("--full", action="store_true",
+                    help="run the bench-profile soak (hundreds of "
+                         "requests) instead of the smoke")
+    sk.add_argument("--seed", type=int, default=7,
+                    help="seed for the built-in specs — same seed, "
+                         "byte-identical request stream")
+    sk.add_argument("--workdir", default=None, metavar="DIR",
+                    help="persist journals/archive/catalog under DIR "
+                         "(default: swept tempdir) so a red gate's "
+                         "culprits stay reconstructable via ia why")
+    sk.add_argument("--json", action="store_true",
+                    help="also print the full machine-readable result "
+                         "to stderr")
+    sk.set_defaults(fn=cmd_soak)
 
     # catalog takes NO engine flags (so it skips the distributed-init
     # gate): build runs the CPU feature path, the rest is pure file io.

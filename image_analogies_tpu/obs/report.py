@@ -383,6 +383,25 @@ def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             },
         }
 
+    # --- soak section (soak/driver.py "soak_kill" records + the
+    # in-replace autocompact counter): which workers the harness shot,
+    # at which request, and how many corpse journals got offline-
+    # compacted before their replacements opened them.
+    soak_kills = [r for r in records if r.get("event") == "soak_kill"]
+    soak_info: Optional[Dict[str, Any]] = None
+    if soak_kills or counters.get("serve.journal.autocompact") \
+            or counters.get("serve.journal.autocompact_refused"):
+        soak_info = {
+            "kills": [{k: r[k] for k in ("worker", "request") if k in r}
+                      for r in soak_kills],
+            "autocompacted": int(
+                counters.get("serve.journal.autocompact", 0)),
+            "autocompact_skipped": int(
+                counters.get("serve.journal.autocompact_skipped", 0)),
+            "autocompact_refused": int(
+                counters.get("serve.journal.autocompact_refused", 0)),
+        }
+
     # --- durability section (serve.journal.* counters + recovery records) -
     recoveries = [r for r in records if r.get("event") == "serve_recovery"]
     journal_info: Optional[Dict[str, Any]] = None
@@ -596,6 +615,7 @@ def analyze(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "traces": traces_info,
         "journal": journal_info,
         "chaos": chaos_info,
+        "soak": soak_info,
         "hbm": hbm or None,
         "spans": spans,
         "n_records": len(records),
@@ -966,6 +986,18 @@ def render(an: Dict[str, Any], run_id: Optional[str] = None) -> str:
             w(f"    containment   {rec['worker_crashes']} worker crashes, "
               f"{rec['requeued']} requeued, "
               f"{rec['breaker_trips']} breaker trips")
+
+    soak = an.get("soak")
+    if soak:
+        w("  soak:")
+        shots = ", ".join(
+            f"{k.get('worker', '?')}@{k.get('request', '?')}"
+            for k in soak["kills"])
+        w(f"    kills         {len(soak['kills'])}  ({shots or '-'})")
+        w(f"    autocompact   {soak['autocompacted']} corpse journal(s) "
+          f"compacted in-replace, "
+          f"{soak.get('autocompact_skipped', 0)} skipped "
+          f"(single-segment), {soak['autocompact_refused']} refused")
 
     hbm = an.get("hbm")
     if hbm:

@@ -472,6 +472,17 @@ class Fleet:
                                       delay_s=verdict["delay_s"])
             if self._stop.wait(verdict["delay_s"]):
                 return None  # fleet shutting down mid-backoff
+        # Offline-compact the corpse's journal before the replacement
+        # opens it: the dir is guaranteed writer-free in this window, so
+        # a long-lived fleet's per-worker journals stay bounded by live
+        # state instead of growing a segment per incarnation.  A
+        # single-segment corpse (first kill) is skipped untouched —
+        # the replacement keeps its historic handoff evidence (stale
+        # lock sweep, contiguous segment numbering).  Refusal is safe —
+        # the replacement just inherits the uncompacted history.
+        if self.cfg.journal_root:
+            serve_journal.autocompact(
+                os.path.join(self.cfg.journal_root, wid))
         handle = self._spawn(wid, generation=old.generation + 1)
         recovered = handle.recovery_stats()
         obs_metrics.inc("router.handoffs")

@@ -702,6 +702,47 @@ class RequestJournal:
         return {"lock_pid": self.active_pid(), "segment": self._segment}
 
 
+def autocompact(path: str, min_segments: int = 2
+                ) -> Optional[Dict[str, Any]]:
+    """Offline compaction of a DEAD worker's journal dir, called by
+    ``Fleet._replace`` between the corpse and the replacement's
+    ``open()`` — the one window in a worker slot's life when nobody
+    holds the directory, so multi-hour soaks don't grow segments
+    unboundedly (live ``compact()`` refuses by design).
+
+    A corpse with fewer than ``min_segments`` segments is already
+    bounded and is SKIPPED without touching the directory — the gate
+    is a bare listdir, so a first-kill handoff keeps its historic
+    evidence intact: the stale foreign lock is still there for the
+    replacement's ``open()`` to sweep, and segment numbering stays
+    contiguous past the corpse's.
+
+    Refusal-safe: if the journal turns out to be held by a live owner
+    (or the rewrite hits an I/O error), the replacement simply
+    inherits the uncompacted journal — recovery replay does not depend
+    on compaction.  Returns the compaction summary, or None when
+    skipped/refused; counters ``serve.journal.autocompact`` /
+    ``.autocompact_skipped`` / ``.autocompact_refused`` make every
+    outcome visible."""
+    if not os.path.isdir(path):
+        return None
+    try:
+        segments = [n for n in os.listdir(path)
+                    if n.startswith("segment-") and n.endswith(".jsonl")]
+    except OSError:
+        return None
+    if len(segments) < min_segments:
+        obs_metrics.inc("serve.journal.autocompact_skipped")
+        return None
+    try:
+        out = RequestJournal(path).compact()
+    except (RuntimeError, OSError):
+        obs_metrics.inc("serve.journal.autocompact_refused")
+        return None
+    obs_metrics.inc("serve.journal.autocompact")
+    return out
+
+
 class DecisionLog:
     """Sealed JSONL decision trail for verdicts rendered OUTSIDE any
     worker journal — the router/fleet control plane (spill off home,

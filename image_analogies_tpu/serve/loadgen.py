@@ -102,17 +102,13 @@ def arrival_schedule(n: int, *, t0: float, duration: float, mult: float,
     flash-crowd load: Poisson arrivals at ``base_rps``, multiplied by
     ``mult`` inside the ``[t0, t0+duration)`` surge window.  One seed
     fixes the whole schedule, so the chaos drill and ``ia bench``
-    replay the exact same traffic."""
-    # Offset the seed stream from make_load's so pacing never reuses
-    # the bytes that drew the request contents.
-    rng = np.random.RandomState((int(seed) + 0x9E37) & 0x7FFFFFFF)
-    t = 0.0
-    out: List[float] = []
-    for _ in range(max(0, int(n))):
-        rate = base_rps * (mult if t0 <= t < t0 + duration else 1.0)
-        t += float(rng.exponential(1.0 / max(rate, 1e-9)))
-        out.append(t)
-    return out
+    replay the exact same traffic.  Delegates to the soak TraceSpec —
+    the single arrival model selftests, drills, and soaks share."""
+    from image_analogies_tpu.soak.trace import TraceSpec
+
+    return TraceSpec(seed=int(seed), requests=max(0, int(n)),
+                     base_rps=base_rps,
+                     flash_crowds=((t0, duration, mult),)).arrivals()
 
 
 def _pace(sched: Optional[List[float]], idx: int, t_start: float) -> None:
@@ -151,18 +147,11 @@ def selftest(cfg: ServeConfig, n: int, *, seed: int = 0,
     under such a load is the thing deadline ordering lowers."""
     from image_analogies_tpu.models.analogy import create_image_analogy
     from image_analogies_tpu.obs import metrics as obs_metrics
+    from image_analogies_tpu.soak.trace import trace_plan
 
-    load = make_load(n, shapes, seed, zipf=zipf, styles=styles)
-    sched = (arrival_schedule(n, seed=seed, **flash_crowd)
-             if flash_crowd else None)
-
-    def deadline_s(i: int) -> Optional[float]:
-        if deadline_ms is None:
-            return None
-        if isinstance(deadline_ms, (int, float)):
-            return deadline_ms / 1e3
-        v = deadline_ms[i % len(deadline_ms)]
-        return None if v is None else v / 1e3
+    load, sched, deadline_s = trace_plan(
+        n, shapes, seed, zipf=zipf, styles=styles,
+        flash_crowd=flash_crowd, deadline_ms=deadline_ms)
 
     # Sequential baseline: one-at-a-time engine calls, fresh backend each
     # (exactly what N independent `ia run` invocations would pay).
@@ -284,18 +273,11 @@ def fleet_selftest(fcfg: "Any", n: int, *, seed: int = 0,
     from image_analogies_tpu.models.analogy import create_image_analogy
     from image_analogies_tpu.obs import metrics as obs_metrics
     from image_analogies_tpu.serve.fleet import Fleet
+    from image_analogies_tpu.soak.trace import trace_plan
 
-    load = make_load(n, shapes, seed, zipf=zipf, styles=styles)
-    sched = (arrival_schedule(n, seed=seed, **flash_crowd)
-             if flash_crowd else None)
-
-    def deadline_s(i: int) -> Optional[float]:
-        if deadline_ms is None:
-            return None
-        if isinstance(deadline_ms, (int, float)):
-            return deadline_ms / 1e3
-        v = deadline_ms[i % len(deadline_ms)]
-        return None if v is None else v / 1e3
+    load, sched, deadline_s = trace_plan(
+        n, shapes, seed, zipf=zipf, styles=styles,
+        flash_crowd=flash_crowd, deadline_ms=deadline_ms)
 
     seq_params = fcfg.serve.params.replace(metrics=False, log_path=None)
     baseline = {}

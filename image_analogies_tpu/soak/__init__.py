@@ -1,0 +1,29 @@
+"""Trace-driven soak harness (ISSUE 20 / ROADMAP item 5).
+
+Every drill in chaos/ is seconds long and hand-shaped; a service for
+millions of users is validated against *traffic*.  This package is the
+driver that ROADMAP item 5 left open once the PR 17 witness layer
+(honest DDSketch quantiles, ceiling trend watchdogs, durable telemetry
+archive) landed:
+
+- :mod:`soak.trace`      — :class:`TraceSpec`: a JSON artifact (seed,
+  Zipf style popularity, diurnal + flash-crowd arrival shapes, mixed
+  session kinds, priority classes) that is fully replayable from one
+  seed — same spec ⇒ byte-identical request stream, locked by digest.
+- :mod:`soak.driver`     — runs a spec against an autoscaling fleet
+  with a chaos plan armed for the whole run (worker SIGKILLs, catalog
+  tier evictions, torn telemetry artifacts, injected hop latency)
+  while the PR 17 witnesses sample.
+- :mod:`soak.invariants` — the end-of-run gate for what only duration
+  proves: zero-loss accounting reconciled against every worker journal
+  (``journal.reconstruct`` names the culprit), bit-identity of a
+  seeded audit subset vs the sequential baseline, the DDSketch p99.9
+  bound, zero ``obs.ceiling.*`` alarms, and journal growth bounded
+  under autocompaction.
+
+``ia soak --spec FILE`` is the CLI; the seeded smoke spec rides tier-1
+and the full profile emits the ``soak_p999_ms`` / ``soak_loss``
+headlines ``ia bench --check`` records.
+"""
+
+from image_analogies_tpu.soak.trace import TraceSpec  # noqa: F401

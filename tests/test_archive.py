@@ -285,6 +285,58 @@ def test_ceiling_alarm_lands_in_fleet_decision_log(tmp_path):
     assert rpt["alarms"] >= 1 and rpt["slope_per_s"] > 0
 
 
+def test_proc_vitals_graceful_without_proc(monkeypatch):
+    """On a /proc-less host (macOS, hardened sandboxes) the vitals
+    reader must still return the FULL key set via its fallbacks —
+    resource.getrusage for RSS, threading.active_count for threads,
+    None for what has no fallback — and never raise."""
+    real_open = open
+
+    def _no_proc_open(path, *a, **kw):
+        if str(path).startswith("/proc"):
+            raise OSError("no /proc here")
+        return real_open(path, *a, **kw)
+
+    real_listdir = os.listdir
+
+    def _no_proc_listdir(path="."):
+        if str(path).startswith("/proc"):
+            raise OSError("no /proc here")
+        return real_listdir(path)
+
+    # shadow the builtins in the module's own namespace: only the
+    # ceilings reader sees the /proc-less world
+    monkeypatch.setattr(obs_ceilings, "open", _no_proc_open,
+                        raising=False)
+    monkeypatch.setattr(obs_ceilings.os, "listdir", _no_proc_listdir)
+    v = obs_ceilings.read_proc_vitals()
+    assert set(v) == {"pid", "rss_bytes", "open_fds", "threads"}
+    assert v["pid"] == os.getpid()
+    assert v["open_fds"] is None  # no fallback exists; None, not a crash
+    assert v["rss_bytes"] is not None and v["rss_bytes"] > 0
+    assert v["threads"] is not None and v["threads"] >= 1
+
+
+def test_frozen_fallback_vitals_never_alarm(monkeypatch):
+    """The off-/proc RSS fallback is ru_maxrss — a PEAK, frozen between
+    ticks.  A monitor fed that constant for a whole window must stay
+    silent (slope 0), not alarm or crash the fleet health loop."""
+    frozen = {"pid": 4242, "rss_bytes": 512 << 20, "open_fds": None,
+              "threads": 8}
+    monkeypatch.setattr(obs_ceilings, "read_proc_vitals",
+                        lambda: dict(frozen))
+    now = [0.0]
+    mon = obs_ceilings.CeilingMonitor(clock=lambda: now[0],
+                                      cooldown_s=0.0)
+    alarms = []
+    for i in range(24):
+        now[0] = float(i)
+        alarms += mon.sample(now=float(i))
+    assert alarms == []
+    rpt = mon.report()["proc.rss_bytes"]
+    assert rpt["alarms"] == 0 and rpt["slope_per_s"] == 0.0
+
+
 # ------------------------------------------------ CLI offline readers
 
 

@@ -1,0 +1,344 @@
+"""Seeded trace-driven soak harness (ISSUE 20): `ia soak`.
+
+Tier-1 invariants locked here:
+
+- TraceSpec is a replayable artifact: to_dict/from_dict round-trips,
+  unknown fields and malformed mixes are rejected at load, and the
+  stream digest is bit-stable across replays while sensitive to the
+  seed (same spec ⇒ byte-identical request stream);
+- `loadgen.arrival_schedule` delegates to the spec's arrival model —
+  the historic pinned offsets survive the delegation, so drills,
+  selftests, and soaks can never drift onto parallel pacing code;
+- ChaosPlan.validate_sites rejects unknown injection sites and
+  `ia chaos --plan` / `TraceSpec` inline plans refuse them at load;
+- the scaled-down smoke soak PASSES its full invariant gate on CPU
+  with chaos armed throughout (worker kills, tier evictions, a torn
+  archive segment, hop latency), twice, with identical verdicts;
+- the gate FAILS LOUDLY on an unrecoverable fault plan: non-zero
+  verdicts, a non-zero loss count, and a culprit idempotency key that
+  `journal.reconstruct` (the `ia why` engine) can replay from the
+  persisted workdir;
+- the invariant evaluators are pure functions of the fact document
+  (synthetic facts exercise each verdict without a fleet).
+
+Every live-fleet test runs under a hard SIGALRM budget (the
+test_transport.py idiom): a wedged fleet fails ONE test loudly instead
+of eating the tier-1 budget.  The full-profile soak (240 requests, the
+bench headline's own spec) rides `-m slow`.
+"""
+
+import json
+import signal
+
+import numpy as np
+import pytest
+
+from image_analogies_tpu.chaos.plan import KNOWN_SITES, ChaosPlan, SiteRule
+from image_analogies_tpu.soak import driver as soak_driver
+from image_analogies_tpu.soak import invariants as soak_invariants
+from image_analogies_tpu.soak.trace import (TraceSpec, full_spec,
+                                            smoke_spec)
+
+
+@pytest.fixture(autouse=True)
+def _hard_timeout():
+    """Per-test wall-clock ceiling: a wedged fleet or a lost handoff
+    raises here instead of hanging the suite."""
+
+    def _boom(signum, frame):  # noqa: ARG001 - signal API
+        from image_analogies_tpu.serve import transport
+        transport.reap_orphans()
+        raise TimeoutError("soak test exceeded its 180 s budget")
+
+    old = signal.signal(signal.SIGALRM, _boom)
+    signal.alarm(180)
+    yield
+    signal.alarm(0)
+    signal.signal(signal.SIGALRM, old)
+
+
+# ------------------------------------------------------- TraceSpec codec
+
+
+def test_trace_spec_roundtrip_and_rejection(tmp_path):
+    spec = smoke_spec(seed=11)
+    again = TraceSpec.from_dict(json.loads(json.dumps(spec.to_dict())))
+    assert again == spec
+
+    path = tmp_path / "spec.json"
+    path.write_text(json.dumps(spec.to_dict()))
+    assert TraceSpec.load(str(path)) == spec
+
+    with pytest.raises(ValueError, match="unknown trace spec field"):
+        TraceSpec.from_dict({"requests": 4, "warp_factor": 9})
+    with pytest.raises(ValueError, match="unknown session kind"):
+        TraceSpec(sessions=(("streaming", 1.0),))
+    with pytest.raises(ValueError, match="unknown priority"):
+        TraceSpec(priorities=(("vip", 1.0),))
+    with pytest.raises(ValueError, match="flash crowd"):
+        TraceSpec(flash_crowds=((0.0, 1.0, 0.5),))
+    with pytest.raises(ValueError, match="diurnal_amplitude"):
+        TraceSpec(diurnal_amplitude=1.5)
+    with pytest.raises(ValueError, match="weight"):
+        TraceSpec(sessions=(("oneshot", 0.0),))
+
+
+def test_stream_digest_replayable_and_seed_sensitive():
+    a, b = smoke_spec(seed=7), smoke_spec(seed=7)
+    assert a.arrivals() == b.arrivals()
+    assert a.stream_digest() == b.stream_digest()
+    assert a.stream_digest() != smoke_spec(seed=8).stream_digest()
+    # the diurnal + surge shaping actually shapes: the flash crowd
+    # window compresses inter-arrival gaps relative to the base rate
+    rates = [a.rate_at(t) for t in (0.0, 0.3)]
+    assert rates[1] > rates[0] * 2
+
+
+def test_arrival_schedule_delegates_to_trace_spec():
+    from image_analogies_tpu.serve import loadgen
+
+    sched = loadgen.arrival_schedule(50, t0=0.2, duration=1.0,
+                                     mult=20.0, base_rps=30.0, seed=7)
+    # pinned offsets from before the delegation: the shared arrival
+    # model must reproduce the historic drill/bench pacing exactly
+    assert [round(t, 6) for t in sched[:3]] == [
+        0.00164, 0.054923, 0.058585]
+    spec = TraceSpec(seed=7, requests=50, base_rps=30.0,
+                     flash_crowds=((0.2, 1.0, 20.0),))
+    assert sched == spec.arrivals()
+
+
+# -------------------------------------------------- plan site validation
+
+
+def test_validate_sites_rejects_unknown(tmp_path):
+    good = ChaosPlan(seed=1, sites=(
+        ("level.dispatch", SiteRule(kind="transient", p=0.5)),))
+    assert good.validate_sites() is good
+
+    bad = ChaosPlan(seed=1, sites=(
+        ("level.dispatchh", SiteRule(kind="transient", p=0.5)),))
+    with pytest.raises(ValueError, match="level.dispatchh"):
+        bad.validate_sites()
+    # a custom registry tightens the check the same way
+    with pytest.raises(ValueError, match="level.dispatch"):
+        good.validate_sites(known=("serve.dispatch",))
+
+    # load() is the operator surface: a file plan with a typo'd site
+    # refuses before any drill arms it...
+    path = tmp_path / "plan.json"
+    path.write_text(json.dumps(bad.to_dict()))
+    with pytest.raises(ValueError, match="unknown injection site"):
+        ChaosPlan.load(str(path))
+    # ...and `ia chaos --plan` turns that into exit 2
+    from image_analogies_tpu.cli import main
+    assert main(["chaos", "--plan", str(path)]) == 2
+    assert all(s in KNOWN_SITES for s in ("serve.dispatch",
+                                          "devcache.tier",
+                                          "archive.append"))
+
+
+def test_soak_spec_inline_chaos_is_validated():
+    spec = TraceSpec(requests=2, chaos={
+        "seed": 1, "sites": {"no.such.site": {"kind": "transient",
+                                              "p": 1.0}}})
+    with pytest.raises(ValueError, match="no.such.site"):
+        soak_driver.run(spec)
+
+
+# ------------------------------------------------ invariant pure functions
+
+
+def _facts(**kw):
+    base = {"submitted": 4, "answered": 4, "rejected": {}, "errors": {},
+            "journals": {}, "audit": {}, "resubmits": 1,
+            "resubmit_identical": True, "kills": [], "handoffs": [],
+            "sites": {}, "archive": {"quarantined": 0},
+            "latencies_ms": [5.0, 6.0, 7.0, 8.0],
+            "counters": {}}
+    base.update(kw)
+    return base
+
+
+def _by_name(verdicts):
+    return {v["name"]: v for v in verdicts}
+
+
+def test_invariants_on_synthetic_facts():
+    spec = TraceSpec(name="syn", seed=3, requests=4, audit=0)
+    plan = soak_driver.default_plan(3)
+
+    # clean shed is accounted, hard rejection + raw errors are loss
+    assert soak_invariants.lost(_facts(
+        answered=2, rejected={"queue_full": 2})) == 0
+    assert soak_invariants.lost(_facts(
+        answered=2, rejected={"poison": 1}, errors={"3": "Timeout"})) == 2
+
+    v = _by_name(soak_invariants.evaluate(spec, plan, _facts(
+        answered=3, errors={2: "TimeoutError"})))
+    assert not v["zero_loss"]["ok"]
+    assert v["zero_loss"]["culprit"] == "syn-3-2"
+
+    v = _by_name(soak_invariants.evaluate(spec, plan, _facts(
+        journals={"w0": {"poisoned": ["syn-3-1"], "segments": 1,
+                         "compacted": {}}})))
+    assert not v["no_poison"]["ok"]
+    assert v["no_poison"]["culprit"] == "syn-3-1"
+
+    v = _by_name(soak_invariants.evaluate(spec, plan, _facts(
+        counters={"obs.ceiling.alarms": 1})))
+    assert not v["no_ceiling_alarms"]["ok"]
+
+    v = _by_name(soak_invariants.evaluate(spec, plan, _facts(
+        journals={"w0": {"poisoned": [], "segments": 3,
+                         "compacted": {}}})))
+    assert not v["journal_bounded"]["ok"]
+
+    v = _by_name(soak_invariants.evaluate(
+        spec, plan, _facts(audit={0: "ok", 1: "mismatch"})))
+    assert not v["bit_identity"]["ok"]
+    assert v["bit_identity"]["culprit"] == "syn-3-1"
+
+    # p99.9 over an empty run refuses to pass (None is not a bound)
+    v = _by_name(soak_invariants.evaluate(
+        spec, plan, _facts(latencies_ms=[], answered=0, submitted=0)))
+    assert not v["p999_bound"]["ok"]
+
+
+# --------------------------------------------------------- live soak gate
+
+
+def _assert_green(res):
+    report = soak_invariants.render(res)
+    assert res["ok"], report
+    return report
+
+
+def test_smoke_soak_gate_passes_and_replays_identically():
+    """The tier-1 soak: scaled-down spec, full methodology — chaos armed
+    throughout, seeded kills, every invariant green, twice, with
+    identical verdicts."""
+    first = soak_driver.run(smoke_spec())
+    report = _assert_green(first)
+    assert "PASS" in report
+
+    facts = first["facts"]
+    # chaos was demonstrably armed the whole run: the acceptance
+    # witness list all fired, and every seeded kill recovered
+    assert len(facts["kills"]) >= 2
+    assert len(facts["handoffs"]) >= len(facts["kills"])
+    for site in soak_driver.REQUIRED_SITES:
+        assert facts["sites"].get(site, {}).get("injected", 0) >= 1, \
+            facts["sites"]
+    assert facts["archive"]["quarantined"] >= 1
+    assert first["loss"] == 0 and first["p999_ms"] is not None
+    # the smoke kills one worker twice: its second replace finds a
+    # multi-segment corpse and must actually compact it; every other
+    # kill at least ran the decision
+    autoc = facts["counters"].get("serve.journal.autocompact", 0)
+    skipped = facts["counters"].get("serve.journal.autocompact_skipped",
+                                    0)
+    assert autoc >= 1
+    assert autoc + skipped >= len(facts["kills"])
+    # post-compaction, every worker journal is bounded to one segment
+    assert all(doc["segments"] <= 1 for doc in facts["journals"].values())
+
+    second = soak_driver.run(smoke_spec())
+    _assert_green(second)
+    assert [(v["name"], v["ok"]) for v in first["verdicts"]] \
+        == [(v["name"], v["ok"]) for v in second["verdicts"]]
+
+
+def test_soak_gate_fails_loudly_with_why_linkable_culprit(tmp_path):
+    """An unrecoverable fault plan (every dispatch crashes, forever)
+    must redden the gate — and the persisted workdir must let `ia why`
+    reconstruct the culprit's causal chain."""
+    from image_analogies_tpu.serve import journal as serve_journal
+
+    spec = TraceSpec(name="hostile", seed=3, requests=6,
+                     shapes=((12, 12),), base_rps=200.0,
+                     sessions=(("oneshot", 1.0),), audit=2)
+    plan = ChaosPlan(seed=3, sites=(
+        ("serve.dispatch", SiteRule(kind="crash", p=1.0)),),
+        name="hostile").validate_sites()
+    workdir = tmp_path / "run"
+    res = soak_driver.run(spec, workdir=str(workdir), plan=plan)
+
+    assert not res["ok"]
+    assert res["loss"] > 0
+    failing = [v for v in res["verdicts"] if not v["ok"]]
+    assert failing
+    culprits = [v["culprit"] for v in res["verdicts"] if v.get("culprit")]
+    assert culprits and all(c.startswith("hostile-3-") for c in culprits)
+    # the red gate's evidence survived on disk, `ia why`-linkable
+    root = res["facts"]["journal_root"]
+    assert root and root.startswith(str(workdir))
+    why = serve_journal.reconstruct(culprits[0], root)
+    assert why["found"] and why["workers"]
+    # the renderer names the culprit in the runbook form
+    assert f"ia why {culprits[0]}" in soak_invariants.render(res)
+
+
+def test_cli_soak_smoke(tmp_path, capsys):
+    from image_analogies_tpu.cli import main
+
+    rc = main(["soak", "--seed", "7", "--json"])
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert "ia soak: PASS" in captured.out
+    doc = json.loads(captured.err)
+    assert doc["ok"] and doc["workload"] == "soak"
+
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"requests": 4, "warp_factor": 9}))
+    assert main(["soak", "--spec", str(bad)]) == 2
+    assert main(["soak", "--spec", str(tmp_path / "missing.json")]) == 2
+
+
+@pytest.mark.slow
+def test_full_profile_soak_headlines():
+    """The bench-profile spec end-to-end: the same run measure_soak
+    records headlines from must be green at duration."""
+    res = soak_driver.run(full_spec())
+    _assert_green(res)
+    assert res["loss"] == 0
+    assert res["p999_ms"] is not None \
+        and res["p999_ms"] <= full_spec().p999_bound_ms
+    assert len(res["facts"]["kills"]) >= 4
+
+
+# ------------------------------------------------- bench headline riders
+
+
+def test_bench_check_gates_soak_headlines(tmp_path):
+    import bench
+
+    traj = {"points": [
+        {"metric_key": "1024x1024", "value": 10.0, "file": "r1",
+         "soak_p999_ms": 900.0, "soak_loss": 0},
+    ], "problems": []}
+    ok = bench.check_regression(traj, fresh_value=10.0,
+                                fresh_soak_p999=950.0, fresh_soak_loss=0)
+    assert ok["ok"] and ok["soak_p999_floor"] == 900.0
+
+    red = bench.check_regression(traj, fresh_value=10.0,
+                                 fresh_soak_p999=2000.0,
+                                 fresh_soak_loss=0)
+    assert not red["ok"]
+    assert any("soak_p999_ms" in p for p in red["problems"])
+
+    # loss gates ABSOLUTELY — any lost request fails without a floor
+    lossy = bench.check_regression(traj, fresh_value=10.0,
+                                   fresh_soak_p999=950.0,
+                                   fresh_soak_loss=1)
+    assert not lossy["ok"]
+    assert any("soak_lost_requests" in p for p in lossy["problems"])
+
+    # legacy archives carry no soak floor: record-only, never a gate
+    legacy = {"points": [{"metric_key": "1024x1024", "value": 10.0,
+                          "file": "r1"}], "problems": []}
+    rec = bench.check_regression(legacy, fresh_value=10.0,
+                                 fresh_soak_p999=950.0,
+                                 fresh_soak_loss=0)
+    assert rec["ok"] and rec["soak_p999_floor"] is None
+    assert rec["soak_p999_ms"] == 950.0 and rec["soak_loss"] == 0
